@@ -1,0 +1,41 @@
+"""Numerics / kernel layer.
+
+TPU-native counterpart of the reference's `src/llm_training/ops/` package
+(attention_op.py, rope_utils.py, rms_norm_op.py, rope_op.py, swiglu_op.py,
+cross_entropy_op.py and the Triton wrappers under ops/liger_kernel/).
+
+Pure-jnp reference implementations live here; Pallas TPU kernels live in
+`llm_training_tpu.ops.pallas` and are dispatched via the `impl=` arguments.
+"""
+
+from llm_training_tpu.ops.rms_norm import rms_norm
+from llm_training_tpu.ops.rope import apply_rope, rotate_half
+from llm_training_tpu.ops.rope_utils import RoPEConfig, compute_rope_frequencies, compute_rope_cos_sin
+from llm_training_tpu.ops.swiglu import swiglu, silu_mul
+from llm_training_tpu.ops.cross_entropy import (
+    shift_labels,
+    cross_entropy,
+    fused_linear_cross_entropy,
+)
+from llm_training_tpu.ops.attention import (
+    dot_product_attention,
+    make_attention_mask,
+    segment_ids_from_attention_mask,
+)
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rotate_half",
+    "RoPEConfig",
+    "compute_rope_frequencies",
+    "compute_rope_cos_sin",
+    "swiglu",
+    "silu_mul",
+    "shift_labels",
+    "cross_entropy",
+    "fused_linear_cross_entropy",
+    "dot_product_attention",
+    "make_attention_mask",
+    "segment_ids_from_attention_mask",
+]

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the hot ops.
+
+TPU-native replacement for the reference's external native kernels
+(flash-attn CUDA, liger-kernel Triton — see SURVEY.md §2.9). Each kernel has
+an XLA fallback in `llm_training_tpu.ops`; dispatch is via the `impl=`
+arguments on the op entry points.
+"""

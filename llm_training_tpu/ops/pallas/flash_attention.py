@@ -1,0 +1,28 @@
+"""Pallas TPU flash attention with segment-id packing.
+
+TPU-native replacement for the reference's flash-attn CUDA dispatch
+(`ops/attention_op.py:538-654`): causal, GQA, sliding window, soft-cap, and
+packed varlen via segment ids instead of unpad/cu_seqlens.
+
+Placeholder: the kernel lands with the Pallas kernel milestone; callers fall
+back to the XLA path via NotImplementedError until then.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray | None = None,
+    q_segment_ids: jnp.ndarray | None = None,
+    causal: bool = True,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+    q_offset: int = 0,
+) -> jnp.ndarray:
+    raise NotImplementedError("pallas flash attention kernel not yet implemented")

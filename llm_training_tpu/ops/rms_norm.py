@@ -1,0 +1,26 @@
+"""RMS normalization.
+
+Capability parity: reference `src/llm_training/ops/rms_norm_op.py:4-14` (fp32
+upcast, variance over last dim) and the Triton-fused
+`ops/liger_kernel/rms_norm_op.py`. On TPU the fused version is just this
+function under XLA fusion — the normalization fuses into the surrounding
+elementwise/matmul HLO, so no hand-written kernel is needed for parity.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    """y = weight * (x / rms(x)).
+
+    The normalization runs in fp32; the normalized value is rounded back to
+    x.dtype *before* the weight multiply, matching the reference's order of
+    operations (`rms_norm_op.py:4-14`: `weight * x_normed.to(input_dtype)`) so
+    bf16 activations produce bit-identical results.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    x_normed = (x32 * jax.lax.rsqrt(variance + eps)).astype(dtype)
+    return weight * x_normed

@@ -1,0 +1,44 @@
+"""Rotary position embedding application.
+
+Capability parity: reference `src/llm_training/ops/rope_op.py:4-20`
+(rotate_half / apply_rope) and the Triton-fused `ops/liger_kernel/rope_op.py`.
+Uses the HF "half rotation" layout: cos/sin are `[..., seq, head_dim]` with the
+frequency vector duplicated along the last dim.
+"""
+
+import jax.numpy as jnp
+
+
+def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    """Rotate the second half of the last dim into the (negated) first half."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply rotary embedding to q and k.
+
+    q: [batch, seq, num_heads, head_dim] (head axis broadcast-compatible)
+    k: [batch, seq, num_kv_heads, head_dim]
+    cos/sin: [batch, seq, head_dim] or [seq, head_dim]
+
+    cos/sin are computed in fp32 by the rotary cache (see rope_utils) and cast
+    to the activation dtype here, matching the reference's precision choice
+    (`models/llama/llama_model.py:367-387`).
+    """
+    if cos.ndim == 2:
+        cos = cos[None]
+        sin = sin[None]
+    # -> [batch, seq, 1, head_dim] to broadcast over heads; cast the fp32
+    # tables to each tensor's dtype independently (no double rounding when
+    # q and k dtypes differ).
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    q_rot = q * cos.astype(q.dtype) + rotate_half(q) * sin.astype(q.dtype)
+    k_rot = k * cos.astype(k.dtype) + rotate_half(k) * sin.astype(k.dtype)
+    return q_rot, k_rot

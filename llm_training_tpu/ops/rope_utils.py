@@ -1,0 +1,236 @@
+"""RoPE frequency computation: all six scaling variants.
+
+Capability parity: reference `src/llm_training/ops/rope_utils.py` — the
+`default` / `linear` / `dynamic` (NTK) / `yarn` / `longrope` / `llama3`
+variants of `ROPE_INIT_FUNCTIONS` (`rope_utils.py:289-296`) plus the
+per-variant config validation (`rope_utils.py:462-469`).
+
+Frequencies are computed host-side in float64-free numpy (fp32), since they
+depend only on static config + (for `dynamic`/`longrope`) a static sequence
+length; the device-side work is just `positions * inv_freq` (see
+`compute_rope_cos_sin`), which stays in fp32 as the reference does
+(`models/llama/llama_model.py:367-387`).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Any, Callable
+
+import jax.numpy as jnp
+import numpy as np
+from pydantic import BaseModel, ConfigDict, model_validator
+
+logger = logging.getLogger(__name__)
+
+
+class RoPEConfig(BaseModel):
+    """Static description of a rotary embedding.
+
+    `scaling` holds the variant-specific knobs (HF `rope_scaling` dict):
+      linear/dynamic: factor
+      yarn:     factor, [attention_factor, beta_fast, beta_slow]
+      longrope: short_factor, long_factor, factor, [attention_factor]
+      llama3:   factor, low_freq_factor, high_freq_factor,
+                original_max_position_embeddings
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    type: str = "default"
+    base: float = 10000.0
+    dim: int
+    max_position_embeddings: int
+    scaling: dict[str, Any] | None = None
+
+    @model_validator(mode="after")
+    def _validate(self) -> "RoPEConfig":
+        fn = _VALIDATORS.get(self.type)
+        if fn is None:
+            raise ValueError(
+                f"Unknown rope type {self.type!r}; expected one of {sorted(ROPE_INIT_FUNCTIONS)}"
+            )
+        fn(self)
+        return self
+
+
+def _require(config: RoPEConfig, keys: set[str], optional: set[str] = frozenset()) -> None:
+    scaling = config.scaling or {}
+    received = set(scaling)
+    missing = keys - received
+    if missing:
+        raise ValueError(f"rope type {config.type!r} requires scaling keys {sorted(missing)}")
+    unknown = received - keys - set(optional)
+    if unknown:
+        logger.warning("rope type %r received unused scaling keys %s", config.type, sorted(unknown))
+
+
+def _validate_default(config: RoPEConfig) -> None:
+    if config.scaling:
+        logger.warning("rope type 'default' ignores scaling config %s", config.scaling)
+
+
+def _validate_factor(config: RoPEConfig) -> None:
+    _require(config, {"factor"})
+    if config.scaling["factor"] < 1.0:
+        raise ValueError(f"rope scaling factor must be >= 1, got {config.scaling['factor']}")
+
+
+def _validate_yarn(config: RoPEConfig) -> None:
+    _require(config, {"factor"}, {"attention_factor", "beta_fast", "beta_slow"})
+
+
+def _validate_longrope(config: RoPEConfig) -> None:
+    _require(config, {"short_factor", "long_factor", "factor"}, {"attention_factor"})
+    for key in ("short_factor", "long_factor"):
+        factors = config.scaling[key]
+        if len(factors) != config.dim // 2:
+            raise ValueError(
+                f"longrope {key} must have length dim/2={config.dim // 2}, got {len(factors)}"
+            )
+
+
+def _validate_llama3(config: RoPEConfig) -> None:
+    _require(
+        config,
+        {"factor", "low_freq_factor", "high_freq_factor", "original_max_position_embeddings"},
+    )
+    s = config.scaling
+    if s["low_freq_factor"] >= s["high_freq_factor"]:
+        raise ValueError("llama3 rope needs low_freq_factor < high_freq_factor")
+
+
+_VALIDATORS: dict[str, Callable[[RoPEConfig], None]] = {
+    "default": _validate_default,
+    "linear": _validate_factor,
+    "dynamic": _validate_factor,
+    "yarn": _validate_yarn,
+    "longrope": _validate_longrope,
+    "llama3": _validate_llama3,
+}
+
+
+def _base_inv_freq(base: float, dim: int) -> np.ndarray:
+    return 1.0 / (base ** (np.arange(0, dim, 2, dtype=np.float32) / dim))
+
+
+def _default_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, float]:
+    return _base_inv_freq(config.base, config.dim), 1.0
+
+
+def _linear_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, float]:
+    inv_freq, attention_factor = _default_rope(config, seq_len)
+    return inv_freq / config.scaling["factor"], attention_factor
+
+
+def _dynamic_ntk_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, float]:
+    dim = config.dim
+    factor = config.scaling["factor"]
+    max_pos = config.max_position_embeddings
+    seq_len = seq_len if seq_len is not None and seq_len > max_pos else max_pos
+    base = config.base * ((factor * seq_len / max_pos) - (factor - 1)) ** (dim / (dim - 2))
+    return _base_inv_freq(base, dim), 1.0
+
+
+def _yarn_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, float]:
+    base, dim = config.base, config.dim
+    max_pos = config.max_position_embeddings
+    scaling = config.scaling
+    factor = scaling["factor"]
+
+    attention_factor = scaling.get("attention_factor")
+    if attention_factor is None:
+        attention_factor = 0.1 * math.log(factor) + 1.0
+    beta_fast = scaling.get("beta_fast") or 32
+    beta_slow = scaling.get("beta_slow") or 1
+
+    def correction_dim(num_rotations: float) -> float:
+        # Dimension whose wavelength completes `num_rotations` over the context.
+        return dim * math.log(max_pos / (num_rotations * 2 * math.pi)) / (2 * math.log(base))
+
+    low = max(math.floor(correction_dim(beta_fast)), 0)
+    high = min(math.ceil(correction_dim(beta_slow)), dim - 1)
+    if low == high:
+        high += 0.001  # avoid a 0-width ramp
+
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float32) - low) / (high - low), 0, 1)
+    pos_freqs = config.base ** (np.arange(0, dim, 2, dtype=np.float32) / dim)
+    extrapolation = 1.0 / pos_freqs
+    interpolation = 1.0 / (factor * pos_freqs)
+    # ramp==0 → pure extrapolation (high-freq dims); ramp==1 → pure interpolation.
+    extrapolation_weight = 1.0 - ramp
+    inv_freq = interpolation * (1 - extrapolation_weight) + extrapolation * extrapolation_weight
+    return inv_freq.astype(np.float32), float(attention_factor)
+
+
+def _longrope_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, float]:
+    base, dim = config.base, config.dim
+    max_pos = config.max_position_embeddings
+    scaling = config.scaling
+    factor = scaling["factor"]
+
+    seq_len = seq_len or int(max_pos * factor)
+    attention_factor = scaling.get("attention_factor")
+    if attention_factor is None:
+        if factor <= 1.0:
+            attention_factor = 1.0
+        else:
+            attention_factor = math.sqrt(1 + math.log(factor) / math.log(max_pos))
+
+    key = "long_factor" if seq_len > max_pos else "short_factor"
+    ext_factors = np.asarray(scaling[key], dtype=np.float32)
+    inv_freq = 1.0 / (ext_factors * base ** (np.arange(0, dim, 2, dtype=np.float32) / dim))
+    return inv_freq.astype(np.float32), float(attention_factor)
+
+
+def _llama3_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, float]:
+    inv_freq, attention_factor = _default_rope(config, seq_len)
+    scaling = config.scaling
+    factor = scaling["factor"]
+    low_freq_factor = scaling["low_freq_factor"]
+    high_freq_factor = scaling["high_freq_factor"]
+    old_context_len = scaling["original_max_position_embeddings"]
+
+    low_freq_wavelen = old_context_len / low_freq_factor
+    high_freq_wavelen = old_context_len / high_freq_factor
+    wavelen = 2 * math.pi / inv_freq
+
+    scaled = np.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    smooth = (old_context_len / wavelen - low_freq_factor) / (high_freq_factor - low_freq_factor)
+    smoothed = (1 - smooth) * scaled / factor + smooth * scaled
+    is_medium = (wavelen >= high_freq_wavelen) & (wavelen <= low_freq_wavelen)
+    return np.where(is_medium, smoothed, scaled).astype(np.float32), attention_factor
+
+
+ROPE_INIT_FUNCTIONS: dict[str, Callable[[RoPEConfig, int | None], tuple[np.ndarray, float]]] = {
+    "default": _default_rope,
+    "linear": _linear_rope,
+    "dynamic": _dynamic_ntk_rope,
+    "yarn": _yarn_rope,
+    "longrope": _longrope_rope,
+    "llama3": _llama3_rope,
+}
+
+
+def compute_rope_frequencies(
+    config: RoPEConfig, seq_len: int | None = None
+) -> tuple[np.ndarray, float]:
+    """Return (inv_freq[dim/2] fp32 numpy, attention_factor)."""
+    return ROPE_INIT_FUNCTIONS[config.type](config, seq_len)
+
+
+def compute_rope_cos_sin(
+    inv_freq: np.ndarray | jnp.ndarray,
+    positions: jnp.ndarray,
+    attention_factor: float = 1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for `positions` (any leading shape), fp32.
+
+    Output shape: positions.shape + (dim,), with the frequency vector
+    duplicated along the last dim (HF half-rotation layout).
+    """
+    inv_freq = jnp.asarray(inv_freq, dtype=jnp.float32)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb) * attention_factor, jnp.sin(emb) * attention_factor

@@ -1,0 +1,32 @@
+"""Test harness: single-process 8-device CPU mesh.
+
+The reference has no test suite (SURVEY.md §4); this framework's tests run
+every parallelism mode (DP/FSDP/TP/SP/CP) on a virtual 8-device CPU mesh via
+XLA's host-platform device-count override, so distributed behavior is
+CI-testable without hardware.
+
+Note: this image's sitecustomize imports jax and registers a TPU backend at
+interpreter start, so env vars alone are too late — we must override via
+jax.config before the backend client is instantiated.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
