@@ -1,0 +1,140 @@
+"""Benchmark: CLM train-step throughput + MFU on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Target (BASELINE.md): ≥55% MFU on Llama-3-8B class workloads; on the single
+bench chip we measure a scaled-down Llama with the same arithmetic shape and
+report MFU fraction with vs_baseline = mfu / 0.55.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# peak bf16 FLOP/s per chip by TPU generation (public specs)
+_PEAK_FLOPS = {
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v6e": 918e12,
+    "cpu": 1e12,  # nominal, so CPU runs still print a line
+}
+
+
+def _detect_peak() -> float:
+    import os
+
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "")
+    if gen in _PEAK_FLOPS:
+        return _PEAK_FLOPS[gen]
+    # device_kind strings: 'TPU v5 lite' == v5e, 'TPU v6 lite' == v6e,
+    # 'TPU v5p'/'TPU v5' == v5p, 'TPU v4' == v4
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return _PEAK_FLOPS["v5e"]
+    if "v6 lite" in kind or "v6e" in kind:
+        return _PEAK_FLOPS["v6e"]
+    if "v5" in kind:
+        return _PEAK_FLOPS["v5p"]
+    if "v4" in kind:
+        return _PEAK_FLOPS["v4"]
+    return _PEAK_FLOPS["cpu"]
+
+
+def main() -> None:
+    from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.optim import OptimConfig
+    from llm_training_tpu.parallel import MeshConfig
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    # ~300M-param Llama: same arithmetic shape class as 8B, sized for one chip
+    model_kwargs = dict(
+        vocab_size=32000,
+        hidden_size=1024,
+        intermediate_size=4096,
+        num_hidden_layers=16,
+        num_attention_heads=16,
+        num_key_value_heads=8,
+        max_position_embeddings=2048,
+        enable_gradient_checkpointing=True,
+        recompute_granularity="full",
+    )
+    if not on_tpu:  # CPU smoke: tiny
+        model_kwargs.update(hidden_size=128, intermediate_size=256, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=2, vocab_size=2048)
+
+    seq = 2048
+    batch = 8 if on_tpu else 4
+    steps = 10 if on_tpu else 3
+
+    objective = CLM(
+        CLMConfig(
+            model=ModelProvider(
+                model_class="llm_training_tpu.models.Llama", model_kwargs=model_kwargs
+            ),
+            optim=OptimConfig(learning_rate=1e-4, warmup_steps=2),
+            ce_chunk_size=2048,
+        )
+    )
+    n_dev = len(jax.devices())
+    datamodule = DummyDataModule(
+        DummyDataModuleConfig(
+            batch_size=batch * max(1, n_dev), max_length=seq,
+            num_samples=batch * max(1, n_dev) * 2, vocab_size=model_kwargs["vocab_size"],
+        )
+    )
+
+    times = []
+
+    class Timer:
+        def on_step_end(self, trainer, step, metrics):
+            times.append(time.perf_counter())
+
+    trainer = Trainer(
+        TrainerConfig(max_steps=steps, log_every_n_steps=1, mesh=MeshConfig()),
+        callbacks=[Timer()],
+    )
+    trainer.fit(objective, datamodule)
+
+    # drop compile step; average the rest
+    deltas = np.diff(times)
+    sec_per_step = float(np.median(deltas)) if len(deltas) else float("nan")
+    tokens_per_step = batch * max(1, n_dev) * seq
+    tokens_per_sec = tokens_per_step / sec_per_step
+    tokens_per_sec_chip = tokens_per_sec / max(1, n_dev)
+
+    cfg = objective.model.config
+    n_params = (
+        cfg.vocab_size * cfg.hidden_size * 2
+        + cfg.num_hidden_layers
+        * (
+            cfg.hidden_size * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
+            * cfg.resolved_head_dim
+            + cfg.num_attention_heads * cfg.resolved_head_dim * cfg.hidden_size
+            + 3 * cfg.hidden_size * cfg.intermediate_size
+            + 2 * cfg.hidden_size
+        )
+    )
+    # 6ND (fwd+bwd) + full-remat extra forward 2ND = 8ND; attention flops excluded
+    flops_per_token = 8 * n_params
+    mfu = tokens_per_sec_chip * flops_per_token / _detect_peak()
+
+    print(json.dumps({
+        "metric": "llama_clm_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.55, 4),
+        "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 1),
+        "sec_per_step": round(sec_per_step, 4),
+        "n_params": n_params,
+        "n_devices": n_dev,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

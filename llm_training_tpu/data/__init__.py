@@ -1,0 +1,22 @@
+"""Data pipeline.
+
+Capability parity: reference `src/llm_training/data/` — base datamodule +
+collator, HF-datasets-based preprocessing with stable caching, pre-training /
+instruction-tuning / preference-tuning modules, packing (naive, best-fit-
+decreasing, group-by-length), chat templates with assistant masks, dummy
+synthetic data, and resumable loading.
+
+Batches are numpy dicts with `input_ids`, `labels`, `position_ids` and
+`segment_ids` (the reference's document-id attention masks,
+`attention_op.py:286-302` — 0 = padding, 1..N = packed docs).
+"""
+
+from llm_training_tpu.data.base import BaseDataModule, BaseDataModuleConfig
+from llm_training_tpu.data.dummy import DummyDataModule, DummyDataModuleConfig
+
+__all__ = [
+    "BaseDataModule",
+    "BaseDataModuleConfig",
+    "DummyDataModule",
+    "DummyDataModuleConfig",
+]

@@ -1,0 +1,13 @@
+"""Training objectives ("lms").
+
+Capability parity: reference `src/llm_training/lms/` — `BaseLightningModule`
+plus the CLM / DPO / ORPO objectives. Here an objective is a pure-function
+bundle: it owns loss + metrics and delegates architecture to a model via the
+`CausalLM` protocol (reference `lms/protos/clm_proto.py:9-26`), but carries
+no trainer state — the Trainer jits `objective.loss_and_metrics` directly.
+"""
+
+from llm_training_tpu.lms.base import BaseLMConfig, CausalLM, ModelProvider
+from llm_training_tpu.lms.clm import CLM, CLMConfig
+
+__all__ = ["BaseLMConfig", "CausalLM", "ModelProvider", "CLM", "CLMConfig"]

@@ -1,0 +1,138 @@
+"""Causal language modeling objective.
+
+Capability parity: reference `lms/clm/clm.py:25-188` — label shifting
+(`clm.py:137`), fused-linear CE so full logits never materialize
+(`clm.py:113-126` via liger; here `ops.fused_linear_cross_entropy`), NEFTune
+embedding noise during training (`clm.py:45-82`), and the loss/perplexity/
+consumed-counter metrics (`clm.py:84-99,155-167`).
+
+Under tensor parallelism the reference switches to `loss_parallel` with
+vocab-sharded logits (`clm.py:113-126`); here the same effect falls out of
+GSPMD: the lm_head kernel is vocab-sharded ('vocab' → tensor axis) and the
+chunked CE's matmul+logsumexp lower to sharded HLO with a psum — no separate
+code path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from pydantic import ConfigDict
+
+from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
+from llm_training_tpu.ops import fused_linear_cross_entropy, shift_labels
+
+
+class CLMConfig(BaseLMConfig):
+    """Reference `lms/clm/clm_config.py:5-9`."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    model: ModelProvider | None = None
+    ignore_index: int = -100
+    neftune_alpha: float | None = None
+    log_perplexity: bool = True
+    ce_chunk_size: int = 1024
+
+
+def _get_path(tree: Any, path: str) -> jnp.ndarray:
+    import flax.linen as nn
+
+    node = tree
+    for key in path.split("/"):
+        node = node[key]
+    if isinstance(node, nn.Partitioned):
+        node = node.value
+    return node
+
+
+class CLM:
+    """The CLM objective as a pure-function bundle.
+
+    `loss_and_metrics` is the jit-traced hot path; everything else is setup.
+    """
+
+    def __init__(self, config: CLMConfig, model: Any | None = None):
+        self.config = config
+        self.model = model if model is not None else config.model.get_model()
+
+    def init_params(self, rng: jax.Array, batch: dict[str, jnp.ndarray]) -> Any:
+        return self.model.init(rng, batch["input_ids"][:1])
+
+    def loss_and_metrics(
+        self,
+        params: Any,
+        batch: dict[str, jnp.ndarray],
+        rng: jax.Array | None = None,
+        train: bool = True,
+    ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+        """batch: input_ids [B,S]; optional labels (pre-shift), segment_ids,
+        position_ids. Returns (mean loss fp32, metrics dict)."""
+        cfg = self.config
+        model = self.model
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels", input_ids)
+        segment_ids = batch.get("segment_ids")
+        position_ids = batch.get("position_ids")
+
+        labels = shift_labels(labels, cfg.ignore_index)
+        if segment_ids is not None:
+            # mask padding AND packed-document boundaries: after the shift,
+            # position i's label must belong to the same document (the
+            # reference gets this via BOS masking in its collators,
+            # pre_training_datacollator.py:32-46; doing it here makes the
+            # no-cross-contamination guarantee independent of the collator)
+            next_seg = jnp.concatenate(
+                [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+            )
+            valid = (segment_ids > 0) & (segment_ids == next_seg)
+            labels = jnp.where(valid, labels, cfg.ignore_index)
+
+        p = params["params"] if "params" in params else params
+
+        inputs_embeds = None
+        if train and cfg.neftune_alpha:
+            # NEFTune (reference clm.py:45-82): uniform noise on the input
+            # embeddings, scale alpha / sqrt(tokens * dim).
+            embed_table = _get_path(p, model.get_input_embeddings_path())
+            inputs_embeds = embed_table[input_ids].astype(model.config.compute_jnp_dtype)
+            tokens = input_ids.shape[1]
+            dim = inputs_embeds.shape[-1]
+            mag = cfg.neftune_alpha / math.sqrt(tokens * dim)
+            noise = jax.random.uniform(
+                rng, inputs_embeds.shape, dtype=inputs_embeds.dtype, minval=-mag, maxval=mag
+            )
+            inputs_embeds = inputs_embeds + noise
+
+        out = model.apply(
+            params,
+            input_ids=None if inputs_embeds is not None else input_ids,
+            segment_ids=segment_ids,
+            position_ids=position_ids,
+            inputs_embeds=inputs_embeds,
+            compute_logits=False,
+            return_last_hidden_states=True,
+        )
+        head_path = model.get_output_embeddings_path()
+        head = _get_path(p, head_path)
+        if head_path == model.get_input_embeddings_path():
+            head = head.T  # tied embeddings: [vocab, embed] -> [embed, vocab]
+        total, count = fused_linear_cross_entropy(
+            out.last_hidden_states,
+            head.astype(out.last_hidden_states.dtype),
+            labels,
+            ignore_index=cfg.ignore_index,
+            chunk_size=cfg.ce_chunk_size,
+        )
+        loss = total / jnp.maximum(count, 1).astype(jnp.float32)
+
+        metrics = {
+            "loss": loss,
+            "target_tokens": count,
+        }
+        if self.config.log_perplexity:
+            metrics["perplexity"] = jnp.exp(loss)
+        return loss, metrics

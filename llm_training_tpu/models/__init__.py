@@ -1,0 +1,15 @@
+"""Model layer.
+
+Capability parity: reference `src/llm_training/models/` — `BaseModel`
+(init_weights + parallelize hooks), `HFCompatModel` (HF config merge +
+state-dict round-trip), and the concrete `Llama` / `Phi3` / `HFCausalLM`
+families. Here, models are flax.linen Modules whose parameters carry
+*logical axis* metadata; the TP/FSDP "plans" of the reference
+(`llama_model.py:197-268`) are the logical→mesh rule table in
+`llm_training_tpu.parallel.sharding`.
+"""
+
+from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
+from llm_training_tpu.models.llama import Llama, LlamaConfig
+
+__all__ = ["BaseModelConfig", "CausalLMOutput", "Llama", "LlamaConfig"]

@@ -1,0 +1,70 @@
+"""Base model config + output types.
+
+Capability parity: reference `models/base_model/base_model.py:14-74`
+(config-carrying module, init_weights gate, parallelize hooks — the hooks
+dissolve into logical-axis metadata here) and
+`models/utils/modeling_outputs.py:11-13` (`CausalLMOutput`).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import flax.struct
+import jax.numpy as jnp
+from pydantic import BaseModel, ConfigDict
+
+
+_DTYPE_MAP = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float64": jnp.float64,
+}
+
+DTypeName = Literal["float32", "bfloat16", "float16", "float64"]
+
+
+def resolve_dtype(name: str) -> jnp.dtype:
+    try:
+        return _DTYPE_MAP[name]
+    except KeyError:
+        raise ValueError(f"unknown dtype {name!r}; expected one of {sorted(_DTYPE_MAP)}")
+
+
+class BaseModelConfig(BaseModel):
+    """Common model-config surface.
+
+    `pre_trained_weights` mirrors the reference's weight-source field
+    (`base_model.py:32-33`); dtype fields replace its str→torch.dtype
+    validator (`base_model_config.py`) with str→jnp names resolved lazily.
+
+    The master-weights scheme of the reference (`optim/master_weight_wrapper.py`)
+    is expressed here directly: params live in `param_dtype` (fp32), the
+    forward runs in `compute_dtype` (bf16), optimizer state stays fp32.
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    pre_trained_weights: str | None = None
+    compute_dtype: DTypeName = "bfloat16"
+    param_dtype: DTypeName = "float32"
+
+    @property
+    def compute_jnp_dtype(self) -> jnp.dtype:
+        return resolve_dtype(self.compute_dtype)
+
+    @property
+    def param_jnp_dtype(self) -> jnp.dtype:
+        return resolve_dtype(self.param_dtype)
+
+
+@flax.struct.dataclass
+class CausalLMOutput:
+    """Forward output (reference `modeling_outputs.py:11-13`).
+
+    `logits` is None when the objective requests hidden states only (for
+    fused-linear-CE, which needs the pre-head activations)."""
+
+    logits: jnp.ndarray | None = None
+    last_hidden_states: jnp.ndarray | None = None

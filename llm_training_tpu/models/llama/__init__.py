@@ -1,0 +1,4 @@
+from llm_training_tpu.models.llama.config import LlamaConfig
+from llm_training_tpu.models.llama.model import Llama
+
+__all__ = ["Llama", "LlamaConfig"]

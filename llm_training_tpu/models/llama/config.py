@@ -1,0 +1,80 @@
+"""Llama model config.
+
+Capability parity: reference `models/llama/llama_config.py:7-32` (all HF
+Llama hparams + gradient-checkpointing knobs), with TPU-native additions:
+`scan_layers` (compile-time: one traced layer scanned over depth) and
+`attention_impl` (xla reference path vs pallas flash kernel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+from llm_training_tpu.ops.rope_utils import RoPEConfig
+
+
+class LlamaConfig(BaseModelConfig):
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    head_dim: int | None = None  # defaults to hidden_size // num_attention_heads
+    max_position_embeddings: int = 4096
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-6
+    pad_token_id: int | None = None
+    bos_token_id: int = 1
+    eos_token_id: int = 2
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    attention_bias: bool = False
+    attention_dropout: float = 0.0
+    mlp_bias: bool = False
+    rope_scaling: dict[str, Any] | None = None
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+
+    # TPU-native knobs
+    scan_layers: bool = True
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "LlamaConfig":
+        if self.num_attention_heads % self.num_key_value_heads != 0:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be divisible "
+                f"by num_key_value_heads ({self.num_key_value_heads})"
+            )
+        if self.attention_dropout != 0.0:
+            # fail loudly rather than silently training without the dropout a
+            # user (or an HF config) asked for
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        self.rope_config  # construct to trigger RoPEConfig validation
+        return self
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def rope_config(self) -> RoPEConfig:
+        scaling = dict(self.rope_scaling) if self.rope_scaling else None
+        rope_type = "default"
+        if scaling:
+            # accept both HF spellings ('rope_type' new, 'type' legacy)
+            for key in ("rope_type", "type"):
+                if key in scaling:
+                    rope_type = scaling.pop(key)
+        return RoPEConfig(
+            type=rope_type,
+            base=self.rope_theta,
+            dim=self.resolved_head_dim,
+            max_position_embeddings=self.max_position_embeddings,
+            scaling=scaling or None,
+        )

@@ -1,0 +1,153 @@
+"""Llama <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `models/hf_compat_model/hf_compat_model.py:96-119`
+(`convert_state_dict_from_hf` / `convert_state_dict_to_hf` / `get_hf_model`)
+for the Llama family. Keys are mapped between HF's
+`model.layers.{i}.self_attn.q_proj.weight` layout and our flax tree
+(`layers/layer/self_attn/q_proj/kernel`, stacked on a leading depth axis when
+`scan_layers` is on). Linear weights transpose (torch stores [out, in];
+flax Dense kernels are [in, out]).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.llama.config import LlamaConfig
+
+# (our in-layer path, hf in-layer name, transpose)
+_LAYER_PARAMS = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+    (("mlp", "gate_proj", "kernel"), "mlp.gate_proj.weight", True),
+    (("mlp", "up_proj", "kernel"), "mlp.up_proj.weight", True),
+    (("mlp", "down_proj", "kernel"), "mlp.down_proj.weight", True),
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+_LAYER_BIAS_PARAMS = [
+    (("self_attn", "q_proj", "bias"), "self_attn.q_proj.bias", False),
+    (("self_attn", "k_proj", "bias"), "self_attn.k_proj.bias", False),
+    (("self_attn", "v_proj", "bias"), "self_attn.v_proj.bias", False),
+    (("self_attn", "o_proj", "bias"), "self_attn.o_proj.bias", False),
+]
+
+
+def _set_path(tree: dict, path: tuple[str, ...], value: Any) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    node[path[-1]] = value
+
+
+def _get_path(tree: Mapping, path: tuple[str, ...]) -> Any:
+    node = tree
+    for key in path:
+        node = node[key]
+    return node
+
+
+def _to_numpy(tensor: Any) -> np.ndarray:
+    if hasattr(tensor, "detach"):  # torch tensor
+        tensor = tensor.detach().to("cpu").float().numpy()
+    return np.asarray(tensor)
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: LlamaConfig
+) -> dict:
+    """HF `model.*` state dict -> flax param tree (unboxed numpy leaves)."""
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    _set_path(params, ("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    _set_path(params, ("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        _set_path(params, ("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    layer_params = list(_LAYER_PARAMS)
+    if config.attention_bias:
+        layer_params += _LAYER_BIAS_PARAMS
+
+    def layer_value(i: int, hf_name: str, transpose: bool) -> np.ndarray:
+        value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+        return value.T if transpose else value
+
+    if config.scan_layers:
+        for path, hf_name, transpose in layer_params:
+            stacked = np.stack(
+                [layer_value(i, hf_name, transpose) for i in range(config.num_hidden_layers)]
+            )
+            _set_path(params, ("layers", "layer") + path, stacked)
+    else:
+        for i in range(config.num_hidden_layers):
+            for path, hf_name, transpose in layer_params:
+                _set_path(params, (f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
+    """flax param tree -> HF `model.*` state dict (numpy values)."""
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)  # strip Partitioned boxes if the tree came from init()
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    layer_params = list(_LAYER_PARAMS)
+    if config.attention_bias:
+        layer_params += _LAYER_BIAS_PARAMS
+
+    for path, hf_name, transpose in layer_params:
+        if config.scan_layers:
+            stacked = np.asarray(_get_path(p, ("layers", "layer") + path))
+            for i in range(config.num_hidden_layers):
+                value = stacked[i]
+                out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        else:
+            for i in range(config.num_hidden_layers):
+                value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+                out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    return out
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
+    """HF LlamaConfig (object or dict) -> our LlamaConfig.
+
+    The reference's `merge_hf_config` (`hf_compat_model.py`) analogue: copy
+    the architecture hparams, leave training-only knobs at defaults.
+    `overrides` win over both (e.g. compute_dtype='float32' for parity tests).
+    """
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return LlamaConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        head_dim=get("head_dim"),
+        max_position_embeddings=get("max_position_embeddings"),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id", 1),
+        eos_token_id=get("eos_token_id", 2),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 10000.0),
+        attention_bias=get("attention_bias", False),
+        attention_dropout=get("attention_dropout", 0.0),
+        mlp_bias=get("mlp_bias", False),
+        rope_scaling=get("rope_scaling"),
+    ), **overrides})

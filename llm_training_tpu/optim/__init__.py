@@ -1,0 +1,14 @@
+"""Optimizers and LR schedules.
+
+Capability parity: reference `optim/` + `lr_schedulers/` — AdamW & friends
+with warmup-composed schedules (`lr_schedulers/warmup.py:7`,
+`{constant,cosine,linear}.py`), grad clipping
+(`optax.clip_by_global_norm` ≙ Lightning's clip + `fsdp2_precision.py:166-169`),
+and master-weight semantics (`optim/master_weight_wrapper.py:10`) expressed
+natively: params and optimizer state live in fp32 while the forward computes
+in bf16, so no wrapper class exists.
+"""
+
+from llm_training_tpu.optim.builder import OptimConfig, build_optimizer, build_lr_schedule
+
+__all__ = ["OptimConfig", "build_optimizer", "build_lr_schedule"]

@@ -1,0 +1,117 @@
+"""optax optimizer/schedule construction from config.
+
+Capability parity: the reference's optimizer config surface
+(`lms/base_lm_config.py:13-43`: optimizer_class/kwargs +
+lr_scheduler_class/kwargs with `num_total_steps` injection,
+`base_lm.py:269-288`) and its three warmup schedules
+(`lr_schedulers/{constant,cosine,linear}.py`).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any
+
+import jax
+import optax
+from pydantic import BaseModel, ConfigDict
+
+logger = logging.getLogger(__name__)
+
+_OPTIMIZERS = {
+    "adamw": optax.adamw,
+    "adam": optax.adam,
+    "sgd": optax.sgd,
+    "adafactor": optax.adafactor,
+    "lion": optax.lion,
+}
+
+_SCHEDULES = ("constant", "cosine", "linear")
+
+
+class OptimConfig(BaseModel):
+    """Mirrors `BaseOptimizerConfig` (`base_lm_config.py`): which optimizer,
+    its kwargs, which warmup schedule, its kwargs, plus grad clipping."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    optimizer: str = "adamw"
+    learning_rate: float = 1e-4
+    optimizer_kwargs: dict[str, Any] = {}
+    lr_scheduler: str | None = "cosine"
+    warmup_steps: int = 0
+    min_lr_ratio: float = 0.0  # cosine/linear floor as a fraction of peak lr
+    lr_scheduler_kwargs: dict[str, Any] = {}
+    grad_clip_norm: float | None = 1.0
+
+
+def build_lr_schedule(config: OptimConfig, num_total_steps: int) -> optax.Schedule:
+    """Warmup composed with an inner schedule (reference `warmup.py:26-34`).
+
+    `num_total_steps` is injected by the trainer, the analogue of
+    `base_lm.py:277-279` feeding `estimated_stepping_batches` to cosine."""
+    peak = config.learning_rate
+    floor = peak * config.min_lr_ratio
+    decay_steps = max(num_total_steps - config.warmup_steps, 1)
+
+    name = config.lr_scheduler or "constant"
+    if name == "constant":
+        inner = optax.constant_schedule(peak)
+    elif name == "cosine":
+        inner = optax.cosine_decay_schedule(
+            peak, decay_steps, alpha=config.min_lr_ratio, **config.lr_scheduler_kwargs
+        )
+    elif name == "linear":
+        inner = optax.linear_schedule(peak, floor, decay_steps, **config.lr_scheduler_kwargs)
+    else:
+        raise ValueError(f"unknown lr_scheduler {name!r}; expected one of {_SCHEDULES}")
+
+    if config.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, peak, config.warmup_steps)
+        return optax.join_schedules([warmup, inner], [config.warmup_steps])
+    return inner
+
+
+def _freeze_mask(params: Any, frozen_patterns: list[str]) -> Any:
+    """True = trainable. Reference regex freezing (`base_lm.py:234-241`)."""
+    regexes = [re.compile(p) for p in frozen_patterns]
+
+    def trainable(path, _) -> bool:
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        frozen = any(r.search(name) for r in regexes)
+        if frozen:
+            logger.info("freezing %s", name)
+        return not frozen
+
+    return jax.tree_util.tree_map_with_path(trainable, params)
+
+
+def build_optimizer(
+    config: OptimConfig,
+    num_total_steps: int,
+    frozen_modules: list[str] | None = None,
+    params_example: Any = None,
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """Full chain: clip -> optimizer(schedule) [-> freeze mask]."""
+    schedule = build_lr_schedule(config, num_total_steps)
+    try:
+        opt_fn = _OPTIMIZERS[config.optimizer]
+    except KeyError:
+        raise ValueError(
+            f"unknown optimizer {config.optimizer!r}; expected one of {sorted(_OPTIMIZERS)}"
+        )
+    chain = []
+    if config.grad_clip_norm is not None:
+        chain.append(optax.clip_by_global_norm(config.grad_clip_norm))
+    chain.append(opt_fn(learning_rate=schedule, **config.optimizer_kwargs))
+    tx = optax.chain(*chain)
+    if frozen_modules:
+        if params_example is None:
+            raise ValueError("params_example required to build the freeze mask")
+        mask = _freeze_mask(params_example, frozen_modules)
+        tx = optax.chain(
+            optax.masked(tx, mask),
+            optax.masked(optax.set_to_zero(), jax.tree.map(lambda t: not t, mask)),
+        )
+    return tx, schedule

@@ -1,0 +1,44 @@
+"""SPMD parallelism layer.
+
+TPU-native replacement for the reference's distributed strategy stack
+(`lightning/strategy/fsdp2/`, `lightning/strategy/deepspeed/`, DTensor TP
+plans and NCCL collectives — SURVEY.md §2.8/§2.9): a single
+`jax.sharding.Mesh` with named axes, logical-axis sharding rules, and GSPMD
+inserting all collectives over ICI/DCN.
+
+Axes:
+  data     — pure data parallelism (replicated params)
+  fsdp     — data parallelism with parameter sharding (ZeRO-3 semantics)
+  tensor   — tensor parallelism (the reference's TP plans) + sequence-
+             parallel activations between blocks (its `SequenceParallel`)
+  sequence — context parallelism over sequence length (ring attention);
+             beyond reference parity, which reached long context via TP+SP
+"""
+
+from llm_training_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    initialize_distributed,
+    DATA_AXIS,
+    FSDP_AXIS,
+    TENSOR_AXIS,
+    SEQUENCE_AXIS,
+)
+from llm_training_tpu.parallel.sharding import (
+    DEFAULT_LOGICAL_AXIS_RULES,
+    logical_to_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "initialize_distributed",
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "TENSOR_AXIS",
+    "SEQUENCE_AXIS",
+    "DEFAULT_LOGICAL_AXIS_RULES",
+    "logical_to_sharding",
+    "shard_pytree",
+]

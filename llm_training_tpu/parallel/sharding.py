@@ -1,0 +1,102 @@
+"""Logical-axis sharding rules: the TP/SP/FSDP "plans" as data.
+
+Capability parity: the reference's per-model DTensor TP plans
+(`llama_model.py:197-244`, `phi3_model.py:212-256`) and FSDP2 plans
+(`llama_model.py:246-268`) become a single table mapping *logical* axis names
+(attached to each parameter by the model) to mesh axes. GSPMD then inserts
+the all-gather/reduce-scatter/all-reduce collectives that FSDP2/DTensor did
+explicitly.
+
+The rule table reproduces the reference plan:
+  embed dim          -> fsdp        (ZeRO-3 parameter sharding)
+  q/k/v + gate/up out -> tensor     (colwise parallel)
+  o/down in          -> tensor      (rowwise parallel)
+  vocab              -> tensor      (vocab-sharded embedding + lm_head)
+  activations: batch -> data+fsdp, sequence -> sequence (context parallel)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from llm_training_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS
+
+# (logical axis name, mesh axis / axes / None=replicated)
+LogicalAxisRules = Sequence[tuple[str, str | Sequence[str] | None]]
+
+DEFAULT_LOGICAL_AXIS_RULES: LogicalAxisRules = (
+    # --- activations
+    ("batch", (DATA_AXIS, FSDP_AXIS)),
+    ("act_seq", SEQUENCE_AXIS),
+    ("act_embed", None),
+    ("act_heads", TENSOR_AXIS),
+    ("act_vocab", TENSOR_AXIS),
+    # --- parameters
+    ("embed", FSDP_AXIS),
+    ("heads", TENSOR_AXIS),
+    ("kv_heads", TENSOR_AXIS),
+    ("mlp", TENSOR_AXIS),
+    ("vocab", TENSOR_AXIS),
+    ("norm", None),
+    ("expert", None),
+)
+
+
+def _rules_dict(rules: LogicalAxisRules) -> dict[str, Any]:
+    seen: dict[str, Any] = {}
+    for name, axes in rules:
+        if name not in seen:  # first match wins, like flax's rule resolution
+            seen[name] = axes
+    return seen
+
+
+def logical_to_spec(
+    logical_axes: Sequence[str | None],
+    rules: LogicalAxisRules = DEFAULT_LOGICAL_AXIS_RULES,
+) -> PartitionSpec:
+    """('embed', 'mlp') -> PartitionSpec('fsdp', 'tensor')."""
+    table = _rules_dict(rules)
+    spec: list[Any] = []
+    used: set[str] = set()
+    for axis in logical_axes:
+        mesh_axes = table.get(axis) if axis is not None else None
+        if mesh_axes is None:
+            spec.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        free = tuple(a for a in mesh_axes if a not in used)
+        used.update(free)
+        if not free:
+            spec.append(None)
+        elif len(free) == 1:
+            spec.append(free[0])
+        else:
+            spec.append(free)
+    return PartitionSpec(*spec)
+
+
+def logical_to_sharding(
+    logical_axes_tree: Any,
+    mesh: Mesh,
+    rules: LogicalAxisRules = DEFAULT_LOGICAL_AXIS_RULES,
+) -> Any:
+    """Map a pytree of logical-axis tuples to NamedShardings on `mesh`."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x),
+    )
+
+
+def shard_pytree(tree: Any, shardings: Any) -> Any:
+    """Place a pytree of arrays onto shardings (host -> device scatter)."""
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+# Activation annotation inside models uses flax's nn.with_logical_constraint
+# (resolved against these same rules via nn.logical_axis_rules in the
+# Trainer) — no separate helper here.

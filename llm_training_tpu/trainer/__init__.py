@@ -1,0 +1,12 @@
+"""Run harness.
+
+Capability parity: reference `src/llm_training/lightning/` — the Lightning
+Trainer + strategies collapse into a single SPMD loop: one jitted train step
+over a named mesh, GSPMD doing what FSDP2Strategy/DeepSpeedStrategy did with
+explicit collectives. Callbacks/loggers/checkpointing attach to this loop.
+"""
+
+from llm_training_tpu.trainer.state import TrainState
+from llm_training_tpu.trainer.trainer import Trainer, TrainerConfig
+
+__all__ = ["TrainState", "Trainer", "TrainerConfig"]

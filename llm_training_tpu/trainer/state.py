@@ -1,0 +1,38 @@
+"""Training state pytree.
+
+The whole of the reference's distributed runtime state (module params,
+optimizer shards, loop counters, persistent metric counters —
+`fsdp2_strategy.py:314-409`, `metrics/consumed_*.py`) is this one pytree;
+sharding it over the mesh IS the distribution strategy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class TrainState:
+    """`step` counts train_step invocations (micro-steps when gradient
+    accumulation is on); the trainer derives optimizer-step numbering.
+    Consumed-sample/token counters live host-side in the Trainer (python
+    ints — no int32 overflow at pre-training scale) and persist via
+    checkpoint metadata, like the reference's meta.pt counters."""
+
+    step: jnp.ndarray             # int32 scalar, micro-steps
+    params: Any                   # fp32 master params (flax tree)
+    opt_state: Any                # optax state (fp32)
+    rng: jax.Array                # objective rng (NEFTune etc.)
+
+    @classmethod
+    def create(cls, params: Any, opt_state: Any, rng: jax.Array) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=rng,
+        )

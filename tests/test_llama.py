@@ -1,0 +1,176 @@
+"""Llama model: shapes, scan/remat invariance, tied embeddings, and logits
+parity against HF transformers' torch implementation on a tiny config."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_training_tpu.models import Llama, LlamaConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    config_from_hf,
+    params_from_hf,
+    params_to_hf,
+)
+
+TINY = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=112,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=64,
+    compute_dtype="float32",
+)
+
+
+def _init_and_run(cfg, ids, **kwargs):
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), ids)
+    return model.apply(params, ids, **kwargs), params
+
+
+def test_forward_shapes_and_dtypes():
+    cfg = LlamaConfig(**TINY)
+    ids = jnp.ones((2, 10), jnp.int32)
+    out, _ = _init_and_run(cfg, ids, return_last_hidden_states=True)
+    assert out.logits.shape == (2, 10, 128)
+    assert out.last_hidden_states.shape == (2, 10, 64)
+
+
+def test_hidden_only_forward():
+    cfg = LlamaConfig(**TINY)
+    ids = jnp.ones((2, 10), jnp.int32)
+    out, _ = _init_and_run(cfg, ids, compute_logits=False, return_last_hidden_states=True)
+    assert out.logits is None
+    assert out.last_hidden_states is not None
+
+
+def test_scan_and_loop_layers_agree():
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 12)))
+    cfg_scan = LlamaConfig(**TINY, scan_layers=True)
+    model_scan = Llama(cfg_scan)
+    params_scan = model_scan.init(jax.random.key(0), ids)
+
+    # restack scanned params into per-layer trees for the loop model
+    hf_sd = params_to_hf(jax.tree.map(lambda x: x, params_scan["params"]), cfg_scan)
+    cfg_loop = LlamaConfig(**TINY, scan_layers=False)
+    params_loop = params_from_hf(hf_sd, cfg_loop)
+
+    out_scan = model_scan.apply(params_scan, ids)
+    out_loop = Llama(cfg_loop).apply(params_loop, ids)
+    np.testing.assert_allclose(out_scan.logits, out_loop.logits, rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("granularity", ["full", "selective"])
+def test_remat_matches_no_remat(granularity):
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 128, (1, 8)))
+    cfg = LlamaConfig(**TINY)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), ids)
+
+    cfg_remat = LlamaConfig(
+        **TINY, enable_gradient_checkpointing=True, recompute_granularity=granularity
+    )
+    model_remat = Llama(cfg_remat)
+
+    def loss(m, p):
+        return m.apply(p, ids).logits.astype(jnp.float32).sum()
+
+    np.testing.assert_allclose(loss(model, params), loss(model_remat, params), rtol=1e-6)
+    g1 = jax.grad(lambda p: loss(model, p))(params)
+    g2 = jax.grad(lambda p: loss(model_remat, p))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5), g1, g2
+    )
+
+
+def test_tied_embeddings():
+    cfg = LlamaConfig(**{**TINY, "tie_word_embeddings": True})
+    ids = jnp.ones((1, 4), jnp.int32)
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), ids)
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    names = [jax.tree_util.keystr(p) for p, _ in flat]
+    assert not any("lm_head" in n for n in names)
+    out = model.apply(params, ids)
+    assert out.logits.shape == (1, 4, 128)
+
+
+def test_packed_forward_matches_separate_docs():
+    """End-to-end (full model) packing parity: one packed row with segment ids
+    == two separate unpadded forwards."""
+    rng = np.random.default_rng(2)
+    cfg = LlamaConfig(**TINY)
+    model = Llama(cfg)
+    doc_a = rng.integers(1, 128, 5)
+    doc_b = rng.integers(1, 128, 7)
+    packed = jnp.asarray(np.concatenate([doc_a, doc_b])[None])
+    segment_ids = jnp.asarray([[1] * 5 + [2] * 7])
+    position_ids = jnp.asarray([list(range(5)) + list(range(7))])
+    params = model.init(jax.random.key(0), packed)
+
+    out = model.apply(params, packed, segment_ids=segment_ids, position_ids=position_ids)
+    out_a = model.apply(params, jnp.asarray(doc_a[None]))
+    out_b = model.apply(params, jnp.asarray(doc_b[None]))
+    np.testing.assert_allclose(out.logits[0, :5], out_a.logits[0], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out.logits[0, 5:], out_b.logits[0], rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- HF parity
+
+
+def _hf_tiny_llama(rope_scaling=None, tie=False):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFLlamaConfig, LlamaForCausalLM
+
+    hf_config = HFLlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=112,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64 if rope_scaling is None else 131072,
+        rope_scaling=rope_scaling,
+        tie_word_embeddings=tie,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    return LlamaForCausalLM(hf_config).eval(), hf_config
+
+
+@pytest.mark.parametrize(
+    "rope_scaling",
+    [
+        None,
+        {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+         "high_freq_factor": 4.0, "original_max_position_embeddings": 8192},
+    ],
+)
+def test_logits_parity_with_hf(rope_scaling):
+    torch = pytest.importorskip("torch")
+    hf_model, hf_config = _hf_tiny_llama(rope_scaling)
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.rope_config.type == ("default" if rope_scaling is None else "llama3")
+
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(3).integers(0, 128, (2, 16))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_round_trip():
+    hf_model, hf_config = _hf_tiny_llama()
+    cfg = config_from_hf(hf_config)
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    back = params_to_hf(params, cfg)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    assert set(back) == set(sd)
+    for key in sd:
+        np.testing.assert_array_equal(back[key], sd[key], err_msg=key)

@@ -1,0 +1,73 @@
+"""Mesh construction + logical sharding rules on the virtual 8-device mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec
+
+from llm_training_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    logical_to_sharding,
+    shard_pytree,
+)
+from llm_training_tpu.parallel.mesh import resolve_axis_sizes
+from llm_training_tpu.parallel.sharding import logical_to_spec
+
+
+def test_auto_factoring(devices):
+    sizes = resolve_axis_sizes(MeshConfig(tensor_parallel_size=2), 8)
+    assert sizes == {"data": 1, "fsdp": 4, "tensor": 2, "sequence": 1}
+
+
+def test_auto_factoring_default_is_pure_fsdp(devices):
+    sizes = resolve_axis_sizes(MeshConfig(), 8)
+    assert sizes == {"data": 1, "fsdp": 8, "tensor": 1, "sequence": 1}
+
+
+def test_factoring_errors():
+    with pytest.raises(ValueError, match="cannot factor"):
+        resolve_axis_sizes(MeshConfig(tensor_parallel_size=3), 8)
+    with pytest.raises(ValueError, match="at most one"):
+        resolve_axis_sizes(MeshConfig(data_parallel_size=-1, fsdp_size=-1), 8)
+    with pytest.raises(ValueError, match="uses 4 devices"):
+        resolve_axis_sizes(
+            MeshConfig(data_parallel_size=2, fsdp_size=2, tensor_parallel_size=1), 8
+        )
+
+
+def test_build_mesh(devices):
+    mesh = build_mesh(MeshConfig(fsdp_size=2, tensor_parallel_size=2, sequence_parallel_size=2))
+    assert mesh.shape == {"data": 1, "fsdp": 2, "tensor": 2, "sequence": 2}
+
+
+def test_logical_to_spec_rules():
+    assert logical_to_spec(("embed", "mlp")) == PartitionSpec("fsdp", "tensor")
+    assert logical_to_spec(("vocab", "embed")) == PartitionSpec("tensor", "fsdp")
+    assert logical_to_spec(("norm",)) == PartitionSpec(None)
+    assert logical_to_spec(("batch", "act_seq", "act_embed")) == PartitionSpec(
+        ("data", "fsdp"), "sequence", None
+    )
+    # an already-used mesh axis is not assigned twice
+    assert logical_to_spec(("heads", "mlp")) == PartitionSpec("tensor", None)
+
+
+def test_shard_pytree_places_shards(devices):
+    mesh = build_mesh(MeshConfig(fsdp_size=4, tensor_parallel_size=2))
+    params = {
+        "w_up": jnp.ones((16, 8)),    # (embed, mlp) -> ('fsdp', 'tensor')
+        "norm": jnp.ones((16,)),      # replicated
+    }
+    axes = {"w_up": ("embed", "mlp"), "norm": ("norm",)}
+    shardings = logical_to_sharding(axes, mesh)
+    sharded = shard_pytree(params, shardings)
+    shard_shapes = {k: v.addressable_shards[0].data.shape for k, v in sharded.items()}
+    assert shard_shapes["w_up"] == (4, 4)   # 16/4 fsdp, 8/2 tensor
+    assert shard_shapes["norm"] == (16,)
+
+    @jax.jit
+    def f(p):
+        return p["w_up"].sum() + p["norm"].sum()
+
+    np.testing.assert_allclose(f(sharded), 16 * 8 + 16)
