@@ -1,0 +1,13 @@
+"""CLI: `llm-training-tpu fit/validate --config x.yaml`.
+
+Capability parity: reference `src/llm_training/cli/` + the LightningCLI
+config system (SURVEY.md §5.6): single YAML with trainer/model/data sections,
+`class_path`/`init_args` subclass selection for any component, dotted
+command-line overrides, `seed_everything`, resolved-config embedding in
+checkpoints.
+"""
+
+from llm_training_tpu.cli.config import instantiate_from_config, load_config
+from llm_training_tpu.cli.main import main
+
+__all__ = ["main", "load_config", "instantiate_from_config"]

@@ -1,0 +1,95 @@
+"""YAML config loading + class_path instantiation.
+
+Capability parity: reference LightningCLI mechanics (`lightning/cli/cli.py`):
+jsonargparse subclass mode (`class_path`/`init_args` nodes — SURVEY.md §5.6)
+and omegaconf-style `${...}` interpolation, re-implemented minimally on
+plain yaml. Every component class `Foo` pairs with a pydantic `FooConfig`;
+instantiation is `Foo(FooConfig(**init_args))`, so validation errors carry
+field paths.
+"""
+
+from __future__ import annotations
+
+import importlib
+import re
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+_INTERP = re.compile(r"\$\{([^}]+)\}")
+
+
+def _resolve_node(root: Any, dotted: str) -> Any:
+    node = root
+    for part in dotted.split("."):
+        node = node[int(part)] if isinstance(node, list) else node[part]
+    return node
+
+
+def _interpolate(value: Any, root: Any) -> Any:
+    if isinstance(value, str):
+        match = _INTERP.fullmatch(value)
+        if match:  # whole-value reference keeps the referenced type
+            return _interpolate(_resolve_node(root, match.group(1)), root)
+        return _INTERP.sub(lambda m: str(_resolve_node(root, m.group(1))), value)
+    if isinstance(value, dict):
+        return {k: _interpolate(v, root) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_interpolate(v, root) for v in value]
+    return value
+
+
+def _parse_override(raw: str) -> tuple[str, Any]:
+    if "=" not in raw:
+        raise ValueError(f"override must be key.path=value, got {raw!r}")
+    key, value = raw.split("=", 1)
+    return key, yaml.safe_load(value)
+
+
+def _apply_override(config: dict, key: str, value: Any) -> None:
+    parts = key.split(".")
+    node = config
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
+def load_config(path: str | Path, overrides: list[str] | None = None) -> dict:
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+    for raw in overrides or []:
+        _apply_override(config, *_parse_override(raw))
+    return _interpolate(config, config)
+
+
+def import_class(class_path: str) -> type:
+    module_name, _, class_name = class_path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"class_path must be fully qualified, got {class_path!r}")
+    return getattr(importlib.import_module(module_name), class_name)
+
+
+def instantiate_from_config(node: dict, default_class: str | None = None) -> Any:
+    """`{class_path: pkg.Foo, init_args: {...}}` -> Foo(FooConfig(**init_args)).
+
+    The reference's jsonargparse subclass mode (`cli.py:42-46`) for our
+    component convention."""
+    if "class_path" not in node and default_class is None:
+        raise ValueError(f"config node needs class_path: {node}")
+    cls = import_class(node.get("class_path", default_class))
+    init_args = node.get("init_args", {})
+    config_cls = _find_config_class(cls)
+    if config_cls is None:
+        return cls(**init_args)
+    return cls(config_cls(**init_args))
+
+
+def _find_config_class(cls: type) -> type | None:
+    module = importlib.import_module(cls.__module__)
+    candidate = getattr(module, cls.__name__ + "Config", None)
+    if candidate is None:
+        # search the class's package __init__ re-exports
+        package = importlib.import_module(cls.__module__.rsplit(".", 1)[0])
+        candidate = getattr(package, cls.__name__ + "Config", None)
+    return candidate

@@ -1,0 +1,88 @@
+"""Console entry: fit / validate.
+
+Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
+(`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
+DataModule -> run, with seed_everything, logging-level control, and the
+resolved config handed to the checkpointer for embedding.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import random
+import sys
+
+import numpy as np
+
+from llm_training_tpu.cli.config import instantiate_from_config, load_config
+
+
+def _seed_everything(seed: int) -> None:
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def _build(config: dict):
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    trainer_node = dict(config.get("trainer", {}))
+    checkpoint_node = trainer_node.pop("checkpoint", None)
+    callbacks_node = trainer_node.pop("callbacks", [])
+    loggers_node = trainer_node.pop("loggers", [])
+
+    checkpointer = None
+    if checkpoint_node:
+        checkpointer = Checkpointer(
+            CheckpointConfig(**checkpoint_node), run_config=config
+        )
+
+    callbacks = [instantiate_from_config(node) for node in callbacks_node]
+    callbacks += [instantiate_from_config(node) for node in loggers_node]
+
+    trainer = Trainer(
+        TrainerConfig(**trainer_node), callbacks=callbacks, checkpointer=checkpointer
+    )
+    objective = instantiate_from_config(
+        config["model"], default_class="llm_training_tpu.lms.CLM"
+    )
+    datamodule = instantiate_from_config(config["data"])
+    return trainer, objective, datamodule
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="llm-training-tpu")
+    parser.add_argument("command", choices=["fit", "validate"])
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--ckpt-path", default=None, help="checkpoint dir/step to resume")
+    parser.add_argument(
+        "overrides", nargs="*", help="dotted config overrides: trainer.max_steps=100"
+    )
+    args = parser.parse_args(argv)
+
+    config = load_config(args.config, args.overrides)
+    logging.basicConfig(
+        level=getattr(logging, str(config.get("logging_level", "INFO")).upper()),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stdout,
+    )
+    _seed_everything(int(config.get("seed_everything", 42)))
+
+    # multi-host rendezvous must precede any jax use
+    from llm_training_tpu.parallel import initialize_distributed
+
+    initialize_distributed()
+
+    trainer, objective, datamodule = _build(config)
+
+    resume_step = int(args.ckpt_path) if args.ckpt_path else None
+    if args.command == "fit":
+        trainer.fit(objective, datamodule, resume_step=resume_step)
+    else:
+        trainer.validate_from_checkpoint(objective, datamodule, resume_step=resume_step)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,9 +91,11 @@ def build_optimizer(
     config: OptimConfig,
     num_total_steps: int,
     frozen_modules: list[str] | None = None,
-    params_example: Any = None,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
-    """Full chain: clip -> optimizer(schedule) [-> freeze mask]."""
+    """Full chain: clip -> optimizer(schedule) [-> freeze mask].
+
+    The freeze mask is a *callable* so it adapts to whatever tree structure
+    (flax-boxed or plain) the transformation is applied to."""
     schedule = build_lr_schedule(config, num_total_steps)
     try:
         opt_fn = _OPTIMIZERS[config.optimizer]
@@ -107,11 +109,12 @@ def build_optimizer(
     chain.append(opt_fn(learning_rate=schedule, **config.optimizer_kwargs))
     tx = optax.chain(*chain)
     if frozen_modules:
-        if params_example is None:
-            raise ValueError("params_example required to build the freeze mask")
-        mask = _freeze_mask(params_example, frozen_modules)
+        patterns = list(frozen_modules)
         tx = optax.chain(
-            optax.masked(tx, mask),
-            optax.masked(optax.set_to_zero(), jax.tree.map(lambda t: not t, mask)),
+            optax.masked(tx, lambda tree: _freeze_mask(tree, patterns)),
+            optax.masked(
+                optax.set_to_zero(),
+                lambda tree: jax.tree.map(lambda t: not t, _freeze_mask(tree, patterns)),
+            ),
         )
     return tx, schedule

@@ -86,6 +86,8 @@ class Trainer:
 
         def make_state(rng):
             params = objective.init_params(rng, sample_batch)
+            # zeros_like maps through the Partitioned boxes, so the abstract
+            # opt_state (mu/nu) carries the same sharding annotations as params
             opt_state = tx.init(params)
             return TrainState.create(params, opt_state, jax.random.key(1))
 
@@ -159,13 +161,6 @@ class Trainer:
             objective.config.optim,
             num_total_steps=cfg.max_steps,
             frozen_modules=objective.config.frozen_modules or None,
-            params_example=(
-                jax.eval_shape(
-                    lambda: objective.init_params(jax.random.key(0), sample_batch)
-                )
-                if objective.config.frozen_modules
-                else None
-            ),
         )
         if cfg.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(tx, cfg.accumulate_grad_batches)
@@ -179,8 +174,11 @@ class Trainer:
                 f"data*fsdp mesh ways ({dp_ways})"
             )
 
-        abstract_state = self._abstract_state(objective, sample_batch, tx)
-        self.state_shardings = self._state_shardings(abstract_state)
+        # the boxed (Partitioned-annotated) abstract tree exists only to
+        # derive shardings; the canonical runtime state is unboxed
+        abstract_boxed = self._abstract_state(objective, sample_batch, tx)
+        self.state_shardings = self._state_shardings(abstract_boxed)
+        abstract_state = nn.meta.unbox(abstract_boxed)
         batch_shardings = _batch_shardings(sample_batch, self.mesh)
 
         # restore or initialize, directly into sharded buffers
@@ -197,8 +195,8 @@ class Trainer:
             def make_state(rng):
                 params = objective.init_params(rng, sample_batch)
                 opt_state = tx.init(params)
-                return TrainState.create(
-                    params, opt_state, jax.random.key(cfg.seed + 1)
+                return nn.meta.unbox(
+                    TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1))
                 )
 
             state = jax.jit(make_state, out_shardings=self.state_shardings)(
@@ -295,6 +293,51 @@ class Trainer:
                     cb.on_validation_end(self, step, {"val_loss": val_loss})
 
     # ------------------------------------------------------------ validate
+
+    def validate_from_checkpoint(
+        self, objective, datamodule, resume_step: int | None = None
+    ) -> dict[str, float]:
+        """Restore the latest (or given) checkpoint and run validation
+        (the CLI `validate` subcommand, reference `llm-training validate`)."""
+        if self.checkpointer is None:
+            raise ValueError("validate_from_checkpoint requires a checkpointer")
+        cfg = self.config
+        self.mesh = build_mesh(cfg.mesh)
+        datamodule.setup()
+        with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+            sample_batch = next(datamodule.train_batches())
+            tx, _ = build_optimizer(
+                objective.config.optim,
+                num_total_steps=cfg.max_steps,
+                frozen_modules=objective.config.frozen_modules or None,
+            )
+            if cfg.accumulate_grad_batches > 1:
+                tx = optax.MultiSteps(tx, cfg.accumulate_grad_batches)
+            abstract_boxed = self._abstract_state(objective, sample_batch, tx)
+            self.state_shardings = self._state_shardings(abstract_boxed)
+            abstract_state = nn.meta.unbox(abstract_boxed)
+            restored = self.checkpointer.maybe_restore(
+                abstract_state, self.state_shardings, resume_step
+            )
+            if restored is None:
+                raise ValueError(f"no checkpoint found in {self.checkpointer.directory}")
+            state, _ = restored
+            eval_step = jax.jit(
+                self._build_eval_step(objective),
+                in_shardings=(self.state_shardings, _batch_shardings(sample_batch, self.mesh)),
+            )
+            losses, weights = [], []
+            for i, batch in enumerate(datamodule.val_batches()):
+                if cfg.limit_val_batches and i >= cfg.limit_val_batches:
+                    break
+                out = jax.device_get(eval_step(state, batch))
+                losses.append(out["loss"])
+                weights.append(out["target_tokens"])
+        if not losses:
+            raise ValueError("datamodule produced no validation batches")
+        result = {"val_loss": float(np.average(losses, weights=weights))}
+        logger.info("validate: %s", result)
+        return result
 
     def validate(self, objective, datamodule, state: TrainState) -> dict[str, float]:
         datamodule.setup()

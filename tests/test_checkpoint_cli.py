@@ -1,0 +1,184 @@
+"""Checkpoint save/restore/resume determinism + CLI fit/validate."""
+
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+from llm_training_tpu.optim import OptimConfig
+from llm_training_tpu.trainer import Trainer, TrainerConfig
+from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+TINY_MODEL = dict(
+    model_class="llm_training_tpu.models.Llama",
+    model_kwargs=dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, compute_dtype="float32",
+    ),
+)
+
+
+def _objective():
+    # constant schedule: the cosine schedule depends on num_total_steps, so a
+    # 5-step and a 10-step run would legitimately differ at steps 1-5
+    return CLM(
+        CLMConfig(
+            model=ModelProvider(**TINY_MODEL),
+            optim=OptimConfig(learning_rate=1e-3, warmup_steps=2, lr_scheduler="constant"),
+        )
+    )
+
+
+def _data():
+    return DummyDataModule(
+        DummyDataModuleConfig(batch_size=8, max_length=64, num_samples=64,
+                              vocab_size=256, validation_split=8)
+    )
+
+
+class _Rec:
+    def __init__(self):
+        self.losses = {}
+
+    def on_step_end(self, trainer, step, metrics):
+        self.losses[step] = float(metrics["loss"])
+
+
+def test_save_resume_matches_uninterrupted(devices, tmp_path):
+    # straight 10-step run
+    rec_full = _Rec()
+    trainer = Trainer(
+        TrainerConfig(max_steps=10, log_every_n_steps=1),
+        callbacks=[rec_full],
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=str(tmp_path / "full"), async_save=False)
+        ),
+    )
+    trainer.fit(_objective(), _data())
+    full_counters = dict(trainer.counters)
+
+    # interrupted at 5 + resumed
+    rec_a = _Rec()
+    ckpt_dir = str(tmp_path / "resume")
+    t1 = Trainer(
+        TrainerConfig(max_steps=5, log_every_n_steps=1, checkpoint_every_n_steps=5),
+        callbacks=[rec_a],
+        checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir, async_save=False)),
+    )
+    t1.fit(_objective(), _data())
+
+    rec_b = _Rec()
+    t2 = Trainer(
+        TrainerConfig(max_steps=10, log_every_n_steps=1),
+        callbacks=[rec_b],
+        checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir, async_save=False)),
+    )
+    t2.fit(_objective(), _data())
+
+    # steps 6..10 of the resumed run match the uninterrupted run exactly
+    for step in range(6, 11):
+        np.testing.assert_allclose(
+            rec_b.losses[step], rec_full.losses[step], rtol=1e-6,
+            err_msg=f"step {step}",
+        )
+    assert t2.counters == full_counters
+
+
+def test_validate_from_checkpoint(devices, tmp_path):
+    ckpt_dir = str(tmp_path / "v")
+    trainer = Trainer(
+        TrainerConfig(max_steps=3, log_every_n_steps=1),
+        checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir, async_save=False)),
+    )
+    trainer.fit(_objective(), _data())
+
+    t2 = Trainer(
+        TrainerConfig(max_steps=3),
+        checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir, async_save=False)),
+    )
+    result = t2.validate_from_checkpoint(_objective(), _data())
+    assert np.isfinite(result["val_loss"])
+
+
+def test_checkpoint_embeds_config(devices, tmp_path):
+    ckpt_dir = str(tmp_path / "c")
+    run_config = {"model": {"class_path": "llm_training_tpu.lms.CLM"}, "note": "hi"}
+    trainer = Trainer(
+        TrainerConfig(max_steps=2, checkpoint_every_n_steps=2),
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=ckpt_dir, async_save=False), run_config=run_config
+        ),
+    )
+    trainer.fit(_objective(), _data())
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(ckpt_dir, item_names=("state", "meta")) as m:
+        meta = m.restore(m.latest_step(), args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+    assert meta["meta"]["config"] == run_config
+    assert meta["meta"]["counters"]["consumed_samples"] == 2 * 8
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def _write_config(tmp_path, **extra):
+    config = {
+        "seed_everything": 7,
+        "trainer": {
+            "max_steps": 3,
+            "log_every_n_steps": 1,
+            "checkpoint": {"dirpath": str(tmp_path / "ckpt"), "async_save": False},
+        },
+        "model": {
+            "class_path": "llm_training_tpu.lms.CLM",
+            "init_args": {
+                "model": TINY_MODEL,
+                "optim": {"learning_rate": 1e-3},
+            },
+        },
+        "data": {
+            "class_path": "llm_training_tpu.data.DummyDataModule",
+            "init_args": {
+                "batch_size": 8, "max_length": 64, "num_samples": 32,
+                "vocab_size": 256, "validation_split": 8,
+            },
+        },
+        **extra,
+    }
+    path = tmp_path / "config.yaml"
+    path.write_text(yaml.safe_dump(config))
+    return path
+
+
+def test_cli_fit_and_validate(devices, tmp_path, capsys):
+    from llm_training_tpu.cli.main import main
+
+    config_path = _write_config(tmp_path)
+    assert main(["fit", "--config", str(config_path)]) == 0
+    assert (tmp_path / "ckpt").exists()
+    assert main(["validate", "--config", str(config_path)]) == 0
+
+
+def test_cli_overrides(tmp_path):
+    from llm_training_tpu.cli.config import load_config
+
+    config_path = _write_config(tmp_path)
+    config = load_config(str(config_path), ["trainer.max_steps=7", "seed_everything=1"])
+    assert config["trainer"]["max_steps"] == 7
+    assert config["seed_everything"] == 1
+
+
+def test_config_interpolation(tmp_path):
+    from llm_training_tpu.cli.config import load_config
+
+    path = tmp_path / "i.yaml"
+    path.write_text(yaml.safe_dump({
+        "base": {"vocab": 256},
+        "model": {"vocab_size": "${base.vocab}", "name": "v${base.vocab}-model"},
+    }))
+    config = load_config(str(path))
+    assert config["model"]["vocab_size"] == 256      # type-preserving
+    assert config["model"]["name"] == "v256-model"   # string substitution
