@@ -13,10 +13,31 @@ Batches are numpy dicts with `input_ids`, `labels`, `position_ids` and
 
 from llm_training_tpu.data.base import BaseDataModule, BaseDataModuleConfig
 from llm_training_tpu.data.dummy import DummyDataModule, DummyDataModuleConfig
+from llm_training_tpu.data.hf_based import HFBasedDataModule, HFBasedDataModuleConfig
+from llm_training_tpu.data.pre_training import (
+    PreTrainingDataModule,
+    PreTrainingDataModuleConfig,
+)
+from llm_training_tpu.data.instruction_tuning import (
+    InstructionTuningDataModule,
+    InstructionTuningDataModuleConfig,
+)
+from llm_training_tpu.data.preference_tuning import (
+    PreferenceTuningDataModule,
+    PreferenceTuningDataModuleConfig,
+)
 
 __all__ = [
     "BaseDataModule",
     "BaseDataModuleConfig",
     "DummyDataModule",
     "DummyDataModuleConfig",
+    "HFBasedDataModule",
+    "HFBasedDataModuleConfig",
+    "PreTrainingDataModule",
+    "PreTrainingDataModuleConfig",
+    "InstructionTuningDataModule",
+    "InstructionTuningDataModuleConfig",
+    "PreferenceTuningDataModule",
+    "PreferenceTuningDataModuleConfig",
 ]

@@ -1,0 +1,31 @@
+"""Chat templates with `{% generation %}` assistant-token masks.
+
+Capability parity: reference `data/chat_templates/` — 9 Jinja2 templates
+whose `{% generation %}` tags let `tokenizer.apply_chat_template(...,
+return_assistant_tokens_mask=True)` produce exact assistant-token masks.
+Written from the public formats of each model family. Loader resolves
+name → packaged file → literal template string
+(reference `chat_templates/__init__.py:24-37`).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+_TEMPLATE_DIR = Path(__file__).parent
+
+
+def available_chat_templates() -> list[str]:
+    return sorted(p.stem for p in _TEMPLATE_DIR.glob("*.j2"))
+
+
+def get_chat_template(name_or_template: str) -> str:
+    path = _TEMPLATE_DIR / f"{name_or_template}.j2"
+    if path.exists():
+        return path.read_text()
+    if "{" in name_or_template:  # literal jinja template
+        return name_or_template
+    raise ValueError(
+        f"unknown chat template {name_or_template!r}; "
+        f"available: {available_chat_templates()}"
+    )

@@ -1,0 +1,154 @@
+"""HF-datasets-backed pipeline with fingerprint-stable caching.
+
+Capability parity: reference `data/hf_based/hf_based_datamodule.py:26-240` —
+`datasets.load_dataset` wrapper, seed-42 train/val split, save/load of
+pre-processed data, deterministic `.map` fingerprinting that includes a
+tokenizer-content hash (so cache hits survive process restarts,
+`hash_tokenizer` `:89-97`), and cache enable/disable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import logging
+from pathlib import Path
+from typing import Any, Callable
+
+import datasets
+from datasets import Dataset, DatasetDict
+from datasets.fingerprint import Hasher
+
+from llm_training_tpu.data.base import BaseDataModule, BaseDataModuleConfig
+
+logger = logging.getLogger(__name__)
+
+
+def hash_tokenizer(tokenizer: Any) -> str:
+    """Content hash of a tokenizer (vocab + config), stable across processes
+    (reference `hf_based_datamodule.py:89-97` hashes the backing files)."""
+    h = hashlib.sha256()
+    h.update(str(type(tokenizer)).encode())
+    if hasattr(tokenizer, "_tokenizer"):  # fast tokenizer: serialized state
+        h.update(tokenizer._tokenizer.to_str().encode())
+    else:
+        h.update(repr(sorted(tokenizer.get_vocab().items())).encode())
+    h.update(repr(sorted((tokenizer.special_tokens_map or {}).items())).encode())
+    return h.hexdigest()
+
+
+class HFBasedDataModuleConfig(BaseDataModuleConfig):
+    dataset_kwargs: dict | None = None
+    num_proc: int | None = None
+    enable_cache: bool = True
+    cleanup_cache_files: bool = False
+    pre_processed_data_path: str | None = None
+
+
+class HFBasedDataModule(BaseDataModule):
+    config: HFBasedDataModuleConfig
+
+    # ------------------------------------------------------------ pipeline
+
+    def load_data(self) -> DatasetDict:
+        kwargs = self.config.dataset_kwargs or {}
+        dataset = datasets.load_dataset(**kwargs)
+        if isinstance(dataset, Dataset):
+            dataset = DatasetDict(train=dataset)
+        return dataset
+
+    def pre_process_data(self, dataset_dict: DatasetDict) -> DatasetDict:
+        return dataset_dict
+
+    def post_process_data(self, dataset_dict: DatasetDict) -> DatasetDict:
+        return dataset_dict
+
+    def split_data(self, dataset_dict: DatasetDict) -> DatasetDict:
+        """seed-42 train/validation split (reference `:55-59`)."""
+        split = self.config.validation_split
+        if split and "validation" not in dataset_dict:
+            train = dataset_dict["train"]
+            n_val = int(split) if split >= 1 else max(1, int(len(train) * split))
+            parts = train.train_test_split(test_size=n_val, seed=42)
+            dataset_dict = DatasetDict(
+                {**dataset_dict, "train": parts["train"], "validation": parts["test"]}
+            )
+        return dataset_dict
+
+    def setup(self) -> None:
+        path = self.config.pre_processed_data_path
+        if path and Path(path).exists():
+            logger.info("loading pre-processed data from %s", path)
+            dataset_dict = datasets.load_from_disk(path)
+        else:
+            dataset_dict = self.load_data()
+            if self.config.cleanup_cache_files:
+                # before any processing (reference hf_based_datamodule.py:49-50)
+                # so we never delete cache files backing the datasets we
+                # are about to create
+                dataset_dict.cleanup_cache_files()
+            dataset_dict = self.pre_process_data(dataset_dict)
+        self.pre_processed_dataset_dict = dataset_dict
+        dataset_dict = self.split_data(dataset_dict)
+        dataset_dict = self.post_process_data(dataset_dict)
+        self.dataset_dict = dataset_dict
+        self.train_dataset = dataset_dict.get("train")
+        self.val_dataset = dataset_dict.get("validation")
+
+    def save_pre_processed_data(self, path: str | None = None) -> None:
+        path = path or self.config.pre_processed_data_path
+        if path is None:
+            raise ValueError("pre_processed_data_path is required")
+        self.pre_processed_dataset_dict.save_to_disk(path)
+        logger.info("saved pre-processed data to %s", path)
+
+    # ------------------------------------------------------------ mapping
+
+    def map_dataset_dict(
+        self,
+        dataset_dict: DatasetDict,
+        function: Callable,
+        fn_kwargs: dict[str, Any] | None = None,
+        remove_columns: bool | list[str] = False,
+        **map_kwargs: Any,
+    ) -> DatasetDict:
+        """`.map` with a deterministic fingerprint: function source +
+        hashable kwargs (tokenizers hashed by content), so the datasets cache
+        hits across process restarts (reference `map_dataset` `:107-176`)."""
+        fn_kwargs = fn_kwargs or {}
+        # hash the function's WHOLE module source: helpers called by the map
+        # function live beside it, and an edit to any of them must invalidate
+        # the cache (hashing only the function's own source would miss them)
+        hash_parts = [
+            function.__qualname__,
+            inspect.getsource(inspect.getmodule(function)),
+        ]
+        for key in sorted(fn_kwargs):
+            value = fn_kwargs[key]
+            if hasattr(value, "get_vocab"):
+                hash_parts.append(f"{key}=tokenizer:{hash_tokenizer(value)}")
+            else:
+                hash_parts.append(f"{key}={Hasher.hash(value)}")
+
+        out = {}
+        for name, dataset in dataset_dict.items():
+            if remove_columns is True:
+                map_kwargs["remove_columns"] = dataset.column_names
+            elif remove_columns:
+                map_kwargs["remove_columns"] = remove_columns
+            # per-dataset: includes the resolved remove_columns list
+            kwargs_hash = Hasher.hash(
+                {k: v for k, v in sorted(map_kwargs.items()) if k != "desc"}
+            )
+            fingerprint = Hasher.hash([dataset._fingerprint, kwargs_hash] + hash_parts)
+            if not self.config.enable_cache:
+                fingerprint = None
+            out[name] = dataset.map(
+                function,
+                fn_kwargs=fn_kwargs,
+                num_proc=self.config.num_proc if len(dataset) > 1 else None,
+                new_fingerprint=fingerprint,
+                load_from_cache_file=self.config.enable_cache,
+                **map_kwargs,
+            )
+        return DatasetDict(out)

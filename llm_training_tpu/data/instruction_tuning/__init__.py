@@ -1,0 +1,15 @@
+from llm_training_tpu.data.instruction_tuning.datamodule import (
+    InstructionTuningDataModule,
+    InstructionTuningDataModuleConfig,
+    OverlongHandlingMethod,
+    PackingMethod,
+)
+from llm_training_tpu.data.instruction_tuning.collator import InstructionTuningDataCollator
+
+__all__ = [
+    "InstructionTuningDataModule",
+    "InstructionTuningDataModuleConfig",
+    "InstructionTuningDataCollator",
+    "OverlongHandlingMethod",
+    "PackingMethod",
+]

@@ -1,0 +1,60 @@
+"""Instruction-tuning collator.
+
+Capability parity: reference
+`data/instruction_tuning/instruction_tuning_datacollator.py:12-72`:
+packing-aware padding where position_ids restart at 0 for each packed
+document (`:45-55`) and per-document segment ids are preserved. Labels come
+pre-masked (-100 outside assistant tokens) from the datamodule.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class InstructionTuningDataCollator:
+    def __init__(self, config: Any, padding_side: str = "right"):
+        self.config = config
+        tokenizer = config.tokenizer
+        if tokenizer.pad_token_id is None:
+            raise ValueError("tokenizer needs a pad token")
+        self.pad_token_id = tokenizer.pad_token_id
+        self.padding_side = padding_side
+
+    def _padded_len(self, longest: int) -> int:
+        multiple = self.config.pad_to_multiple_of
+        if multiple:
+            return -(-longest // multiple) * multiple
+        return longest
+
+    def __call__(self, examples: list[dict]) -> dict[str, np.ndarray]:
+        width = self._padded_len(max(len(e["input_ids"]) for e in examples))
+        batch = len(examples)
+
+        input_ids = np.full((batch, width), self.pad_token_id, np.int32)
+        labels = np.full((batch, width), -100, np.int32)
+        segment_ids = np.zeros((batch, width), np.int32)
+        position_ids = np.zeros((batch, width), np.int32)
+
+        for row, example in enumerate(examples):
+            n = len(example["input_ids"])
+            sl = slice(0, n) if self.padding_side == "right" else slice(width - n, width)
+            input_ids[row, sl] = example["input_ids"]
+            labels[row, sl] = example["labels"]
+            segs = np.asarray(example["segment_ids"], np.int32)
+            segment_ids[row, sl] = segs
+            # positions restart at each packed document boundary
+            positions = np.arange(n, dtype=np.int32)
+            for seg in np.unique(segs):
+                mask = segs == seg
+                positions[mask] -= positions[mask][0]
+            position_ids[row, sl] = positions
+
+        return {
+            "input_ids": input_ids,
+            "labels": labels,
+            "segment_ids": segment_ids,
+            "position_ids": position_ids,
+        }

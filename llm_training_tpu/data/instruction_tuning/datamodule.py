@@ -1,0 +1,217 @@
+"""Instruction tuning data: chat template → assistant-mask labels → pack.
+
+Capability parity: reference
+`data/instruction_tuning/instruction_tuning_datamodule.py:17-202`:
+- chat-template application with `{% generation %}` assistant masks →
+  labels (`:31-78`); requires tokenizers >= 0.20.1 (`:24-28`)
+- seeded random default-system-prompt injection (`:47-55`)
+- drop-or-truncate overlong handling (`:80-100`)
+- GROUP_BY_LENGTH packing: length-sorted best-fit grouping with per-document
+  segment ids; documents never span rows (`:102-145`)
+
+Expected example format: `{"messages": [{"role": ..., "content": ...}, ...]}`.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from enum import Enum
+from typing import Any
+
+import tokenizers
+from datasets import DatasetDict, Features, Sequence, Value
+from packaging.version import Version
+from pydantic import ConfigDict, field_validator, model_validator
+
+from llm_training_tpu.data.chat_templates import get_chat_template
+from llm_training_tpu.data.hf_based import HFBasedDataModule, HFBasedDataModuleConfig
+from llm_training_tpu.data.instruction_tuning.collator import InstructionTuningDataCollator
+from llm_training_tpu.data.pre_training.datamodule import best_fit_bin_packing
+from llm_training_tpu.data.tokenizer import resolve_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class OverlongHandlingMethod(str, Enum):
+    DROP = "drop"
+    TRUNCATE = "truncate"
+
+
+class PackingMethod(str, Enum):
+    NO_PACKING = "no_packing"
+    GROUP_BY_LENGTH = "group_by_length"
+
+
+class InstructionTuningDataModuleConfig(HFBasedDataModuleConfig):
+    model_config = ConfigDict(extra="forbid", arbitrary_types_allowed=True)
+
+    tokenizer: Any
+    chat_template: str | None = None
+    max_length: int | None = None
+    overlong_handling_method: OverlongHandlingMethod = OverlongHandlingMethod.DROP
+    packing_method: PackingMethod = PackingMethod.NO_PACKING
+    pad_to_multiple_of: int | None = None
+    add_default_system_prompt_rate: float | None = None
+    default_system_prompt: str | None = None
+
+    @field_validator("tokenizer")
+    @classmethod
+    def _resolve_tokenizer(cls, value: Any) -> Any:
+        return resolve_tokenizer(value)
+
+    @field_validator("chat_template")
+    @classmethod
+    def _resolve_template(cls, value: str | None) -> str | None:
+        return get_chat_template(value) if value is not None else None
+
+    @model_validator(mode="after")
+    def _validate(self) -> "InstructionTuningDataModuleConfig":
+        if Version(tokenizers.__version__) < Version("0.20.1"):
+            # reference gate `:24-28`: older tokenizers mis-mask llama-3 prompts
+            raise RuntimeError("tokenizers >= 0.20.1 required for assistant masks")
+        if self.default_system_prompt and self.add_default_system_prompt_rate is None:
+            raise ValueError(
+                "add_default_system_prompt_rate is required with default_system_prompt"
+            )
+        if self.packing_method == PackingMethod.GROUP_BY_LENGTH and self.max_length is None:
+            raise ValueError("max_length is required for group_by_length packing")
+        return self
+
+
+def _apply_template_and_tokenize(
+    batch: dict[str, list],
+    indices: list[int],
+    tokenizer: Any,
+    chat_template: str | None,
+    default_system_prompt: str | None,
+    add_rate: float | None,
+    seed: int,
+) -> dict[str, list]:
+    conversations = []
+    for idx, messages in zip(indices, batch["messages"]):
+        messages = list(messages)
+        has_system = any(m["role"] == "system" for m in messages)
+        if default_system_prompt and not has_system:
+            # per-example seeded draw: stable across runs and num_proc shards
+            if random.Random(f"{seed}-{idx}").random() < add_rate:
+                messages.insert(0, {"role": "system", "content": default_system_prompt})
+        conversations.append(messages)
+
+    encoded = tokenizer.apply_chat_template(
+        conversations,
+        chat_template=chat_template,
+        return_dict=True,
+        return_assistant_tokens_mask=True,
+        tokenizer_kwargs=dict(return_attention_mask=False, verbose=False),
+    )
+    out = {"input_ids": [], "labels": [], "length": []}
+    for input_ids, mask in zip(encoded["input_ids"], encoded["assistant_masks"]):
+        out["input_ids"].append(input_ids)
+        out["labels"].append(
+            [t if m == 1 else -100 for t, m in zip(input_ids, mask)]
+        )
+        out["length"].append(len(input_ids))
+    return out
+
+
+def _handle_overlong(batch: dict[str, list], max_length: int, method: str) -> dict[str, list]:
+    if method == OverlongHandlingMethod.DROP:
+        keep = [i for i, n in enumerate(batch["length"]) if n <= max_length]
+        return {k: [v[i] for i in keep] for k, v in batch.items()}
+    return {
+        "input_ids": [ids[:max_length] for ids in batch["input_ids"]],
+        "labels": [l[:max_length] for l in batch["labels"]],
+        "length": [min(n, max_length) for n in batch["length"]],
+    }
+
+
+def _group_by_length_packing(batch: dict[str, list], max_length: int) -> dict[str, list]:
+    indices = sorted(range(len(batch["length"])), key=batch["length"].__getitem__, reverse=True)
+    lengths = [batch["length"][i] for i in indices]
+    out = {"input_ids": [], "labels": [], "segment_ids": [], "length": []}
+    for group in best_fit_bin_packing(max_length, lengths):
+        ids: list[int] = []
+        labels: list[int] = []
+        segs: list[int] = []
+        for doc_num, local in enumerate(group, start=1):
+            example = indices[local]
+            ids += batch["input_ids"][example]
+            labels += batch["labels"][example]
+            segs += [doc_num] * batch["length"][example]
+        out["input_ids"].append(ids)
+        out["labels"].append(labels)
+        out["segment_ids"].append(segs)
+        out["length"].append(len(ids))
+    return out
+
+
+def _add_trivial_segments(batch: dict[str, list]) -> dict[str, list]:
+    return {**batch, "segment_ids": [[1] * n for n in batch["length"]]}
+
+
+class InstructionTuningDataModule(HFBasedDataModule):
+    config: InstructionTuningDataModuleConfig
+
+    def __init__(self, config: InstructionTuningDataModuleConfig):
+        super().__init__(config)
+        self.collator = InstructionTuningDataCollator(config)
+
+    def pre_process_data(self, dataset_dict: DatasetDict) -> DatasetDict:
+        cfg = self.config
+        dataset_dict = self.map_dataset_dict(
+            dataset_dict,
+            _apply_template_and_tokenize,
+            fn_kwargs=dict(
+                tokenizer=cfg.tokenizer,
+                chat_template=cfg.chat_template,
+                default_system_prompt=cfg.default_system_prompt,
+                add_rate=cfg.add_default_system_prompt_rate,
+                seed=cfg.seed,
+            ),
+            batched=True,
+            with_indices=True,
+            remove_columns=True,
+            desc="Applying chat template",
+        )
+        if cfg.max_length is not None:
+            dataset_dict = self.map_dataset_dict(
+                dataset_dict,
+                _handle_overlong,
+                fn_kwargs=dict(
+                    max_length=cfg.max_length,
+                    method=cfg.overlong_handling_method.value,
+                ),
+                batched=True,
+                desc="Handling overlong examples",
+            )
+        packer = (
+            _group_by_length_packing
+            if cfg.packing_method == PackingMethod.GROUP_BY_LENGTH
+            else _add_trivial_segments
+        )
+        dataset_dict = self.map_dataset_dict(
+            dataset_dict,
+            packer,
+            fn_kwargs=(
+                dict(max_length=cfg.max_length)
+                if cfg.packing_method == PackingMethod.GROUP_BY_LENGTH
+                else {}
+            ),
+            batched=True,
+            batch_size=10000,
+            remove_columns=True,
+            features=Features(
+                {
+                    "input_ids": Sequence(Value("int32")),
+                    "labels": Sequence(Value("int32")),
+                    "segment_ids": Sequence(Value("uint16")),
+                    "length": Value("uint32"),
+                }
+            ),
+            desc="Packing",
+        )
+        return dataset_dict
+
+    def collate(self, examples: list[dict]) -> dict:
+        return self.collator(examples)

@@ -1,0 +1,13 @@
+from llm_training_tpu.data.pre_training.datamodule import (
+    PackingMethod,
+    PreTrainingDataModule,
+    PreTrainingDataModuleConfig,
+)
+from llm_training_tpu.data.pre_training.collator import PreTrainingDataCollator
+
+__all__ = [
+    "PackingMethod",
+    "PreTrainingDataModule",
+    "PreTrainingDataModuleConfig",
+    "PreTrainingDataCollator",
+]

@@ -1,0 +1,65 @@
+"""Pre-training collator.
+
+Capability parity: reference
+`data/pre_training/pre_training_datacollator.py:9-46`: pad-to-longest with
+`pad_to_multiple_of`, configurable side, labels masking BOS and padding, one
+shared position_ids row (positions run across packed documents, as the
+reference does for pre-training; instruction tuning restarts them per doc).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class PreTrainingDataCollator:
+    def __init__(self, config: Any, padding_side: str = "right"):
+        self.config = config
+        self.padding_side = padding_side
+        tokenizer = config.tokenizer
+        if tokenizer.pad_token_id is None:
+            raise ValueError(
+                "tokenizer needs a pad token (reference asserts the same, "
+                "pre_training_datacollator.py:19)"
+            )
+        self.pad_token_id = tokenizer.pad_token_id
+        self.bos_token_id = tokenizer.bos_token_id
+
+    def _padded_len(self, longest: int) -> int:
+        multiple = self.config.pad_to_multiple_of
+        if multiple:
+            return -(-longest // multiple) * multiple
+        return longest
+
+    def __call__(self, examples: list[dict]) -> dict[str, np.ndarray]:
+        lengths = [len(e["input_ids"]) for e in examples]
+        width = self._padded_len(max(lengths))
+        batch = len(examples)
+
+        input_ids = np.full((batch, width), self.pad_token_id, np.int32)
+        segment_ids = np.zeros((batch, width), np.int32)
+        labels = np.full((batch, width), -100, np.int32)
+
+        position_ids = np.zeros((batch, width), np.int32)
+        for row, example in enumerate(examples):
+            ids = np.asarray(example["input_ids"], np.int32)
+            segs = np.asarray(example["segment_ids"], np.int32)
+            sl = slice(0, len(ids)) if self.padding_side == "right" else slice(width - len(ids), width)
+            input_ids[row, sl] = ids
+            segment_ids[row, sl] = segs
+            row_labels = ids.copy()
+            if self.bos_token_id is not None:
+                row_labels[ids == self.bos_token_id] = -100
+            labels[row, sl] = row_labels
+            # positions start at 0 at the first real token, whichever side
+            # the padding is on (packed documents share one position stream,
+            # as the reference's pre-training collator does)
+            position_ids[row, sl] = np.arange(len(ids), dtype=np.int32)
+        return {
+            "input_ids": input_ids,
+            "labels": labels,
+            "segment_ids": segment_ids,
+            "position_ids": position_ids,
+        }

@@ -1,0 +1,11 @@
+from llm_training_tpu.data.preference_tuning.datamodule import (
+    PreferenceTuningDataModule,
+    PreferenceTuningDataModuleConfig,
+)
+from llm_training_tpu.data.preference_tuning.collator import PreferenceTuningDataCollator
+
+__all__ = [
+    "PreferenceTuningDataModule",
+    "PreferenceTuningDataModuleConfig",
+    "PreferenceTuningDataCollator",
+]

@@ -1,0 +1,50 @@
+"""Preference-pair collator.
+
+Capability parity: reference
+`data/preference_tuning/preference_tuning_datacollator.py:12-69`: pads the
+chosen/rejected sextuple and adds position_ids. Both sides pad to one common
+width so the DPO objective can run them as a single stacked forward.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+class PreferenceTuningDataCollator:
+    def __init__(self, config: Any, padding_side: str = "right"):
+        self.config = config
+        tokenizer = config.tokenizer
+        if tokenizer.pad_token_id is None:
+            raise ValueError("tokenizer needs a pad token")
+        self.pad_token_id = tokenizer.pad_token_id
+        self.padding_side = padding_side
+
+    def __call__(self, examples: list[dict]) -> dict[str, np.ndarray]:
+        longest = max(
+            max(e["chosen_length"], e["rejected_length"]) for e in examples
+        )
+        multiple = self.config.pad_to_multiple_of
+        width = -(-longest // multiple) * multiple if multiple else longest
+        batch = len(examples)
+
+        out: dict[str, np.ndarray] = {}
+        for side in ("chosen", "rejected"):
+            input_ids = np.full((batch, width), self.pad_token_id, np.int32)
+            labels = np.full((batch, width), -100, np.int32)
+            segment_ids = np.zeros((batch, width), np.int32)
+            position_ids = np.zeros((batch, width), np.int32)
+            for row, example in enumerate(examples):
+                n = example[f"{side}_length"]
+                sl = slice(0, n) if self.padding_side == "right" else slice(width - n, width)
+                input_ids[row, sl] = example[f"{side}_input_ids"]
+                labels[row, sl] = example[f"{side}_labels"]
+                segment_ids[row, sl] = 1
+                position_ids[row, sl] = np.arange(n, dtype=np.int32)
+            out[f"{side}_input_ids"] = input_ids
+            out[f"{side}_labels"] = labels
+            out[f"{side}_segment_ids"] = segment_ids
+            out[f"{side}_position_ids"] = position_ids
+        return out

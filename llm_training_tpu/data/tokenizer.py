@@ -1,0 +1,28 @@
+"""Tokenizer resolution / factory.
+
+Capability parity: reference `lightning/cli/utils.py:7-22` (`HFTokenizer`
+jsonargparse factory: path + pad_token + padding_side). In YAML configs the
+tokenizer is a string path or a `{path, pad_token, padding_side}` dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def resolve_tokenizer(value: Any) -> Any:
+    if hasattr(value, "get_vocab"):
+        return value
+    from transformers import AutoTokenizer
+
+    if isinstance(value, str):
+        return AutoTokenizer.from_pretrained(value)
+    if isinstance(value, dict):
+        kwargs = dict(value)
+        path = kwargs.pop("path")
+        pad_token = kwargs.pop("pad_token", None)
+        tokenizer = AutoTokenizer.from_pretrained(path, **kwargs)
+        if pad_token is not None:
+            tokenizer.pad_token = pad_token
+        return tokenizer
+    raise TypeError(f"cannot resolve tokenizer from {type(value)}")

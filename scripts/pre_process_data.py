@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Offline many-CPU tokenization/packing.
+
+Capability parity: reference `scripts/pre_process_data.py:25-48`: run the
+datamodule's pre-processing with high num_proc, save to
+`pre_processed_data_path`, and write an `info.txt` with per-source token
+tables. Usage:
+
+  python scripts/pre_process_data.py --config run.yaml [--num-proc N]
+
+Reads the `data:` section of the same YAML used for training.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from llm_training_tpu.cli.config import instantiate_from_config, load_config
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--num-proc", type=int, default=None)
+    parser.add_argument("--output-path", default=None,
+                        help="defaults to data.init_args.pre_processed_data_path")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    config = load_config(args.config)
+    node = config["data"]
+    if args.num_proc is not None:
+        node.setdefault("init_args", {})["num_proc"] = args.num_proc
+    datamodule = instantiate_from_config(node)
+
+    output_path = args.output_path or datamodule.config.pre_processed_data_path
+    if output_path is None:
+        raise SystemExit("set --output-path or data.init_args.pre_processed_data_path")
+
+    # force re-processing even if a processed copy exists at the target
+    datamodule.config.pre_processed_data_path = None
+    datamodule.setup()
+    datamodule.config.pre_processed_data_path = output_path
+    datamodule.save_pre_processed_data(output_path)
+
+    if hasattr(datamodule, "tokens_table"):
+        info = datamodule.tokens_table()
+        (Path(output_path) / "info.txt").write_text(info + "\n")
+        print(info)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Offline test fixtures: a tiny trained BPE tokenizer + toy datasets."""
+
+from __future__ import annotations
+
+import functools
+
+from datasets import Dataset, DatasetDict
+
+_CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump",
+    "sphinx of black quartz judge my vow",
+    "the five boxing wizards jump quickly",
+    "hello world how are you today my friend",
+    "training language models on tensor processing units",
+    "sequence packing avoids cross contamination between documents",
+]
+
+
+@functools.lru_cache(maxsize=1)
+def tiny_tokenizer():
+    from tokenizers import Tokenizer, models, pre_tokenizers, trainers
+    from transformers import PreTrainedTokenizerFast
+
+    tok = Tokenizer(models.BPE(unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    trainer = trainers.BpeTrainer(
+        special_tokens=[
+            "<unk>", "<s>", "</s>", "<pad>",
+            "<|im_start|>", "<|im_end|>",
+            "<|user|>", "<|assistant|>", "<|system|>", "<|end|>",
+        ],
+        vocab_size=400,
+    )
+    tok.train_from_iterator(_CORPUS, trainer)
+    return PreTrainedTokenizerFast(
+        tokenizer_object=tok,
+        bos_token="<s>",
+        eos_token="</s>",
+        pad_token="<pad>",
+        unk_token="<unk>",
+    )
+
+
+def text_dataset(n_per_source: int = 12) -> DatasetDict:
+    rows = {"text": [], "source": []}
+    for source in ("wiki", "code"):
+        for i in range(n_per_source):
+            rows["text"].append(_CORPUS[i % len(_CORPUS)] + f" sample {i}")
+            rows["source"].append(source)
+    rows["text"].append("")  # empty doc must be dropped
+    rows["source"].append("wiki")
+    return DatasetDict(train=Dataset.from_dict(rows))
+
+
+def chat_dataset(n: int = 12) -> DatasetDict:
+    rows = {"messages": []}
+    for i in range(n):
+        rows["messages"].append(
+            [
+                {"role": "user", "content": _CORPUS[i % len(_CORPUS)]},
+                {"role": "assistant", "content": _CORPUS[(i + 1) % len(_CORPUS)]},
+            ]
+        )
+    return DatasetDict(train=Dataset.from_dict(rows))
+
+
+def preference_dataset(n: int = 10) -> DatasetDict:
+    rows = {"prompt": [], "chosen": [], "rejected": []}
+    for i in range(n):
+        rows["prompt"].append(_CORPUS[i % len(_CORPUS)])
+        rows["chosen"].append(_CORPUS[(i + 1) % len(_CORPUS)])
+        rows["rejected"].append(_CORPUS[(i + 2) % len(_CORPUS)])
+    return DatasetDict(train=Dataset.from_dict(rows))

@@ -1,0 +1,105 @@
+"""Data pipeline -> trainer integration + offline pre-processing round trip."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from data_fixtures import text_dataset, tiny_tokenizer
+from llm_training_tpu.data.pre_training import (
+    PreTrainingDataModule,
+    PreTrainingDataModuleConfig,
+)
+from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+from llm_training_tpu.optim import OptimConfig
+from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+
+def _module(**kwargs):
+    module = PreTrainingDataModule(
+        PreTrainingDataModuleConfig(
+            tokenizer=tiny_tokenizer(),
+            max_length=32,
+            batch_size=8,
+            enable_cache=False,
+            pad_to_multiple_of=32,
+            **kwargs,
+        )
+    )
+    module.load_data = lambda: text_dataset(n_per_source=40)
+    return module
+
+
+def test_packed_pretraining_trains(devices):
+    datamodule = _module()
+    objective = CLM(
+        CLMConfig(
+            model=ModelProvider(
+                model_class="llm_training_tpu.models.Llama",
+                model_kwargs=dict(
+                    vocab_size=512, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+                    max_position_embeddings=64, compute_dtype="float32",
+                ),
+            ),
+            optim=OptimConfig(learning_rate=1e-3, lr_scheduler="constant"),
+        )
+    )
+    trainer = Trainer(TrainerConfig(max_steps=3, log_every_n_steps=1))
+    state = trainer.fit(objective, datamodule)
+    assert int(np.asarray(state.step)) == 3
+    # consumed_tokens counted only non-padding positions
+    assert 0 < trainer.counters["consumed_tokens"] <= 3 * 8 * 32
+
+
+def test_save_and_reload_preprocessed(tmp_path):
+    module = _module()
+    module.setup()
+    module.save_pre_processed_data(str(tmp_path / "prep"))
+
+    module2 = _module(pre_processed_data_path=str(tmp_path / "prep"))
+    module2.load_data = lambda: (_ for _ in ()).throw(AssertionError("must not re-load"))
+    module2.setup()
+    assert len(module2.train_dataset) == len(module.train_dataset)
+    np.testing.assert_array_equal(
+        module2.train_dataset[0]["input_ids"], module.train_dataset[0]["input_ids"]
+    )
+
+
+def test_pre_process_script(tmp_path):
+    """Run scripts/pre_process_data.py main() end-to-end with real files."""
+    import yaml
+
+    tiny_tokenizer().save_pretrained(str(tmp_path / "tokenizer"))
+    text_dataset(n_per_source=20)["train"].save_to_disk(str(tmp_path / "raw"))
+    arrow = next((tmp_path / "raw").glob("*.arrow"))
+
+    out = tmp_path / "prep2"
+    config = {
+        "data": {
+            "class_path": "llm_training_tpu.data.PreTrainingDataModule",
+            "init_args": {
+                "tokenizer": str(tmp_path / "tokenizer"),
+                "dataset_kwargs": {"path": "arrow", "data_files": str(arrow)},
+                "max_length": 32,
+                "batch_size": 4,
+                "enable_cache": False,
+                "pre_processed_data_path": str(out),
+            },
+        }
+    }
+    config_path = tmp_path / "run.yaml"
+    config_path.write_text(yaml.safe_dump(config))
+
+    sys.path.insert(0, str(Path(__file__).parent.parent / "scripts"))
+    import pre_process_data
+
+    assert pre_process_data.main(["--config", str(config_path), "--num-proc", "1"]) == 0
+    assert (out / "info.txt").exists()
+    assert "wiki" in (out / "info.txt").read_text()
+
+    # and the saved data round-trips into a fresh module
+    module = _module(pre_processed_data_path=str(out))
+    module.load_data = lambda: (_ for _ in ()).throw(AssertionError("must not re-load"))
+    module.setup()
+    assert len(module.train_dataset) > 0

@@ -1,0 +1,267 @@
+"""Data pipeline: packing invariants, chat-template masks, collators."""
+
+import numpy as np
+import pytest
+
+from data_fixtures import chat_dataset, preference_dataset, text_dataset, tiny_tokenizer
+from llm_training_tpu.data.chat_templates import available_chat_templates, get_chat_template
+from llm_training_tpu.data.instruction_tuning import (
+    InstructionTuningDataModule,
+    InstructionTuningDataModuleConfig,
+)
+from llm_training_tpu.data.pre_training import (
+    PackingMethod,
+    PreTrainingDataModule,
+    PreTrainingDataModuleConfig,
+)
+from llm_training_tpu.data.pre_training.datamodule import best_fit_bin_packing
+from llm_training_tpu.data.preference_tuning import (
+    PreferenceTuningDataModule,
+    PreferenceTuningDataModuleConfig,
+)
+
+
+def _pt_module(**kwargs):
+    kwargs = {"max_length": 32, **kwargs}
+    module = PreTrainingDataModule(
+        PreTrainingDataModuleConfig(
+            tokenizer=tiny_tokenizer(),
+            batch_size=2,
+            enable_cache=False,
+            **kwargs,
+        )
+    )
+    module.load_data = lambda: text_dataset()
+    return module
+
+
+@pytest.mark.parametrize("method", [PackingMethod.NAIVE_PACKING, PackingMethod.BEST_FIT_BIN_PACKING])
+def test_pre_training_packing_invariants(method):
+    module = _pt_module(packing_method=method)
+    module.setup()
+    tokenizer = tiny_tokenizer()
+    all_tokens = 0
+    for row in module.train_dataset:
+        ids = row["input_ids"]
+        segs = row["segment_ids"]
+        assert len(ids) <= 32
+        assert len(ids) == len(segs) == row["length"]
+        # segment ids are 1..N contiguous non-decreasing... for naive packing
+        # they may start mid-document but are renumbered to start at 1
+        assert segs[0] == 1
+        assert all(b - a in (0, 1) for a, b in zip(segs, segs[1:]))
+        all_tokens += len(ids)
+        if method == PackingMethod.BEST_FIT_BIN_PACKING:
+            # documents never span rows: every segment begins with BOS
+            starts = [0] + [i for i in range(1, len(segs)) if segs[i] != segs[i - 1]]
+            for s in starts:
+                assert ids[s] == tokenizer.bos_token_id
+    # token conservation: every tokenized token lands in exactly one row
+    expected = 0
+    for row in text_dataset()["train"]:
+        if row["text"]:
+            expected += len(tokenizer(row["text"])["input_ids"]) + 2  # +BOS+EOS
+    assert all_tokens == expected
+
+
+def test_pre_training_sources_not_mixed():
+    module = _pt_module(packing_method=PackingMethod.BEST_FIT_BIN_PACKING)
+    module.setup()
+    # each packed row carries a single source
+    assert set(module.train_dataset["source"]) == {"wiki", "code"}
+
+
+def test_pre_training_sample_rate():
+    base = _pt_module()
+    base.setup()
+    wiki_rows = sum(1 for s in base.train_dataset["source"] if s == "wiki")
+    code_rows = sum(1 for s in base.train_dataset["source"] if s == "code")
+
+    module = _pt_module(sample_rate={"wiki": 2.5, "code": 1.0})
+    module.setup()
+    wiki_sampled = sum(1 for s in module.train_dataset["source"] if s == "wiki")
+    code_sampled = sum(1 for s in module.train_dataset["source"] if s == "code")
+    assert code_sampled == code_rows
+    assert wiki_sampled == 2 * wiki_rows + int(wiki_rows * 0.5)
+
+
+def test_pre_training_stride():
+    module = _pt_module(max_length=16, stride=8, packing_method=PackingMethod.NO_PACKING)
+    module.setup()
+    assert all(row["length"] <= 16 for row in module.train_dataset)
+
+
+def test_pre_training_collator():
+    module = _pt_module()
+    module.setup()
+    batch = module.collate([module.train_dataset[0], module.train_dataset[1]])
+    assert batch["input_ids"].shape == batch["labels"].shape == batch["segment_ids"].shape
+    tokenizer = tiny_tokenizer()
+    # BOS and padding are masked in labels
+    assert (batch["labels"][batch["input_ids"] == tokenizer.bos_token_id] == -100).all()
+    assert (batch["labels"][batch["segment_ids"] == 0] == -100).all()
+
+
+def test_tokens_table():
+    module = _pt_module()
+    module.setup()
+    table = module.tokens_table()
+    assert "wiki" in table and "code" in table and "*" in table
+
+
+# ---------------------------------------------------------------- bin packing
+
+
+def test_best_fit_bin_packing_properties():
+    lengths = [10, 9, 8, 7, 2, 2, 1]
+    groups = best_fit_bin_packing(10, lengths)
+    # all items placed exactly once
+    placed = sorted(i for g in groups for i in g)
+    assert placed == list(range(len(lengths)))
+    for g in groups:
+        assert sum(lengths[i] for i in g) <= 10
+    # best-fit on sorted-desc input: [10], [9,1], [8,2], [7,2]
+    assert len(groups) == 4
+
+
+# ---------------------------------------------------------------- instruction
+
+
+def _it_module(**kwargs):
+    module = InstructionTuningDataModule(
+        InstructionTuningDataModuleConfig(
+            tokenizer=tiny_tokenizer(),
+            chat_template="chatml",
+            batch_size=2,
+            enable_cache=False,
+            **kwargs,
+        )
+    )
+    module.load_data = lambda: chat_dataset()
+    return module
+
+
+def test_instruction_tuning_assistant_masks():
+    module = _it_module()
+    module.setup()
+    tokenizer = tiny_tokenizer()
+    for row in module.train_dataset:
+        labels = np.asarray(row["labels"])
+        ids = np.asarray(row["input_ids"])
+        assert (labels != -100).any() and (labels == -100).any()
+        # labeled positions reproduce the assistant text + <|im_end|>
+        text = tokenizer.decode(ids[labels != -100])
+        assert "<|im_end|>" in text
+        assert "<|im_start|>" not in text  # prompt tokens never labeled
+
+
+def test_instruction_tuning_group_by_length_packing():
+    module = _it_module(max_length=64, packing_method="group_by_length")
+    module.setup()
+    for row in module.train_dataset:
+        assert row["length"] <= 64
+        segs = np.asarray(row["segment_ids"])
+        assert segs[0] == 1
+    # packing reduced the row count below the example count
+    assert len(module.train_dataset) < len(chat_dataset()["train"])
+
+
+def test_instruction_tuning_collator_positions_restart():
+    module = _it_module(max_length=64, packing_method="group_by_length")
+    module.setup()
+    batch = module.collate([module.train_dataset[0]])
+    segs = batch["segment_ids"][0]
+    positions = batch["position_ids"][0]
+    for seg in np.unique(segs[segs > 0]):
+        assert positions[segs == seg][0] == 0
+
+
+def test_instruction_tuning_overlong_drop_vs_truncate():
+    drop = _it_module(max_length=24, overlong_handling_method="drop")
+    drop.setup()
+    truncate = _it_module(max_length=24, overlong_handling_method="truncate")
+    truncate.setup()
+    assert all(r["length"] <= 24 for r in drop.train_dataset)
+    assert all(r["length"] <= 24 for r in truncate.train_dataset)
+    assert len(truncate.train_dataset) >= len(drop.train_dataset)
+
+
+def test_default_system_prompt_injection():
+    injected = _it_module(
+        add_default_system_prompt_rate=1.0,
+        default_system_prompt="be helpful and kind to every user always",
+    )
+    injected.setup()
+    plain = _it_module()
+    plain.setup()
+    # rate=1.0 -> every example gains the system-prompt tokens
+    for with_sys, without in zip(injected.train_dataset, plain.train_dataset):
+        assert with_sys["length"] > without["length"]
+
+    # rate=0.0 -> nothing injected
+    none = _it_module(add_default_system_prompt_rate=0.0,
+                      default_system_prompt="be helpful and kind to every user always")
+    none.setup()
+    for with_sys, without in zip(none.train_dataset, plain.train_dataset):
+        assert with_sys["length"] == without["length"]
+
+
+# ---------------------------------------------------------------- preference
+
+
+def test_preference_tuning_pairs():
+    module = PreferenceTuningDataModule(
+        PreferenceTuningDataModuleConfig(
+            tokenizer=tiny_tokenizer(),
+            chat_template="chatml",
+            batch_size=2,
+            max_length=64,
+            enable_cache=False,
+        )
+    )
+    module.load_data = lambda: preference_dataset()
+    module.setup()
+    row = module.train_dataset[0]
+    assert row["chosen_length"] == len(row["chosen_input_ids"])
+    batch = module.collate([module.train_dataset[0], module.train_dataset[1]])
+    assert batch["chosen_input_ids"].shape == batch["rejected_input_ids"].shape
+    assert (batch["chosen_labels"] != -100).any()
+
+
+# ---------------------------------------------------------------- templates
+
+
+def test_all_templates_render_with_masks():
+    tokenizer = tiny_tokenizer()
+    messages = [
+        {"role": "user", "content": "hello world"},
+        {"role": "assistant", "content": "how are you"},
+    ]
+    assert len(available_chat_templates()) == 9
+    for name in available_chat_templates():
+        if name == "gemma":
+            continue  # needs no system; fine here, but keep loop uniform
+        out = tokenizer.apply_chat_template(
+            messages,
+            chat_template=get_chat_template(name),
+            return_dict=True,
+            return_assistant_tokens_mask=True,
+        )
+        mask = np.asarray(out["assistant_masks"])
+        assert mask.sum() > 0, name
+        text = tokenizer.decode(np.asarray(out["input_ids"])[mask == 1])
+        assert "how are you" in text, name
+
+
+def test_gemma_template_rejects_system():
+    tokenizer = tiny_tokenizer()
+    with pytest.raises(Exception):
+        tokenizer.apply_chat_template(
+            [{"role": "system", "content": "x"}, {"role": "user", "content": "y"}],
+            chat_template=get_chat_template("gemma"),
+        )
+
+
+def test_unknown_template_raises():
+    with pytest.raises(ValueError, match="unknown chat template"):
+        get_chat_template("nope")
