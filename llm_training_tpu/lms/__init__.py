@@ -9,5 +9,17 @@ no trainer state — the Trainer jits `objective.loss_and_metrics` directly.
 
 from llm_training_tpu.lms.base import BaseLMConfig, CausalLM, ModelProvider
 from llm_training_tpu.lms.clm import CLM, CLMConfig
+from llm_training_tpu.lms.dpo import DPO, DPOConfig
+from llm_training_tpu.lms.orpo import ORPO, ORPOConfig
 
-__all__ = ["BaseLMConfig", "CausalLM", "ModelProvider", "CLM", "CLMConfig"]
+__all__ = [
+    "BaseLMConfig",
+    "CausalLM",
+    "ModelProvider",
+    "CLM",
+    "CLMConfig",
+    "DPO",
+    "DPOConfig",
+    "ORPO",
+    "ORPOConfig",
+]

@@ -1,0 +1,109 @@
+"""Odds-Ratio Preference Optimization (single-model).
+
+Capability parity: reference `lms/orpo/orpo.py:35-240`: length-normalized
+per-sequence log-probs (`orpo.py:93`), odds-ratio loss
+`-(beta * logsigmoid(log_odds)).mean()` added to the CE loss on the chosen
+response (`orpo.py:123-178`), and the reward/log-odds metrics dashboard
+(`orpo.py:140-152`). The reference's `empty_cache_threshold` GC workaround
+(`orpo.py:192-198`) has no analogue — XLA's allocator needs no manual cache
+clearing.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from pydantic import ConfigDict
+
+from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
+from llm_training_tpu.lms.dpo import _get_path
+from llm_training_tpu.ops import shift_labels
+from llm_training_tpu.ops.cross_entropy import fused_linear_log_probs
+
+
+class ORPOConfig(BaseLMConfig):
+    model_config = ConfigDict(extra="forbid")
+
+    model: ModelProvider | None = None
+    beta: float = 0.1
+    ignore_index: int = -100
+    logps_chunk_size: int = 1024
+
+
+class ORPO:
+    def __init__(self, config: ORPOConfig, model: Any | None = None):
+        self.config = config
+        self.model = model if model is not None else config.model.get_model()
+
+    def init_params(self, rng: jax.Array, batch: dict[str, jnp.ndarray]) -> Any:
+        return self.model.init(rng, batch["chosen_input_ids"][:1])
+
+    def _logps(self, params, batch, side: str):
+        labels = shift_labels(batch[f"{side}_labels"], self.config.ignore_index)
+        out = self.model.apply(
+            params,
+            input_ids=batch[f"{side}_input_ids"],
+            segment_ids=batch.get(f"{side}_segment_ids"),
+            position_ids=batch.get(f"{side}_position_ids"),
+            compute_logits=False,
+            return_last_hidden_states=True,
+        )
+        p = params["params"] if "params" in params else params
+        head_path = self.model.get_output_embeddings_path()
+        head = _get_path(p, head_path)
+        if head_path == self.model.get_input_embeddings_path():
+            head = head.T
+        logps, counts = fused_linear_log_probs(
+            out.last_hidden_states,
+            head.astype(out.last_hidden_states.dtype),
+            labels,
+            ignore_index=self.config.ignore_index,
+            chunk_size=self.config.logps_chunk_size,
+        )
+        return logps, counts
+
+    def loss_and_metrics(
+        self,
+        params: Any,
+        batch: dict[str, jnp.ndarray],
+        rng: jax.Array | None = None,
+        train: bool = True,
+    ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+        cfg = self.config
+
+        chosen_sums, chosen_counts = self._logps(params, batch, "chosen")
+        rejected_sums, rejected_counts = self._logps(params, batch, "rejected")
+
+        # length-normalized logps (reference orpo.py:93)
+        chosen_logps = chosen_sums / jnp.maximum(chosen_counts, 1)
+        rejected_logps = rejected_sums / jnp.maximum(rejected_counts, 1)
+
+        # odds ratio in log space; log1p(-exp(x)) is stable for x < 0
+        log_odds = (chosen_logps - rejected_logps) - (
+            jnp.log1p(-jnp.exp(chosen_logps)) - jnp.log1p(-jnp.exp(rejected_logps))
+        )
+        ratio = jax.nn.log_sigmoid(log_odds)
+        or_loss = -(cfg.beta * ratio).mean()
+
+        # CE (SFT) term on the chosen response
+        ce_loss = -chosen_sums.sum() / jnp.maximum(chosen_counts.sum(), 1)
+
+        loss = or_loss + ce_loss
+
+        chosen_rewards = cfg.beta * jax.lax.stop_gradient(chosen_logps)
+        rejected_rewards = cfg.beta * jax.lax.stop_gradient(rejected_logps)
+        metrics = {
+            "loss": loss,
+            "or_loss": jax.lax.stop_gradient(or_loss),
+            "ce_loss": jax.lax.stop_gradient(ce_loss),
+            "target_tokens": chosen_counts.sum() + rejected_counts.sum(),
+            "chosen_rewards": chosen_rewards.mean(),
+            "rejected_rewards": rejected_rewards.mean(),
+            "reward_accuracy": (chosen_rewards > rejected_rewards).mean(),
+            "reward_margin": (chosen_rewards - rejected_rewards).mean(),
+            "log_odds_ratio": jax.lax.stop_gradient(ratio).mean(),
+            "log_odds_chosen": jax.lax.stop_gradient(log_odds).mean(),
+        }
+        return loss, metrics

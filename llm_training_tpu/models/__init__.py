@@ -11,5 +11,13 @@ families. Here, models are flax.linen Modules whose parameters carry
 
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
 from llm_training_tpu.models.llama import Llama, LlamaConfig
+from llm_training_tpu.models.phi3 import Phi3, Phi3Config
 
-__all__ = ["BaseModelConfig", "CausalLMOutput", "Llama", "LlamaConfig"]
+__all__ = [
+    "BaseModelConfig",
+    "CausalLMOutput",
+    "Llama",
+    "LlamaConfig",
+    "Phi3",
+    "Phi3Config",
+]

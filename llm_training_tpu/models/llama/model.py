@@ -71,7 +71,11 @@ class LlamaAttention(nn.Module):
 
     q/k/v projections are colwise-parallel ('heads'/'kv_heads' → tensor axis),
     o_proj rowwise ('embed' output) — the reference TP plan
-    (`llama_model.py:197-244`) via logical axes."""
+    (`llama_model.py:197-244`) via logical axes.
+
+    Also serves Phi-3 (reference `phi3_model.py:436-480`): the config may
+    carry `sliding_window` and `attention_compute_dtype` (Phi-3's SDPA
+    upcast workaround, `phi3_model.py:172-187`)."""
 
     config: LlamaConfig
 
@@ -100,12 +104,21 @@ class LlamaAttention(nn.Module):
 
         q, k = apply_rope(q, k, cos, sin)
 
+        attention_dtype = getattr(cfg, "attention_compute_dtype", None)
+        if attention_dtype is not None:
+            from llm_training_tpu.models.base import resolve_dtype
+
+            dtype = resolve_dtype(attention_dtype)
+            q, k, v = q.astype(dtype), k.astype(dtype), v.astype(dtype)
+
         out = dot_product_attention(
             q, k, v,
             segment_ids=segment_ids,
             causal=True,
+            sliding_window=getattr(cfg, "sliding_window", None),
             impl=cfg.attention_impl,
         )
+        out = out.astype(hidden.dtype)
         out = out.reshape(batch, seq, cfg.num_attention_heads * head_dim)
         return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj", cfg.attention_bias)(out)
 

@@ -1,0 +1,86 @@
+"""Phi-3 model config.
+
+Capability parity: reference `models/phi3/phi3_config.py:9-79` — Llama-shaped
+hparams plus `original_max_position_embeddings`, `sliding_window`,
+`attention_compute_dtype`, and the longrope `rope_scaling` validator with
+factor defaulting (`phi3_config.py:34-79`).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import DTypeName
+from llm_training_tpu.models.llama.config import LlamaConfig
+from llm_training_tpu.ops.rope_utils import RoPEConfig
+
+
+class Phi3Config(LlamaConfig):
+    vocab_size: int = 32064
+    hidden_size: int = 3072
+    intermediate_size: int = 8192
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    original_max_position_embeddings: int | None = None
+    sliding_window: int | None = None
+    bos_token_id: int = 1
+    eos_token_id: int = 32000
+    pad_token_id: int | None = 32000
+    resid_pdrop: float = 0.0
+    embd_pdrop: float = 0.0
+    # Phi-3's attention-precision override (reference phi3_model.py:172-187):
+    # run the attention core in this dtype (e.g. 'float32') regardless of
+    # compute_dtype
+    attention_compute_dtype: DTypeName | None = None
+
+    @model_validator(mode="after")
+    def _validate_phi3(self) -> "Phi3Config":
+        if self.resid_pdrop != 0.0 or self.embd_pdrop != 0.0:
+            raise ValueError("dropout is not supported; set resid/embd_pdrop to 0.0")
+        if self.rope_scaling:
+            rope_type = self.rope_scaling.get("rope_type", self.rope_scaling.get("type"))
+            if rope_type == "longrope":
+                dim = self.resolved_head_dim // 2
+                for key in ("short_factor", "long_factor"):
+                    factors = self.rope_scaling.get(key)
+                    if factors is None or len(factors) != dim:
+                        raise ValueError(
+                            f"longrope {key} must have length head_dim/2={dim}"
+                        )
+                if self.original_max_position_embeddings is None:
+                    raise ValueError(
+                        "longrope requires original_max_position_embeddings"
+                    )
+        return self
+
+    @property
+    def rope_config(self) -> RoPEConfig:
+        scaling: dict[str, Any] | None = (
+            dict(self.rope_scaling) if self.rope_scaling else None
+        )
+        rope_type = "default"
+        if scaling:
+            for key in ("rope_type", "type"):
+                if key in scaling:
+                    rope_type = scaling.pop(key)
+        max_pos = self.max_position_embeddings
+        if rope_type == "longrope":
+            # factor defaulting (reference phi3_config.py:34-79 /
+            # modeling HF): factor = max_pos / original_max_pos; frequencies
+            # are computed against the ORIGINAL context window
+            original = self.original_max_position_embeddings
+            if original is None:
+                raise ValueError("longrope requires original_max_position_embeddings")
+            scaling.setdefault("factor", max_pos / original)
+            max_pos = original
+        return RoPEConfig(
+            type=rope_type,
+            base=self.rope_theta,
+            dim=self.resolved_head_dim,
+            max_position_embeddings=max_pos,
+            scaling=scaling or None,
+        )

@@ -1,0 +1,139 @@
+"""Phi-3 <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` for the Phi-3
+family. HF Phi-3 stores fused `qkv_proj` / `gate_up_proj`; our tree stores
+them split (see `phi3/model.py` docstring), so conversion splits on load and
+re-fuses on export.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.llama.hf_conversion import (
+    _LAYER_PARAMS,
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+from llm_training_tpu.models.phi3.config import Phi3Config
+
+# our split layout <-> HF fused names
+_SPLIT_LAYER_PARAMS = [p for p in _LAYER_PARAMS if "q_proj" not in p[1]
+                       and "k_proj" not in p[1] and "v_proj" not in p[1]
+                       and "gate_proj" not in p[1] and "up_proj" not in p[1]]
+
+
+def _qkv_splits(config: Phi3Config) -> tuple[int, int]:
+    head_dim = config.resolved_head_dim
+    q = config.num_attention_heads * head_dim
+    kv = config.num_key_value_heads * head_dim
+    return q, kv
+
+
+def params_from_hf(state_dict: Mapping[str, Any], config: Phi3Config) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    _set_path(params, ("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    _set_path(params, ("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        _set_path(params, ("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    q_size, kv_size = _qkv_splits(config)
+    inter = config.intermediate_size
+
+    def layer_parts(i: int) -> dict[tuple[str, ...], np.ndarray]:
+        qkv = _to_numpy(sd[f"layers.{i}.self_attn.qkv_proj.weight"]).T  # [hidden, q+2kv]
+        gate_up = _to_numpy(sd[f"layers.{i}.mlp.gate_up_proj.weight"]).T  # [hidden, 2*inter]
+        parts = {
+            ("self_attn", "q_proj", "kernel"): qkv[:, :q_size],
+            ("self_attn", "k_proj", "kernel"): qkv[:, q_size : q_size + kv_size],
+            ("self_attn", "v_proj", "kernel"): qkv[:, q_size + kv_size :],
+            ("mlp", "gate_proj", "kernel"): gate_up[:, :inter],
+            ("mlp", "up_proj", "kernel"): gate_up[:, inter:],
+        }
+        for path, hf_name, transpose in _SPLIT_LAYER_PARAMS:
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            parts[path] = value.T if transpose else value
+        return parts
+
+    layers = [layer_parts(i) for i in range(config.num_hidden_layers)]
+    if config.scan_layers:
+        for path in layers[0]:
+            _set_path(params, ("layers", "layer") + path,
+                      np.stack([layer[path] for layer in layers]))
+    else:
+        for i, layer in enumerate(layers):
+            for path, value in layer.items():
+                _set_path(params, (f"layers_{i}",) + path, value)
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: Phi3Config) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    def layer_tree(i: int) -> Any:
+        if config.scan_layers:
+            return None, i
+        return (f"layers_{i}",), None
+
+    for i in range(config.num_hidden_layers):
+        def get(path: tuple[str, ...]) -> np.ndarray:
+            if config.scan_layers:
+                return np.asarray(_get_path(p, ("layers", "layer") + path))[i]
+            return np.asarray(_get_path(p, (f"layers_{i}",) + path))
+
+        qkv = np.concatenate(
+            [
+                get(("self_attn", "q_proj", "kernel")),
+                get(("self_attn", "k_proj", "kernel")),
+                get(("self_attn", "v_proj", "kernel")),
+            ],
+            axis=1,
+        )
+        out[f"model.layers.{i}.self_attn.qkv_proj.weight"] = qkv.T
+        gate_up = np.concatenate(
+            [get(("mlp", "gate_proj", "kernel")), get(("mlp", "up_proj", "kernel"))],
+            axis=1,
+        )
+        out[f"model.layers.{i}.mlp.gate_up_proj.weight"] = gate_up.T
+        for path, hf_name, transpose in _SPLIT_LAYER_PARAMS:
+            value = get(path)
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    return out
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> Phi3Config:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return Phi3Config(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings"),
+        original_max_position_embeddings=get("original_max_position_embeddings"),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id", 1),
+        eos_token_id=get("eos_token_id", 32000),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=get("rope_scaling"),
+        sliding_window=get("sliding_window"),
+    ), **overrides})

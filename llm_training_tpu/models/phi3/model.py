@@ -1,0 +1,27 @@
+"""Phi-3 / Phi-3.5 decoder.
+
+Capability parity: reference `models/phi3/phi3_model.py:31-824`. The
+architecture is Llama-shaped; the reference's differences are:
+- fused qkv_proj / gate_up_proj (`phi3_model.py:507-509,421`) — a CUDA
+  memory-layout optimization. On TPU, XLA fuses the separate projections
+  into one MXU pass anyway, so we store q/k/v and gate/up separately (which
+  also makes the tensor-parallel sharding uniform — the reference needed a
+  special TP plan for the fused layout, `phi3_model.py:212-256`). The HF
+  converter splits/merges the fused matrices.
+- sliding-window mask (`phi3_model.py:164-170`) — a mask term in
+  `ops.dot_product_attention`
+- `attention_compute_dtype` upcast (`phi3_model.py:172-187`)
+- longrope with `original_max_position_embeddings` (`phi3_model.py:303-317`)
+
+All of these are handled by the shared decoder stack (see
+`llama/model.py:LlamaAttention`), so Phi3 is Llama with a Phi3Config.
+"""
+
+from __future__ import annotations
+
+from llm_training_tpu.models.llama.model import Llama
+from llm_training_tpu.models.phi3.config import Phi3Config
+
+
+class Phi3(Llama):
+    config: Phi3Config

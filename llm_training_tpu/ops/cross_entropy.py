@@ -104,3 +104,54 @@ def fused_linear_cross_entropy(
         body, (jnp.float32(0.0), jnp.int32(0)), (hidden_chunks, label_chunks)
     )
     return total, count
+
+
+def fused_linear_log_probs(
+    hidden: jnp.ndarray,
+    weight: jnp.ndarray,
+    labels: jnp.ndarray,
+    ignore_index: int = -100,
+    chunk_size: int = 1024,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-sequence label log-probs of `hidden @ weight` without full logits.
+
+    hidden: [batch, seq, embed]; labels: [batch, seq].
+    Returns (sum log p per row [batch] fp32, valid-token counts [batch]).
+    The DPO/ORPO building block (reference `dpo.py:89-108`,
+    `orpo.py:60-93`): chunked over the sequence axis with rematerialized
+    chunks, so peak memory is O(batch * chunk * vocab) — the same trick as
+    `fused_linear_cross_entropy` but with per-row reductions.
+    """
+    batch, seq, embed = hidden.shape
+    chunk_size = min(chunk_size, seq)
+    num_chunks = -(-seq // chunk_size)
+    pad = num_chunks * chunk_size - seq
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=ignore_index)
+
+    # [num_chunks, batch, chunk, ...] for scan
+    hidden_chunks = jnp.moveaxis(
+        hidden.reshape(batch, num_chunks, chunk_size, embed), 1, 0
+    )
+    label_chunks = jnp.moveaxis(
+        labels.reshape(batch, num_chunks, chunk_size), 1, 0
+    )
+
+    @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk_logps(h: jnp.ndarray, l: jnp.ndarray):
+        logits = jnp.dot(h, weight, preferred_element_type=jnp.float32)
+        nll, valid = _token_nll(logits, l, ignore_index)
+        return -nll.sum(axis=-1), valid.sum(axis=-1)
+
+    def body(carry, xs):
+        total, count = carry
+        s, c = chunk_logps(*xs)
+        return (total + s, count + c), None
+
+    (logps, counts), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((batch,), jnp.float32), jnp.zeros((batch,), jnp.int32)),
+        (hidden_chunks, label_chunks),
+    )
+    return logps, counts

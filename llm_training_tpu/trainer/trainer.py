@@ -232,11 +232,7 @@ class Trainer:
             batch = next(batches)
             state, metrics = train_step(state, batch)
 
-            seg = batch.get("segment_ids")
-            self.counters["consumed_samples"] += int(batch["input_ids"].shape[0])
-            self.counters["consumed_tokens"] += (
-                int((seg > 0).sum()) if seg is not None else int(batch["input_ids"].size)
-            )
+            self._update_counters(batch)
 
             if (micro + 1) % cfg.accumulate_grad_batches != 0:
                 continue
@@ -291,6 +287,20 @@ class Trainer:
             for cb in self.callbacks:
                 if hasattr(cb, "on_validation_end"):
                     cb.on_validation_end(self, step, {"val_loss": val_loss})
+
+    def _update_counters(self, batch: dict) -> None:
+        """Consumed samples/tokens from the host-side numpy batch; handles
+        both CLM batches (`input_ids`) and preference batches
+        (`chosen_/rejected_input_ids`)."""
+        id_keys = [k for k in batch if k == "input_ids" or k.endswith("_input_ids")]
+        first = batch[id_keys[0]]
+        self.counters["consumed_samples"] += int(first.shape[0])
+        for key in id_keys:
+            prefix = key[: -len("input_ids")]
+            seg = batch.get(prefix + "segment_ids")
+            self.counters["consumed_tokens"] += (
+                int((seg > 0).sum()) if seg is not None else int(batch[key].size)
+            )
 
     # ------------------------------------------------------------ validate
 
