@@ -45,9 +45,31 @@ class BaseLMConfig(BaseModel):
 
     init_weights: bool = True
     load_weights: bool = True
+    # HF checkpoint dir to initialize from (reference `pre_trained_weights`,
+    # `base_lm_config.py:13-43`); streamed into sharded arrays via hf_io
+    pre_trained_weights: str | None = None
     optim: OptimConfig = OptimConfig()
     frozen_modules: list[str] = []
     log_grad_norm: bool = True
+
+
+def resolve_pretrained_source(objective: Any) -> str | None:
+    """Objective-level `pre_trained_weights` wins; else the model config's
+    own weight-source field (reference `base_model.py:32-33`)."""
+    return (
+        objective.config.pre_trained_weights
+        or objective.model.config.pre_trained_weights
+    )
+
+
+def load_single_model_pretrained(objective: Any, shardings: Any, dtypes: Any) -> Any:
+    """Shared CLM/ORPO loader: stream the HF weight source into sharded
+    arrays (reference `base_lm.py:175-193`)."""
+    from llm_training_tpu.models.hf_io import load_pretrained_params
+
+    return load_pretrained_params(
+        objective.model.config, resolve_pretrained_source(objective), shardings, dtypes
+    )
 
 
 class ModelProvider(BaseModel):

@@ -62,6 +62,16 @@ class CLM:
     def init_params(self, rng: jax.Array, batch: dict[str, jnp.ndarray]) -> Any:
         return self.model.init(rng, batch["input_ids"][:1])
 
+    def pretrained_source(self) -> str | None:
+        from llm_training_tpu.lms.base import resolve_pretrained_source
+
+        return resolve_pretrained_source(self)
+
+    def pretrained_params(self, shardings: Any, dtypes: Any) -> Any:
+        from llm_training_tpu.lms.base import load_single_model_pretrained
+
+        return load_single_model_pretrained(self, shardings, dtypes)
+
     def loss_and_metrics(
         self,
         params: Any,

@@ -20,6 +20,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from pydantic import ConfigDict
 
 from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
@@ -69,6 +70,45 @@ class DPO:
         # ref starts as an exact copy (reference dpo.py:59-67 loads the same
         # pre-trained weights into both)
         return {"policy": policy, "ref": jax.tree.map(jnp.copy, ref)}
+
+    def pretrained_source(self) -> str | None:
+        from llm_training_tpu.lms.base import resolve_pretrained_source
+
+        return resolve_pretrained_source(self)
+
+    def pretrained_params(self, shardings: Any, dtypes: Any) -> Any:
+        """Stream HF weights into policy and frozen ref (reference
+        dpo.py:59-67). The ref loads from its own model-config weight source
+        when one is set (it may be a different architecture); otherwise it
+        reuses the policy's host reads."""
+        from llm_training_tpu.models.hf_io import load_pretrained_params
+
+        policy_src = self.pretrained_source()
+        ref_src = (
+            self.ref_model.config.pre_trained_weights
+            if self.ref_model is not self.model
+            and self.ref_model.config.pre_trained_weights
+            else policy_src
+        )
+        if self.ref_model is self.model and ref_src == policy_src:
+            # same model + same source: read the checkpoint once, place twice
+            host = load_pretrained_params(self.model.config, policy_src)
+            policy = jax.tree.map(
+                lambda leaf, s, d: jax.device_put(np.asarray(leaf).astype(d), s),
+                host, shardings["policy"], dtypes["policy"],
+            )
+            ref = jax.tree.map(
+                lambda leaf, s, d: jax.device_put(np.asarray(leaf).astype(d), s),
+                host, shardings["ref"], dtypes["ref"],
+            )
+            return {"policy": policy, "ref": ref}
+        policy = load_pretrained_params(
+            self.model.config, policy_src, shardings["policy"], dtypes["policy"]
+        )
+        ref = load_pretrained_params(
+            self.ref_model.config, ref_src, shardings["ref"], dtypes["ref"]
+        )
+        return {"policy": policy, "ref": ref}
 
     def _sequence_logps(self, model, params, batch, side: str):
         labels = shift_labels(batch[f"{side}_labels"], self.config.ignore_index)

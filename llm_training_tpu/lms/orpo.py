@@ -40,6 +40,16 @@ class ORPO:
     def init_params(self, rng: jax.Array, batch: dict[str, jnp.ndarray]) -> Any:
         return self.model.init(rng, batch["chosen_input_ids"][:1])
 
+    def pretrained_source(self) -> str | None:
+        from llm_training_tpu.lms.base import resolve_pretrained_source
+
+        return resolve_pretrained_source(self)
+
+    def pretrained_params(self, shardings: Any, dtypes: Any) -> Any:
+        from llm_training_tpu.lms.base import load_single_model_pretrained
+
+        return load_single_model_pretrained(self, shardings, dtypes)
+
     def _logps(self, params, batch, side: str):
         labels = shift_labels(batch[f"{side}_labels"], self.config.ignore_index)
         out = self.model.apply(
@@ -80,9 +90,13 @@ class ORPO:
         chosen_logps = chosen_sums / jnp.maximum(chosen_counts, 1)
         rejected_logps = rejected_sums / jnp.maximum(rejected_counts, 1)
 
-        # odds ratio in log space; log1p(-exp(x)) is stable for x < 0
+        # odds ratio in log space; log1p(-exp(x)) is stable for x < 0, and the
+        # clamp keeps x strictly negative (a fully-truncated response gives
+        # counts=0 -> logps exactly 0 -> log1p(-1) = -inf otherwise)
+        eps = jnp.asarray(-1e-6, chosen_logps.dtype)
         log_odds = (chosen_logps - rejected_logps) - (
-            jnp.log1p(-jnp.exp(chosen_logps)) - jnp.log1p(-jnp.exp(rejected_logps))
+            jnp.log1p(-jnp.exp(jnp.minimum(chosen_logps, eps)))
+            - jnp.log1p(-jnp.exp(jnp.minimum(rejected_logps, eps)))
         )
         ratio = jax.nn.log_sigmoid(log_odds)
         or_loss = -(cfg.beta * ratio).mean()

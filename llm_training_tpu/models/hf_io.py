@@ -1,0 +1,242 @@
+"""HuggingFace checkpoint IO: streamed loading + safetensors export.
+
+Capability parity: the reference's pre-trained-weight path
+(`lms/base_lm.py:175-193` — rank-0 `torch.load` + broadcast/scatter) and the
+export half of `scripts/convert_to_hf.py:101-162`. TPU-native design: instead
+of loading everything on one rank and broadcasting over NCCL, each tensor is
+read lazily from safetensors and `jax.device_put` with its `NamedSharding` —
+every host reads only once, XLA scatters the shards over ICI, and the host
+working set stays one-tensor-sized.
+
+Reading goes through the torch framework of `safetensors` (torch is CPU-only
+here) so bf16 files round-trip exactly; writing uses `safetensors.torch` with
+`{"format": "pt"}` metadata, which is what `transformers.from_pretrained`
+expects.
+"""
+
+from __future__ import annotations
+
+import json
+from collections.abc import Mapping
+from pathlib import Path
+from typing import Any, Iterator
+
+import jax
+import numpy as np
+
+_SAFE_INDEX = "model.safetensors.index.json"
+_SAFE_SINGLE = "model.safetensors"
+
+# config-class name -> conversion module; each module provides
+# params_from_hf / params_to_hf / config_from_hf / config_to_hf
+_FAMILIES: dict[str, str] = {
+    "LlamaConfig": "llm_training_tpu.models.llama.hf_conversion",
+    "Phi3Config": "llm_training_tpu.models.phi3.hf_conversion",
+}
+
+
+def conversion_module(config: Any):
+    import importlib
+
+    name = type(config).__name__
+    if name not in _FAMILIES:
+        raise ValueError(
+            f"no HF conversion registered for {name}; known: {sorted(_FAMILIES)}"
+        )
+    return importlib.import_module(_FAMILIES[name])
+
+
+class LazyStateDict(Mapping):
+    """Mapping over one or more safetensors files that reads each tensor on
+    first access (and never holds more than the caller keeps alive)."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self._key_to_file: dict[str, Path] = {}
+        self._handles: dict[Path, Any] = {}
+        for file, keys in self._discover():
+            for key in keys:
+                self._key_to_file[key] = file
+
+    def _discover(self) -> Iterator[tuple[Path, list[str]]]:
+        from safetensors import safe_open
+
+        if self.path.is_file():
+            files = [self.path]
+        elif (self.path / _SAFE_INDEX).exists():
+            index = json.loads((self.path / _SAFE_INDEX).read_text())
+            files = sorted({self.path / f for f in index["weight_map"].values()})
+        elif (self.path / _SAFE_SINGLE).exists():
+            files = [self.path / _SAFE_SINGLE]
+        else:
+            files = sorted(self.path.glob("*.safetensors"))
+        if not files:
+            raise FileNotFoundError(
+                f"no safetensors found under {self.path} "
+                f"(expected {_SAFE_SINGLE} or {_SAFE_INDEX})"
+            )
+        for file in files:
+            with safe_open(file, framework="pt") as f:
+                yield file, list(f.keys())
+
+    def _handle(self, file: Path):
+        from safetensors import safe_open
+
+        if file not in self._handles:
+            self._handles[file] = safe_open(file, framework="pt")
+        return self._handles[file]
+
+    def __getitem__(self, key: str):
+        return self._handle(self._key_to_file[key]).get_tensor(key)
+
+    def __iter__(self):
+        return iter(self._key_to_file)
+
+    def __len__(self) -> int:
+        return len(self._key_to_file)
+
+
+def load_hf_config(path: str | Path) -> dict:
+    config_file = Path(path) / "config.json" if Path(path).is_dir() else Path(path)
+    return json.loads(config_file.read_text())
+
+
+def load_pretrained_params(
+    config: Any,
+    hf_path: str | Path,
+    shardings: Any | None = None,
+    dtypes: Any | None = None,
+) -> Any:
+    """HF checkpoint dir -> flax param tree `{'params': ...}`.
+
+    When `shardings` (a matching pytree of NamedSharding) is given, each leaf
+    is `device_put` straight to its shards and the host copy is dropped —
+    the memory-safe analogue of the reference's broadcast distribution
+    (`base_lm.py:175-193`). `dtypes` (matching pytree or single dtype) casts
+    leaves on the way in (e.g. fp32 master params from a bf16 checkpoint).
+    """
+    conv = conversion_module(config)
+    state_dict = LazyStateDict(hf_path)
+
+    if shardings is None and dtypes is None:
+        return conv.params_from_hf(state_dict, config)
+
+    by_path = _flatten_by_path(shardings)
+    dtypes_by_path = (
+        _flatten_by_path(dtypes) if _is_pytree(dtypes) else None
+    )
+
+    def leaf_fn(path: tuple[str, ...], value: np.ndarray):
+        key = ("params",) + path
+        dtype = dtypes_by_path[key] if dtypes_by_path is not None else dtypes
+        if dtype is not None:
+            value = value.astype(dtype)
+        sharding = by_path.get(key) if by_path is not None else None
+        if sharding is not None:
+            return jax.device_put(value, sharding)
+        return value
+
+    # each converted leaf is placed (device_put) inside the conversion walk,
+    # so the host never holds more than one (stacked) tensor at a time
+    return conv.params_from_hf(state_dict, config, leaf_fn=leaf_fn)
+
+
+def _is_pytree(value: Any) -> bool:
+    return isinstance(value, (dict, list, tuple))
+
+
+def _flatten_by_path(tree: Any) -> dict[tuple[str, ...], Any] | None:
+    """pytree -> {('params', 'embed_tokens', ...): leaf} with string keys."""
+    if tree is None:
+        return None
+    flat: dict[tuple[str, ...], Any] = {}
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        flat[tuple(str(getattr(k, "key", k)) for k in key_path)] = leaf
+    return flat
+
+
+def _as_torch_state_dict(state_dict: Mapping[str, np.ndarray], dtype: str):
+    import torch
+
+    torch_dtype = getattr(torch, dtype)
+    out = {}
+    for key, value in state_dict.items():
+        array = np.asarray(value)
+        if array.dtype.name == "bfloat16":  # ml_dtypes bf16: torch can't ingest it
+            array = array.astype(np.float32)
+        out[key] = torch.from_numpy(np.ascontiguousarray(array)).to(torch_dtype)
+    return out
+
+
+def save_hf_checkpoint(
+    params: Mapping,
+    config: Any,
+    output_dir: str | Path,
+    dtype: str = "bfloat16",
+    max_shard_bytes: int = 5 * 1024**3,
+    generation_config: dict | None = None,
+) -> Path:
+    """flax params + config -> HF-layout dir (safetensors shards + index +
+    config.json). Reference: `scripts/convert_to_hf.py:76-97`."""
+    from safetensors.torch import save_file
+
+    conv = conversion_module(config)
+    output_dir = Path(output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+
+    state_dict = _as_torch_state_dict(conv.params_to_hf(params, config), dtype)
+
+    # shard greedily in key order, HF-style file naming
+    shards: list[dict[str, Any]] = [{}]
+    sizes = [0]
+    for key, tensor in state_dict.items():
+        nbytes = tensor.numel() * tensor.element_size()
+        if sizes[-1] + nbytes > max_shard_bytes and shards[-1]:
+            shards.append({})
+            sizes.append(0)
+        shards[-1][key] = tensor
+        sizes[-1] += nbytes
+
+    if len(shards) == 1:
+        save_file(shards[0], output_dir / _SAFE_SINGLE, metadata={"format": "pt"})
+    else:
+        weight_map = {}
+        for i, shard in enumerate(shards):
+            name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+            save_file(shard, output_dir / name, metadata={"format": "pt"})
+            weight_map.update({key: name for key in shard})
+        index = {
+            "metadata": {"total_size": sum(sizes)},
+            "weight_map": weight_map,
+        }
+        (output_dir / _SAFE_INDEX).write_text(json.dumps(index, indent=2))
+
+    hf_config = conv.config_to_hf(config, torch_dtype=dtype)
+    (output_dir / "config.json").write_text(json.dumps(hf_config, indent=2) + "\n")
+    if generation_config:
+        (output_dir / "generation_config.json").write_text(
+            json.dumps(generation_config, indent=2) + "\n"
+        )
+    return output_dir
+
+
+_ARCH_TO_FAMILY = {
+    # HF model_type -> our (model class path, conversion config name)
+    "llama": "llm_training_tpu.models.Llama",
+    "mistral": "llm_training_tpu.models.Llama",  # same graph: GQA + SwiGLU + RMSNorm
+    "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
+    "phi3": "llm_training_tpu.models.Phi3",
+}
+
+
+def model_class_for_hf(hf_config: dict) -> str:
+    """HF `config.json` -> our model class path (the `HFCausalLM` analogue,
+    reference `models/hf_causal_lm/hf_causal_lm.py:22`, for architectures
+    whose computation graph one of our TPU modules reproduces)."""
+    model_type = hf_config.get("model_type")
+    if model_type not in _ARCH_TO_FAMILY:
+        raise ValueError(
+            f"unsupported HF model_type {model_type!r}; supported: "
+            f"{sorted(_ARCH_TO_FAMILY)}"
+        )
+    return _ARCH_TO_FAMILY[model_type]

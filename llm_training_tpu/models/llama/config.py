@@ -36,6 +36,9 @@ class LlamaConfig(BaseModelConfig):
     attention_dropout: float = 0.0
     mlp_bias: bool = False
     rope_scaling: dict[str, Any] | None = None
+    # Mistral/Qwen2-style local attention (None = full causal); consumed by
+    # LlamaAttention via ops.dot_product_attention's sliding_window arg
+    sliding_window: int | None = None
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"

@@ -54,21 +54,36 @@ def _get_path(tree: Mapping, path: tuple[str, ...]) -> Any:
 
 def _to_numpy(tensor: Any) -> np.ndarray:
     if hasattr(tensor, "detach"):  # torch tensor
-        tensor = tensor.detach().to("cpu").float().numpy()
+        import torch
+
+        tensor = tensor.detach().to("cpu")
+        if tensor.dtype == torch.bfloat16:
+            # keep bf16 (ml_dtypes view) — no fp32 upcast doubling host memory
+            import ml_dtypes
+
+            return tensor.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        tensor = tensor.float().numpy()
     return np.asarray(tensor)
 
 
 def params_from_hf(
-    state_dict: Mapping[str, Any], config: LlamaConfig
+    state_dict: Mapping[str, Any], config: LlamaConfig, leaf_fn: Any = None
 ) -> dict:
-    """HF `model.*` state dict -> flax param tree (unboxed numpy leaves)."""
+    """HF `model.*` state dict -> flax param tree (unboxed numpy leaves).
+
+    `leaf_fn(path, value)` (if given) transforms each leaf as soon as it is
+    built — the streaming hook hf_io uses to `device_put` each tensor and
+    drop the host copy before the next one is read."""
     params: dict = {}
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
 
-    _set_path(params, ("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
-    _set_path(params, ("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    def put(path: tuple[str, ...], value: np.ndarray) -> None:
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
     if not config.tie_word_embeddings:
-        _set_path(params, ("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
     layer_params = list(_LAYER_PARAMS)
     if config.attention_bias:
@@ -83,11 +98,11 @@ def params_from_hf(
             stacked = np.stack(
                 [layer_value(i, hf_name, transpose) for i in range(config.num_hidden_layers)]
             )
-            _set_path(params, ("layers", "layer") + path, stacked)
+            put(("layers", "layer") + path, stacked)
     else:
         for i in range(config.num_hidden_layers):
             for path, hf_name, transpose in layer_params:
-                _set_path(params, (f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
+                put((f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
     return {"params": params}
 
 
@@ -120,6 +135,45 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     return out
 
 
+def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    """Our LlamaConfig -> HF `config.json` dict (reference `get_hf_model`,
+    `hf_compat_model.py:113-119`, exports an HF config alongside weights)."""
+    return {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.resolved_head_dim,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": config.attention_dropout,
+        "mlp_bias": config.mlp_bias,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+        # emitted as mistral when local attention is on (HF LlamaConfig has
+        # no sliding_window; MistralConfig shares the weight layout)
+        **(
+            {"model_type": "mistral", "architectures": ["MistralForCausalLM"],
+             "sliding_window": config.sliding_window}
+            if config.sliding_window
+            else {}
+        ),
+    }
+
+
 def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
     """HF LlamaConfig (object or dict) -> our LlamaConfig.
 
@@ -150,4 +204,11 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_dropout=get("attention_dropout", 0.0),
         mlp_bias=get("mlp_bias", False),
         rope_scaling=get("rope_scaling"),
+        # Mistral sets sliding_window unconditionally; Qwen2 gates it behind
+        # use_sliding_window (default False)
+        sliding_window=(
+            get("sliding_window")
+            if get("use_sliding_window", get("model_type") != "qwen2")
+            else None
+        ),
     ), **overrides})

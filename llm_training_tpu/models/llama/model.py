@@ -249,8 +249,13 @@ class Llama(nn.Module):
 
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
-        # host-side rotary tables (static config -> numpy)
-        inv_freq, attention_scaling = compute_rope_frequencies(cfg.rope_config)
+        # host-side rotary tables (static config -> numpy); seq is static at
+        # trace time, so seq-dependent variants (dynamic NTK, longrope
+        # short/long factor selection — HF Phi3RotaryEmbedding semantics)
+        # resolve per compiled shape
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
         hidden = self._layers(hidden, segment_ids, cos, sin)

@@ -33,14 +33,19 @@ def _qkv_splits(config: Phi3Config) -> tuple[int, int]:
     return q, kv
 
 
-def params_from_hf(state_dict: Mapping[str, Any], config: Phi3Config) -> dict:
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: Phi3Config, leaf_fn: Any = None
+) -> dict:
     params: dict = {}
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
 
-    _set_path(params, ("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
-    _set_path(params, ("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    def put(path: tuple[str, ...], value: np.ndarray) -> None:
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
     if not config.tie_word_embeddings:
-        _set_path(params, ("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
     q_size, kv_size = _qkv_splits(config)
     inter = config.intermediate_size
@@ -63,12 +68,12 @@ def params_from_hf(state_dict: Mapping[str, Any], config: Phi3Config) -> dict:
     layers = [layer_parts(i) for i in range(config.num_hidden_layers)]
     if config.scan_layers:
         for path in layers[0]:
-            _set_path(params, ("layers", "layer") + path,
-                      np.stack([layer[path] for layer in layers]))
+            put(("layers", "layer") + path,
+                np.stack([layer[path] for layer in layers]))
     else:
         for i, layer in enumerate(layers):
             for path, value in layer.items():
-                _set_path(params, (f"layers_{i}",) + path, value)
+                put((f"layers_{i}",) + path, value)
     return {"params": params}
 
 
@@ -112,6 +117,38 @@ def params_to_hf(params: Mapping, config: Phi3Config) -> dict[str, np.ndarray]:
             value = get(path)
             out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
     return out
+
+
+def config_to_hf(config: Phi3Config, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    """Our Phi3Config -> HF `config.json` dict."""
+    return {
+        "architectures": ["Phi3ForCausalLM"],
+        "model_type": "phi3",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "original_max_position_embeddings": config.original_max_position_embeddings
+        or config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "sliding_window": config.sliding_window,
+        "attention_dropout": 0.0,
+        "embd_pdrop": 0.0,
+        "resid_pdrop": 0.0,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
 
 
 def config_from_hf(hf_config: Any, **overrides: Any) -> Phi3Config:

@@ -189,7 +189,25 @@ class Trainer:
             if restored is not None:
                 state, meta = restored
                 self.counters.update(meta.get("counters", {}))
-        if state is None:
+        pre_trained = (
+            objective.pretrained_source()
+            if hasattr(objective, "pretrained_source")
+            else None
+        )
+        if state is None and pre_trained and objective.config.load_weights:
+            # stream HF weights straight into sharded buffers (reference
+            # rank-0-load + broadcast, base_lm.py:175-193)
+            logger.info("loading pre-trained weights from %s", pre_trained)
+            dtypes = jax.tree.map(lambda leaf: leaf.dtype, abstract_state.params)
+            params = objective.pretrained_params(self.state_shardings.params, dtypes)
+            opt_state = jax.jit(
+                tx.init, out_shardings=self.state_shardings.opt_state
+            )(params)
+            state = jax.device_put(
+                TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1)),
+                self.state_shardings,
+            )
+        elif state is None:
             logger.info("initializing parameters on the mesh")
 
             def make_state(rng):

@@ -1,0 +1,137 @@
+"""Convert a training checkpoint to a HuggingFace model directory.
+
+Capability parity: reference `scripts/convert_to_hf.py` — checkpoint (any
+flavor) -> `save_pretrained` layout including tokenizer + chat template. The
+model is rebuilt from the config *embedded in the checkpoint* (reference
+`save_config_callback.py:43-45`), so no original YAML is needed.
+
+Usage:
+    python scripts/convert_to_hf.py <checkpoint_dir> <output_dir> \
+        [--step N] [--dtype bfloat16] [--tokenizer PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+logger = logging.getLogger("convert_to_hf")
+
+
+def load_checkpoint(ckpt_dir: Path, step: int | None):
+    """Restore ONLY the params subtree (+ meta JSON) — an AdamW state dir is
+    ~3x params, and DPO adds the frozen ref; exporting needs neither."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(
+        ckpt_dir.absolute(), item_names=("state", "meta")
+    ) as manager:
+        step = step if step is not None else manager.latest_step()
+        if step is None:
+            raise SystemExit(f"no checkpoint steps found in {ckpt_dir}")
+        logger.info("reading step %d from %s", step, ckpt_dir)
+        meta = manager.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )["meta"]
+
+    state_dir = ckpt_dir.absolute() / str(step) / "state"
+    ckptr = ocp.PyTreeCheckpointer()
+    tree = ckptr.metadata(state_dir).item_metadata.tree
+
+    def is_array_meta(x) -> bool:
+        return hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, dict)
+
+    abstract = jax.tree.map(
+        lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+        tree["params"],
+        is_leaf=is_array_meta,
+    )
+    restored = ckptr.restore(
+        state_dir,
+        args=ocp.args.PyTreeRestore(item={"params": abstract}, partial_restore=True),
+    )
+    return restored["params"], meta
+
+
+def convert_checkpoint(
+    ckpt_dir: str | Path,
+    output_dir: str | Path,
+    step: int | None = None,
+    dtype: str = "bfloat16",
+    tokenizer_path: str | None = None,
+) -> Path:
+    from llm_training_tpu.cli.config import instantiate_from_config
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    params, meta = load_checkpoint(Path(ckpt_dir), step)
+    run_config = meta.get("config") or {}
+    if "model" not in run_config:
+        raise SystemExit(
+            "checkpoint has no embedded config; pass a checkpoint written by "
+            "`llm-training-tpu fit`"
+        )
+    objective = instantiate_from_config(
+        run_config["model"], default_class="llm_training_tpu.lms.CLM"
+    )
+
+    if isinstance(params, dict) and "policy" in params:  # DPO: export the policy
+        params = params["policy"]
+
+    out = save_hf_checkpoint(params, objective.model.config, output_dir, dtype=dtype)
+    logger.info("weights + config.json written to %s", out)
+
+    tokenizer_src = tokenizer_path or _tokenizer_from_config(run_config)
+    if tokenizer_src is not None:
+        _export_tokenizer(tokenizer_src, run_config, out)
+    else:
+        logger.warning("no tokenizer in config and none given; skipping tokenizer export")
+    return out
+
+
+def _tokenizer_from_config(run_config: dict):
+    init_args = (run_config.get("data") or {}).get("init_args") or {}
+    tokenizer = init_args.get("tokenizer")
+    if isinstance(tokenizer, dict):
+        return tokenizer.get("path")
+    return tokenizer
+
+
+def _export_tokenizer(tokenizer_src, run_config: dict, out: Path) -> None:
+    from llm_training_tpu.data.tokenizer import resolve_tokenizer
+
+    tokenizer = resolve_tokenizer(tokenizer_src)
+    init_args = (run_config.get("data") or {}).get("init_args") or {}
+    template_name = init_args.get("chat_template")
+    if template_name:
+        from llm_training_tpu.data.chat_templates import get_chat_template
+
+        tokenizer.chat_template = get_chat_template(template_name)
+    tokenizer.save_pretrained(out)
+    logger.info("tokenizer written to %s", out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_dir")
+    parser.add_argument("--step", type=int, default=None)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["bfloat16", "float16", "float32"])
+    parser.add_argument("--tokenizer", default=None,
+                        help="tokenizer path (defaults to the one in the embedded config)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(levelname)s %(name)s: %(message)s")
+    convert_checkpoint(
+        args.checkpoint_dir, args.output_dir,
+        step=args.step, dtype=args.dtype, tokenizer_path=args.tokenizer,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -103,6 +103,35 @@ def test_longrope_factor_defaulting():
         )
 
 
+def test_longrope_short_long_parity_with_hf():
+    """HF selects short_factor for seq <= original_max and long_factor above;
+    our seq_len-aware frequency computation must match both regimes."""
+    torch = pytest.importorskip("torch")
+    dim = (TINY["hidden_size"] // TINY["num_attention_heads"]) // 2
+    rope_scaling = {  # HF Phi3Config validator wants the legacy 'type' key
+        "type": "longrope",
+        "short_factor": [1.0 + 0.05 * i for i in range(dim)],
+        "long_factor": [2.0 + 0.1 * i for i in range(dim)],
+    }
+    hf_model, hf_config = _hf_tiny_phi3(  # TINY already has max_position=64
+        original_max_position_embeddings=16,
+        rope_scaling=rope_scaling,
+    )
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Phi3(cfg)
+
+    for seq in (12, 32):  # short regime (<=16) and long regime (>16)
+        ids = np.random.default_rng(seq).integers(0, TINY["vocab_size"], (1, seq))
+        with torch.no_grad():
+            hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+        ours = model.apply(params, jnp.asarray(ids)).logits
+        np.testing.assert_allclose(
+            np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4,
+            err_msg=f"seq={seq}",
+        )
+
+
 def test_attention_compute_dtype():
     cfg = Phi3Config(**TINY, compute_dtype="bfloat16", attention_compute_dtype="float32")
     ids = jnp.ones((1, 8), jnp.int32)
