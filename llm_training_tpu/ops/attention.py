@@ -89,6 +89,11 @@ def _xla_attention(
     if mask is not None:
         scores = jnp.where(mask[:, :, None], scores, _MASK_VALUE)
     probs = jax.nn.softmax(scores, axis=-1)
+    if mask is not None:
+        # fully-masked rows (padding / empty ring chunks) emit exactly 0, not
+        # the mean of v that a softmax over all-masked scores would give —
+        # the invariant the flash kernel and ring combiner provide
+        probs = jnp.where(mask[:, :, None].any(-1, keepdims=True), probs, 0.0)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
     return out.reshape(batch, q_len, num_q_heads, head_dim)
 
@@ -116,9 +121,8 @@ def dot_product_attention(
         used with q_len != kv_len (e.g. ring-attention chunks).
     q_offset: absolute position of query row 0 within the kv sequence, for
         causal masking of cross-length chunks.
-    impl: 'auto' (pallas on TPU with XLA fallback) | 'xla' | 'pallas'
-        (explicit 'pallas' raises if the kernel can't handle the case —
-        no silent degradation).
+    impl: 'auto' (pallas flash kernel on TPU, einsum path elsewhere) |
+        'xla' | 'pallas' (forced; interpreted off-TPU).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -134,21 +138,16 @@ def dot_product_attention(
     if use_pallas:
         from llm_training_tpu.ops.pallas.flash_attention import flash_attention
 
-        try:
-            return flash_attention(
-                q, k, v,
-                segment_ids=segment_ids,
-                q_segment_ids=q_segment_ids,
-                causal=causal,
-                sliding_window=sliding_window,
-                logits_soft_cap=logits_soft_cap,
-                scale=scale,
-                q_offset=q_offset,
-            )
-        except NotImplementedError:
-            if impl == "pallas":
-                raise
-            # 'auto' only: fall through to the XLA reference path.
+        return flash_attention(
+            q, k, v,
+            segment_ids=segment_ids,
+            q_segment_ids=q_segment_ids,
+            causal=causal,
+            sliding_window=sliding_window,
+            logits_soft_cap=logits_soft_cap,
+            scale=scale,
+            q_offset=q_offset,
+        )
 
     mask = None
     if segment_ids is not None or causal or sliding_window is not None:

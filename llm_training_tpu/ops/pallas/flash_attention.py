@@ -2,15 +2,464 @@
 
 TPU-native replacement for the reference's flash-attn CUDA dispatch
 (`ops/attention_op.py:538-654`): causal, GQA, sliding window, soft-cap, and
-packed varlen via segment ids instead of unpad/cu_seqlens.
+packed varlen via segment ids instead of unpad/cu_seqlens. The reference's
+block-diagonal packed mask (`attention_op.py:305-314`) becomes a block-level
+segment-id comparison inside the kernel; its `_upad_input`/`pad_input`
+round-trip (`attention_op.py:415-485`) has no analogue — packed rows stay
+dense and static-shaped, which is what XLA wants anyway.
 
-Placeholder: the kernel lands with the Pallas kernel milestone; callers fall
-back to the XLA path via NotImplementedError until then.
+Design (standard flash attention 2 tiling, TPU-shaped):
+  forward: grid (batch*q_heads, q_blocks, kv_blocks), kv innermost
+    ("arbitrary"), online-softmax state (m, l, acc) carried in VMEM scratch
+    across kv iterations; returns O and the row logsumexp for the backward.
+  backward dQ: same grid; recomputes P from (Q, K, LSE), accumulates
+    dQ = sum_j dS_ij K_j in scratch.
+  backward dK/dV: grid (batch*kv_heads, kv_blocks, gqa_group, q_blocks) —
+    the GQA group axis is folded into the kernel grid so dK/dV accumulate
+    over the query heads sharing a kv head without an XLA-level reduction.
+
+Causal/sliding-window block skipping: fully-masked (q_block, kv_block) tiles
+are skipped with `pl.when`, so causal attention does ~half the FLOPs and a
+sliding-window run is linear in window size — the reason flash-attn varlen
+wins in the reference, reproduced at the tile level.
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+_LANES = 128
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _block_mask(
+    i,
+    j,
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    causal: bool,
+    sliding_window: int | None,
+    seg_q,
+    seg_kv,
+):
+    """(block_q, block_k) boolean mask (True = attend) for tile (i, j)."""
+    q_pos = (
+        i * block_q
+        + q_offset
+        + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    )
+    k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    mask = (seg_q[:, None] == seg_kv[None, :]) & (seg_q[:, None] > 0)
+    if causal:
+        mask &= k_pos <= q_pos
+    if sliding_window is not None:
+        mask &= q_pos - k_pos < sliding_window
+    return mask
+
+
+def _should_visit(
+    i,
+    j,
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    causal: bool,
+    sliding_window: int | None,
+):
+    """Tile-level skip predicate: False when tile (i, j) is fully masked by
+    position alone (segments can only mask further)."""
+    visit = jnp.bool_(True)
+    q_lo = i * block_q + q_offset
+    q_hi = q_lo + block_q - 1
+    k_lo = j * block_k
+    k_hi = k_lo + block_k - 1
+    if causal:
+        visit &= k_lo <= q_hi
+    if sliding_window is not None:
+        visit &= q_lo - k_hi < sliding_window
+    return visit
+
+
+def _scores(q, k, scale: float, logits_soft_cap: float | None):
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s = s * scale
+    if logits_soft_cap is not None:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+    return s
+
+
+def _fwd_kernel(
+    q_seg_ref,
+    kv_seg_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    o_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    sliding_window: int | None,
+    logits_soft_cap: float | None,
+    q_offset: int,
+    block_q: int,
+    block_k: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _MASK_VALUE)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(_should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window))
+    def _visit():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        seg_q = q_seg_ref[0, 0]
+        seg_kv = kv_seg_ref[0, 0]
+
+        s = _scores(q, k, scale, logits_soft_cap)
+        mask = _block_mask(
+            i, j, block_q, block_k, q_offset, causal, sliding_window, seg_q, seg_kv
+        )
+        s = jnp.where(mask, s, _MASK_VALUE)
+
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        # explicit zeroing (not just the additive mask) keeps fully-masked
+        # rows exactly at l == 0 so padding rows emit O = 0, LSE = -inf
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        m_scr[:, :1] = m_new
+        l_scr[:, :1] = l_new
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _flush():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(l_safe), -jnp.inf)
+        lse_ref[0, 0] = lse[:, 0]
+
+
+def _dq_kernel(
+    q_seg_ref,
+    kv_seg_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    scale: float,
+    causal: bool,
+    sliding_window: int | None,
+    logits_soft_cap: float | None,
+    q_offset: int,
+    block_q: int,
+    block_k: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(_should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window))
+    def _visit():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+
+        s = _scores(q, k, scale, logits_soft_cap)
+        mask = _block_mask(
+            i, j, block_q, block_k, q_offset, causal, sliding_window,
+            q_seg_ref[0, 0], kv_seg_ref[0, 0],
+        )
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        if logits_soft_cap is not None:
+            ds = ds * (1.0 - (s / logits_soft_cap) ** 2)
+        ds = ds * scale
+        dq_scr[:] += jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _flush():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_seg_ref,
+    kv_seg_ref,
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    scale: float,
+    causal: bool,
+    sliding_window: int | None,
+    logits_soft_cap: float | None,
+    q_offset: int,
+    block_q: int,
+    block_k: int,
+):
+    j = pl.program_id(1)
+    g = pl.program_id(2)
+    i = pl.program_id(3)
+    ng = pl.num_programs(2)
+    nq = pl.num_programs(3)
+
+    @pl.when((g == 0) & (i == 0))
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window))
+    def _visit():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+
+        s = _scores(q, k, scale, logits_soft_cap)
+        mask = _block_mask(
+            i, j, block_q, block_k, q_offset, causal, sliding_window,
+            q_seg_ref[0, 0], kv_seg_ref[0, 0],
+        )
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        # dV_j += P^T dO ; contraction over the q rows (dim 0 of both)
+        dv_scr[:] += lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        if logits_soft_cap is not None:
+            ds = ds * (1.0 - (s / logits_soft_cap) ** 2)
+        ds = ds * scale
+        dk_scr[:] += lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when((g == ng - 1) & (i == nq - 1))
+    def _flush():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _make_attention(
+    *,
+    num_q_heads: int,
+    num_kv_heads: int,
+    scale: float,
+    causal: bool,
+    sliding_window: int | None,
+    logits_soft_cap: float | None,
+    q_offset: int,
+    block_q: int,
+    block_k: int,
+    interpret: bool,
+):
+    """Build the custom-VJP flash attention over padded flat inputs:
+    q [B*Hq, Sq, D], k/v [B*Hkv, Skv, D], seg_q [B, Sq], seg_kv [B, Skv]."""
+    group = num_q_heads // num_kv_heads
+
+    def kv_bh(bh_idx):
+        """Flat q batch-head index -> flat kv batch-head index (GQA)."""
+        return (bh_idx // num_q_heads) * num_kv_heads + (
+            bh_idx % num_q_heads
+        ) // group
+
+    def q_bh(bhk, g):
+        """Flat kv batch-head index + group member -> flat q batch-head."""
+        return (bhk // num_kv_heads) * num_q_heads + (bhk % num_kv_heads) * group + g
+
+    hyper = dict(
+        scale=scale,
+        causal=causal,
+        sliding_window=sliding_window,
+        logits_soft_cap=logits_soft_cap,
+        q_offset=q_offset,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+    def fwd_pallas(q, k, v, seg_q, seg_kv):
+        bh, sq, d = q.shape
+        skv = k.shape[1]
+        nq, nk = sq // block_q, skv // block_k
+        grid = (bh, nq, nk)
+
+        o, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, **hyper),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(seg_q[:, None], seg_kv[:, None], q, k, v)
+        return o, lse[:, 0]
+
+    def bwd_pallas(q, k, v, seg_q, seg_kv, o, lse, do):
+        bh, sq, d = q.shape
+        skv = k.shape[1]
+        nq, nk = sq // block_q, skv // block_k
+        bh_kv = k.shape[0]
+
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        )  # [bh, sq]
+
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, **hyper),
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
+                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
+
+        # q-side refs are indexed by (kv batch-head, group member): the GQA
+        # reduction over the q heads sharing one kv head happens in scratch
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, **hyper),
+            grid=(bh_kv, nk, group, nq),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q), lambda b, j, g, i: (b // num_kv_heads, 0, i)
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k), lambda b, j, g, i: (b // num_kv_heads, 0, j)
+                ),
+                pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
+            ),
+            interpret=interpret,
+        )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
+        return dq, dk, dv
+
+    @jax.custom_vjp
+    def attention(q, k, v, seg_q, seg_kv):
+        o, _ = fwd_pallas(q, k, v, seg_q, seg_kv)
+        return o
+
+    def attention_fwd(q, k, v, seg_q, seg_kv):
+        o, lse = fwd_pallas(q, k, v, seg_q, seg_kv)
+        return o, (q, k, v, seg_q, seg_kv, o, lse)
+
+    def attention_bwd(res, do):
+        q, k, v, seg_q, seg_kv, o, lse = res
+        dq, dk, dv = bwd_pallas(q, k, v, seg_q, seg_kv, o, lse, do)
+        return dq, dk, dv, None, None
+
+    attention.defvjp(attention_fwd, attention_bwd)
+    return attention
 
 
 def flash_attention(
@@ -24,5 +473,78 @@ def flash_attention(
     logits_soft_cap: float | None = None,
     scale: float | None = None,
     q_offset: int = 0,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
 ) -> jnp.ndarray:
-    raise NotImplementedError("pallas flash attention kernel not yet implemented")
+    """Flash attention over packed sequences.
+
+    q: [batch, q_len, num_q_heads, head_dim]; k/v: [batch, kv_len,
+    num_kv_heads, head_dim]; segment ids as in
+    `llm_training_tpu.ops.attention.dot_product_attention` (0 = padding).
+    Runs compiled on TPU, interpreted elsewhere (tests).
+    """
+    batch, q_len, num_q_heads, head_dim = q.shape
+    kv_len, num_kv_heads = k.shape[1], k.shape[2]
+    if num_q_heads % num_kv_heads != 0:
+        raise ValueError(
+            f"num_q_heads ({num_q_heads}) not divisible by num_kv_heads ({num_kv_heads})"
+        )
+    if scale is None:
+        scale = head_dim**-0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    orig_dtype = q.dtype
+
+    if q_segment_ids is None:
+        if segment_ids is not None and q_len != kv_len:
+            raise ValueError(
+                "q_segment_ids is required when segment_ids is given and "
+                f"q_len ({q_len}) != kv_len ({kv_len})"
+            )
+        q_segment_ids = (
+            segment_ids
+            if segment_ids is not None
+            else jnp.ones((batch, q_len), jnp.int32)
+        )
+    if segment_ids is None:
+        segment_ids = jnp.ones((batch, kv_len), jnp.int32)
+    q_segment_ids = q_segment_ids.astype(jnp.int32)
+    segment_ids = segment_ids.astype(jnp.int32)
+
+    # pad sequence dims to block multiples and head_dim to the lane width;
+    # padded tokens get segment id 0, so they are masked not attended
+    block_q = min(block_q, _round_up(q_len, _LANES))
+    block_k = min(block_k, _round_up(kv_len, _LANES))
+    sq_pad = _round_up(q_len, block_q) - q_len
+    skv_pad = _round_up(kv_len, block_k) - kv_len
+    d_pad = _round_up(head_dim, _LANES) - head_dim
+    if sq_pad or d_pad:
+        q = jnp.pad(q, ((0, 0), (0, sq_pad), (0, 0), (0, d_pad)))
+        q_segment_ids = jnp.pad(q_segment_ids, ((0, 0), (0, sq_pad)))
+    if skv_pad or d_pad:
+        k = jnp.pad(k, ((0, 0), (0, skv_pad), (0, 0), (0, d_pad)))
+        v = jnp.pad(v, ((0, 0), (0, skv_pad), (0, 0), (0, d_pad)))
+        segment_ids = jnp.pad(segment_ids, ((0, 0), (0, skv_pad)))
+
+    # [B, S, H, D] -> flat [B*H, S, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(batch * num_q_heads, q_len + sq_pad, -1)
+    kf = k.transpose(0, 2, 1, 3).reshape(batch * num_kv_heads, kv_len + skv_pad, -1)
+    vf = v.transpose(0, 2, 1, 3).reshape(batch * num_kv_heads, kv_len + skv_pad, -1)
+
+    attention = _make_attention(
+        num_q_heads=num_q_heads,
+        num_kv_heads=num_kv_heads,
+        scale=scale,
+        causal=causal,
+        sliding_window=sliding_window,
+        logits_soft_cap=logits_soft_cap,
+        q_offset=q_offset,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    of = attention(qf, kf, vf, q_segment_ids, segment_ids)
+
+    o = of.reshape(batch, num_q_heads, q_len + sq_pad, -1).transpose(0, 2, 1, 3)
+    return o[:, :q_len, :, :head_dim].astype(orig_dtype)

@@ -360,7 +360,10 @@ def test_cross_length_attention_chunks():
         )
 
 
-def test_explicit_pallas_impl_raises_not_silently_degrades():
+def test_fully_masked_rows_emit_zero_xla():
+    """Padding rows (segment 0) produce exactly 0 in the XLA path — the same
+    invariant the pallas kernel and ring combiner provide."""
     q = jnp.ones((1, 4, 1, 8), jnp.float32)
-    with pytest.raises(NotImplementedError):
-        dot_product_attention(q, q, q, impl="pallas")
+    seg = jnp.asarray([[1, 1, 0, 0]])
+    out = dot_product_attention(q, q, q, segment_ids=seg, impl="xla")
+    np.testing.assert_array_equal(np.asarray(out[:, 2:]), 0.0)

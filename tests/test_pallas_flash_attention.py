@@ -1,0 +1,175 @@
+"""Pallas flash attention kernel vs the XLA reference path.
+
+The kernel runs in interpreter mode on CPU (the wrapper auto-selects), so
+these tests exercise the real kernel logic — tiling, online softmax, block
+skipping, GQA grid folding, the custom VJP — without TPU hardware. The
+reference validated its attention only implicitly through flash-attn's own
+tests (SURVEY.md §4); here packed/causal/windowed parity is asserted
+directly against the einsum path.
+"""
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_training_tpu.ops.attention import dot_product_attention
+from llm_training_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _rand(rng, shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _make_qkv(rng, batch, sq, skv, hq, hkv, d):
+    return (
+        jnp.asarray(_rand(rng, (batch, sq, hq, d))),
+        jnp.asarray(_rand(rng, (batch, skv, hkv, d))),
+        jnp.asarray(_rand(rng, (batch, skv, hkv, d))),
+    )
+
+
+def _packed_segments(rng, batch, seq, max_docs=4):
+    """Random packed segment ids: 1..N runs then 0-padding."""
+    rows = []
+    for _ in range(batch):
+        cuts = np.sort(rng.choice(np.arange(1, seq), size=max_docs - 1, replace=False))
+        row, seg = [], 1
+        prev = 0
+        for c in list(cuts) + [seq - 2]:
+            if c <= prev:
+                continue
+            row += [seg] * (c - prev)
+            seg += 1
+            prev = c
+        row += [0] * (seq - len(row))
+        rows.append(row)
+    return jnp.asarray(rows, jnp.int32)
+
+
+CASES = [
+    # (name, hq, hkv, sliding_window, soft_cap, packed)
+    ("causal", 4, 4, None, None, False),
+    ("gqa", 4, 2, None, None, False),
+    ("packed_gqa", 4, 2, None, None, True),
+    ("window", 2, 2, 37, None, False),
+    ("softcap", 2, 2, None, 20.0, False),
+    ("everything", 4, 2, 50, 30.0, True),
+]
+
+
+@pytest.mark.parametrize("name,hq,hkv,window,cap,packed", CASES, ids=[c[0] for c in CASES])
+def test_forward_matches_xla(name, hq, hkv, window, cap, packed):
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    batch, seq, d = 2, 256, 32
+    q, k, v = _make_qkv(rng, batch, seq, seq, hq, hkv, d)
+    seg = _packed_segments(rng, batch, seq) if packed else None
+
+    kwargs = dict(segment_ids=seg, causal=True, sliding_window=window, logits_soft_cap=cap)
+    expected = dot_product_attention(q, k, v, impl="xla", **kwargs)
+    got = flash_attention(q, k, v, block_q=128, block_k=128, **kwargs)
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_gradients_match_xla():
+    rng = np.random.default_rng(0)
+    batch, seq, hq, hkv, d = 1, 256, 4, 2, 32
+    q, k, v = _make_qkv(rng, batch, seq, seq, hq, hkv, d)
+    seg = _packed_segments(rng, batch, seq)
+    cot = jnp.asarray(_rand(rng, (batch, seq, hq, d)))
+
+    def loss(fn, q, k, v):
+        return (fn(q, k, v) * cot).sum()
+
+    def xla(q, k, v):
+        return dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+
+    def pallas(q, k, v):
+        return flash_attention(q, k, v, segment_ids=seg, block_q=128, block_k=128)
+
+    gx = jax.grad(lambda *a: loss(xla, *a), argnums=(0, 1, 2))(q, k, v)
+    gp = jax.grad(lambda *a: loss(pallas, *a), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gx, gp, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
+def test_gradients_match_xla_softcap_window():
+    rng = np.random.default_rng(1)
+    batch, seq, h, d = 1, 128, 2, 32
+    q, k, v = _make_qkv(rng, batch, seq, seq, h, h, d)
+    cot = jnp.asarray(_rand(rng, (batch, seq, h, d)))
+    kw = dict(sliding_window=33, logits_soft_cap=25.0)
+
+    gx = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, impl="xla", **kw) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gp = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, block_q=128, block_k=128, **kw) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(gx, gp, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
+def test_unaligned_shapes_are_padded():
+    """seq/head_dim not multiples of the lane width go through the padding
+    path; result must still match the XLA path on the unpadded region."""
+    rng = np.random.default_rng(2)
+    batch, seq, h, d = 2, 200, 2, 24
+    q, k, v = _make_qkv(rng, batch, seq, seq, h, h, d)
+    expected = dot_product_attention(q, k, v, impl="xla")
+    got = flash_attention(q, k, v, block_q=128, block_k=128)
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_cross_length_chunk_matches_slice():
+    """Ring-attention chunk shape: q shorter than kv with q_offset."""
+    rng = np.random.default_rng(3)
+    seq, d = 256, 32
+    q, k, v = _make_qkv(rng, 1, seq, seq, 2, 2, d)
+    seg = _packed_segments(rng, 1, seq)
+
+    full = flash_attention(q, k, v, segment_ids=seg, block_q=128, block_k=128)
+    chunk = slice(128, 256)
+    part = flash_attention(
+        q[:, chunk], k, v,
+        segment_ids=seg, q_segment_ids=seg[:, chunk], q_offset=128,
+        block_q=128, block_k=128,
+    )
+    np.testing.assert_allclose(part, full[:, chunk], rtol=2e-3, atol=2e-3)
+
+
+def test_fully_masked_rows_emit_zero():
+    """Padding rows (segment 0) must produce exactly 0 output, not NaN —
+    the invariant ring attention's combiner relies on."""
+    rng = np.random.default_rng(4)
+    q, k, v = _make_qkv(rng, 1, 128, 128, 2, 2, 32)
+    seg = jnp.asarray([[1] * 64 + [0] * 64], jnp.int32)
+    out = flash_attention(q, k, v, segment_ids=seg, block_q=128, block_k=128)
+    assert not np.any(np.isnan(np.asarray(out)))
+    np.testing.assert_array_equal(np.asarray(out[:, 64:]), 0.0)
+
+
+def test_dispatch_uses_pallas_off_tpu():
+    """`impl='pallas'` now runs the kernel (interpreted off-TPU) instead of
+    raising, and agrees with the XLA path through the dispatcher."""
+    rng = np.random.default_rng(5)
+    q, k, v = _make_qkv(rng, 1, 128, 128, 2, 2, 32)
+    got = dot_product_attention(q, k, v, impl="pallas")
+    expected = dot_product_attention(q, k, v, impl="xla")
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_bf16_inputs():
+    rng = np.random.default_rng(6)
+    q, k, v = _make_qkv(rng, 1, 128, 128, 2, 2, 32)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    got = flash_attention(q, k, v, block_q=128, block_k=128)
+    assert got.dtype == jnp.bfloat16
+    expected = dot_product_attention(q, k, v, impl="xla")
+    np.testing.assert_allclose(
+        got.astype(np.float32), expected.astype(np.float32), rtol=5e-2, atol=5e-2
+    )
