@@ -46,6 +46,10 @@ class LlamaConfig(BaseModelConfig):
     # TPU-native knobs
     scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+    # context parallelism: shard the sequence axis and run ring attention
+    # over it (requires a mesh with sequence_parallel_size > 1); goes beyond
+    # the reference, which reaches long context via TP+SP only (SURVEY.md §5.7)
+    ring_attention: bool = False
 
     @model_validator(mode="after")
     def _validate(self) -> "LlamaConfig":

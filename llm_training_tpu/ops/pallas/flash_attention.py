@@ -42,6 +42,36 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _kv_bh_map(num_q_heads: int, num_kv_heads: int):
+    """Flat q batch-head index -> flat kv batch-head index (GQA)."""
+    group = num_q_heads // num_kv_heads
+
+    def kv_bh(bh_idx):
+        return (bh_idx // num_q_heads) * num_kv_heads + (bh_idx % num_q_heads) // group
+
+    return kv_bh
+
+
+def _q_bh_map(num_q_heads: int, num_kv_heads: int):
+    """Flat kv batch-head index + group member -> flat q batch-head index."""
+    group = num_q_heads // num_kv_heads
+
+    def q_bh(bhk, g):
+        return (bhk // num_kv_heads) * num_q_heads + (bhk % num_kv_heads) * group + g
+
+    return q_bh
+
+
+def _check_block_divisibility(sq: int, skv: int, block_q: int, block_k: int) -> None:
+    # the kernels floor the grid; a non-dividing block would silently drop
+    # trailing rows/columns (callers pad — the public wrapper and ring both do)
+    if sq % block_q or skv % block_k:
+        raise ValueError(
+            f"sequence lengths ({sq}, {skv}) must be multiples of the blocks "
+            f"({block_q}, {block_k}); pad inputs or pick dividing blocks"
+        )
+
+
 def _block_mask(
     i,
     j,
@@ -301,6 +331,171 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def flash_fwd_flat(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seg_q: jnp.ndarray,
+    seg_kv: jnp.ndarray,
+    *,
+    num_q_heads: int,
+    num_kv_heads: int,
+    scale: float,
+    causal: bool,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    q_offset: int = 0,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward kernel over flat padded inputs: q [B*Hq, Sq, D], k/v
+    [B*Hkv, Skv, D], seg_q [B, Sq], seg_kv [B, Skv]. Returns
+    (o [B*Hq, Sq, D], lse [B*Hq, Sq] fp32). Building block for both the
+    public wrapper and ring attention (which re-runs the backward with the
+    globally-combined lse)."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    _check_block_divisibility(sq, skv, block_q, block_k)
+    nq, nk = sq // block_q, skv // block_k
+    hyper = dict(
+        scale=scale, causal=causal, sliding_window=sliding_window,
+        logits_soft_cap=logits_soft_cap, q_offset=q_offset,
+        block_q=block_q, block_k=block_k,
+    )
+    kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, **hyper),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(seg_q[:, None], seg_kv[:, None], q, k, v)
+    return o, lse[:, 0]
+
+
+def flash_bwd_flat(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    seg_q: jnp.ndarray,
+    seg_kv: jnp.ndarray,
+    do: jnp.ndarray,
+    lse: jnp.ndarray,
+    delta: jnp.ndarray,
+    *,
+    num_q_heads: int,
+    num_kv_heads: int,
+    scale: float,
+    causal: bool,
+    sliding_window: int | None = None,
+    logits_soft_cap: float | None = None,
+    q_offset: int = 0,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Backward kernels over flat padded inputs. `lse`/`delta` are [B*Hq, Sq]
+    fp32 — for ring attention they are the globally-combined values, which is
+    exactly what makes per-chunk dQ/dK/dV contributions sum to the full-
+    sequence gradient."""
+    bh, sq, d = q.shape
+    skv = k.shape[1]
+    _check_block_divisibility(sq, skv, block_q, block_k)
+    nq, nk = sq // block_q, skv // block_k
+    bh_kv = k.shape[0]
+    group = num_q_heads // num_kv_heads
+    hyper = dict(
+        scale=scale, causal=causal, sliding_window=sliding_window,
+        logits_soft_cap=logits_soft_cap, q_offset=q_offset,
+        block_q=block_q, block_k=block_k,
+    )
+    kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
+    q_bh = _q_bh_map(num_q_heads, num_kv_heads)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **hyper),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
+
+    # q-side refs are indexed by (kv batch-head, group member): the GQA
+    # reduction over the q heads sharing one kv head happens in scratch
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **hyper),
+        grid=(bh_kv, nk, group, nq),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, block_q), lambda b, j, g, i: (b // num_kv_heads, 0, i)
+            ),
+            pl.BlockSpec(
+                (1, 1, block_k), lambda b, j, g, i: (b // num_kv_heads, 0, j)
+            ),
+            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
+    return dq, dk, dv
+
+
 def _make_attention(
     *,
     num_q_heads: int,
@@ -314,21 +509,10 @@ def _make_attention(
     block_k: int,
     interpret: bool,
 ):
-    """Build the custom-VJP flash attention over padded flat inputs:
-    q [B*Hq, Sq, D], k/v [B*Hkv, Skv, D], seg_q [B, Sq], seg_kv [B, Skv]."""
-    group = num_q_heads // num_kv_heads
-
-    def kv_bh(bh_idx):
-        """Flat q batch-head index -> flat kv batch-head index (GQA)."""
-        return (bh_idx // num_q_heads) * num_kv_heads + (
-            bh_idx % num_q_heads
-        ) // group
-
-    def q_bh(bhk, g):
-        """Flat kv batch-head index + group member -> flat q batch-head."""
-        return (bhk // num_kv_heads) * num_q_heads + (bhk % num_kv_heads) * group + g
-
+    """Build the custom-VJP flash attention over padded flat inputs."""
     hyper = dict(
+        num_q_heads=num_q_heads,
+        num_kv_heads=num_kv_heads,
         scale=scale,
         causal=causal,
         sliding_window=sliding_window,
@@ -336,126 +520,22 @@ def _make_attention(
         q_offset=q_offset,
         block_q=block_q,
         block_k=block_k,
+        interpret=interpret,
     )
-
-    def fwd_pallas(q, k, v, seg_q, seg_kv):
-        bh, sq, d = q.shape
-        skv = k.shape[1]
-        nq, nk = sq // block_q, skv // block_k
-        grid = (bh, nq, nk)
-
-        o, lse = pl.pallas_call(
-            functools.partial(_fwd_kernel, **hyper),
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((block_q, _LANES), jnp.float32),
-                pltpu.VMEM((block_q, _LANES), jnp.float32),
-                pltpu.VMEM((block_q, d), jnp.float32),
-            ],
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary"),
-            ),
-            interpret=interpret,
-        )(seg_q[:, None], seg_kv[:, None], q, k, v)
-        return o, lse[:, 0]
-
-    def bwd_pallas(q, k, v, seg_q, seg_kv, o, lse, do):
-        bh, sq, d = q.shape
-        skv = k.shape[1]
-        nq, nk = sq // block_q, skv // block_k
-        bh_kv = k.shape[0]
-
-        delta = jnp.sum(
-            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-        )  # [bh, sq]
-
-        dq = pl.pallas_call(
-            functools.partial(_dq_kernel, **hyper),
-            grid=(bh, nq, nk),
-            in_specs=[
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-                pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-                pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            ],
-            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary"),
-            ),
-            interpret=interpret,
-        )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
-
-        # q-side refs are indexed by (kv batch-head, group member): the GQA
-        # reduction over the q heads sharing one kv head happens in scratch
-        dk, dv = pl.pallas_call(
-            functools.partial(_dkv_kernel, **hyper),
-            grid=(bh_kv, nk, group, nq),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, block_q), lambda b, j, g, i: (b // num_kv_heads, 0, i)
-                ),
-                pl.BlockSpec(
-                    (1, 1, block_k), lambda b, j, g, i: (b // num_kv_heads, 0, j)
-                ),
-                pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-                pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
-                pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
-                pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
-            ],
-            out_specs=[
-                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-                pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-            ],
-            out_shape=[
-                jax.ShapeDtypeStruct(k.shape, k.dtype),
-                jax.ShapeDtypeStruct(v.shape, v.dtype),
-            ],
-            scratch_shapes=[
-                pltpu.VMEM((block_k, d), jnp.float32),
-                pltpu.VMEM((block_k, d), jnp.float32),
-            ],
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
-            ),
-            interpret=interpret,
-        )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
-        return dq, dk, dv
 
     @jax.custom_vjp
     def attention(q, k, v, seg_q, seg_kv):
-        o, _ = fwd_pallas(q, k, v, seg_q, seg_kv)
+        o, _ = flash_fwd_flat(q, k, v, seg_q, seg_kv, **hyper)
         return o
 
     def attention_fwd(q, k, v, seg_q, seg_kv):
-        o, lse = fwd_pallas(q, k, v, seg_q, seg_kv)
+        o, lse = flash_fwd_flat(q, k, v, seg_q, seg_kv, **hyper)
         return o, (q, k, v, seg_q, seg_kv, o, lse)
 
     def attention_bwd(res, do):
         q, k, v, seg_q, seg_kv, o, lse = res
-        dq, dk, dv = bwd_pallas(q, k, v, seg_q, seg_kv, o, lse, do)
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+        dq, dk, dv = flash_bwd_flat(q, k, v, seg_q, seg_kv, do, lse, delta, **hyper)
         return dq, dk, dv, None, None
 
     attention.defvjp(attention_fwd, attention_bwd)

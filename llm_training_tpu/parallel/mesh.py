@@ -94,6 +94,19 @@ def build_mesh(
     return mesh
 
 
+def active_mesh() -> Mesh | None:
+    """The mesh installed by the enclosing `with mesh:` block (how model code
+    reaches the trainer's mesh without threading it through flax modules).
+
+    Reaches into jax._src because the public accessor
+    (jax.interpreters.pxla.thread_resources) is deprecated since JAX 0.8.2
+    with no replacement; validated against JAX 0.9.0."""
+    from jax._src import mesh as mesh_lib
+
+    m = mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
 _distributed_initialized = False
 
 

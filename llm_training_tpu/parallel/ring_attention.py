@@ -1,0 +1,324 @@
+"""Ring attention: context parallelism over the `sequence` mesh axis.
+
+The reference has NO context parallelism (SURVEY.md §2.8: "CP / ring
+attention / Ulysses — absent"); it reaches 131k tokens by composing TP+SP
+with activation checkpointing (SURVEY.md §5.7). Here long context is a
+first-class axis: activations are sequence-sharded across devices and
+attention runs as a ring — each device keeps its q chunk and circulates
+k/v chunks with `ppermute` over ICI, overlapping the transfer with the
+block-attention compute.
+
+Causality at chunk granularity makes the rotating offset static:
+  kv chunk from an EARLIER position  -> full (unmasked) attention
+  kv chunk from the SAME position    -> ordinary causal attention
+  kv chunk from a LATER position     -> skipped entirely
+so no traced q_offset ever reaches a kernel, and the causal ring does
+~half the chunk-pair work, like the tile-level skipping inside the kernel.
+
+Partial results combine with the running-logsumexp rule (the same online
+softmax the flash kernel uses across kv blocks, lifted to chunks). The
+backward is a custom VJP that re-runs the ring with the globally-combined
+lse and delta: with those fixed, per-chunk-pair dQ/dK/dV contributions sum
+exactly to the full-sequence gradient; dK/dV accumulators ride the ring
+with their chunk and arrive home after a full rotation.
+
+Packing composes for free: segment ids are global document ids, so the
+chunk-pair mask `seg_q == seg_kv` is correct across chunk boundaries.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from llm_training_tpu.parallel.mesh import SEQUENCE_AXIS
+
+
+def _safe_weight(lse: jnp.ndarray, lse_total: jnp.ndarray) -> jnp.ndarray:
+    """exp(lse - lse_total) with fully-masked rows (-inf) mapping to weight 0
+    without producing NaN in either branch (NaN in an untaken `where` branch
+    still poisons gradients)."""
+    finite_total = jnp.where(jnp.isneginf(lse_total), 0.0, lse_total)
+    return jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - finite_total))
+
+
+def _chunk_fwd_xla(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap):
+    """(o, lse) for one chunk pair. q [B,C,Hq,D]; k/v [B,C,Hkv,D];
+    lse [B,Hq,C] fp32; o is fp32 (combined then cast by the caller)."""
+    batch, c_q, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(batch, c_q, hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if logits_soft_cap is not None:
+        s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
+
+    mask = (seg_q[:, None, None, :, None] == seg_kv[:, None, None, None, :]) & (
+        seg_q[:, None, None, :, None] > 0
+    )
+    if causal:
+        c_kv = k.shape[1]
+        mask = mask & (
+            jnp.arange(c_kv)[None, :] <= jnp.arange(c_q)[:, None]
+        )[None, None, None]
+
+    s = jnp.where(mask, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    p = jnp.where(mask, jnp.exp(s - m_safe), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(jnp.where(l[..., 0] > 0, l[..., 0], 1.0)), -jnp.inf)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p / jnp.where(l > 0, l, 1.0), v.astype(jnp.float32))
+    # lse [b,hkv,g,q] -> [b,hq,q]
+    return o.reshape(batch, c_q, hq, d), lse.reshape(batch, hq, c_q)
+
+
+def _chunk_bwd_xla(q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap):
+    """Chunk-pair gradients given the GLOBAL lse/delta ([B,Hq,C] fp32)."""
+    batch, c_q, hq, d = q.shape
+    hkv = k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(batch, c_q, hkv, group, d)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    s_raw = s * scale
+    s = s_raw
+    if logits_soft_cap is not None:
+        s = logits_soft_cap * jnp.tanh(s_raw / logits_soft_cap)
+
+    mask = (seg_q[:, None, None, :, None] == seg_kv[:, None, None, None, :]) & (
+        seg_q[:, None, None, :, None] > 0
+    )
+    if causal:
+        c_kv = k.shape[1]
+        mask = mask & (
+            jnp.arange(c_kv)[None, :] <= jnp.arange(c_q)[:, None]
+        )[None, None, None]
+
+    lse_g = lse.reshape(batch, hkv, group, c_q)[..., None]  # [b,hkv,g,q,1]
+    lse_safe = jnp.where(jnp.isneginf(lse_g), 0.0, lse_g)
+    p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+
+    dog = do.astype(jnp.float32).reshape(batch, c_q, hkv, group, d)
+    dv = jnp.einsum("bhgqk,bqhgd->bkhd", p, dog)
+    dp = jnp.einsum("bqhgd,bkhd->bhgqk", dog, v.astype(jnp.float32))
+    delta_g = delta.reshape(batch, hkv, group, c_q)[..., None]
+    ds = p * (dp - delta_g)
+    if logits_soft_cap is not None:
+        ds = ds * (1.0 - (s / logits_soft_cap) ** 2)
+    ds = ds * scale
+    dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, k.astype(jnp.float32)).reshape(
+        batch, c_q, hq, d
+    )
+    dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg.astype(jnp.float32))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _to_flat(x):
+    """[B, C, H, D] -> [B*H, C, D]."""
+    b, c, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, c, d)
+
+
+def _from_flat(x, batch):
+    bh, c, d = x.shape
+    return x.reshape(batch, bh // batch, c, d).transpose(0, 2, 1, 3)
+
+
+def _ring_block(c: int) -> int:
+    """Largest lane-aligned block <= 512 that divides the chunk length (the
+    flat kernels require exact divisibility — they do not pad)."""
+    for b in (512, 384, 256, 128):
+        if c % b == 0:
+            return b
+    raise ValueError(f"chunk length {c} is not a multiple of 128")
+
+
+def _pallas_ok(q, k) -> bool:
+    return (
+        q.shape[1] % 128 == 0
+        and k.shape[1] % 128 == 0
+        and q.shape[-1] % 128 == 0
+        and jax.default_backend() == "tpu"
+    )
+
+
+def _chunk_fwd(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap, impl):
+    if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
+        from llm_training_tpu.ops.pallas.flash_attention import flash_fwd_flat
+
+        batch, _, hq, _ = q.shape
+        hkv = k.shape[2]
+        o, lse = flash_fwd_flat(
+            _to_flat(q), _to_flat(k), _to_flat(v), seg_q, seg_kv,
+            num_q_heads=hq, num_kv_heads=hkv, scale=scale, causal=causal,
+            logits_soft_cap=logits_soft_cap,
+            block_q=_ring_block(q.shape[1]), block_k=_ring_block(k.shape[1]),
+            interpret=jax.default_backend() != "tpu",
+        )
+        return _from_flat(o, batch).astype(jnp.float32), lse.reshape(batch, hq, -1)
+    return _chunk_fwd_xla(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap)
+
+
+def _chunk_bwd(q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap, impl):
+    if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
+        from llm_training_tpu.ops.pallas.flash_attention import flash_bwd_flat
+
+        batch, _, hq, _ = q.shape
+        hkv = k.shape[2]
+        flat = lambda x: x.reshape(batch * hq, -1)
+        dq, dk, dv = flash_bwd_flat(
+            _to_flat(q), _to_flat(k), _to_flat(v), seg_q, seg_kv,
+            _to_flat(do), flat(lse), flat(delta),
+            num_q_heads=hq, num_kv_heads=hkv, scale=scale, causal=causal,
+            logits_soft_cap=logits_soft_cap,
+            block_q=_ring_block(q.shape[1]), block_k=_ring_block(k.shape[1]),
+            interpret=jax.default_backend() != "tpu",
+        )
+        return _from_flat(dq, batch), _from_flat(dk, batch), _from_flat(dv, batch)
+    return _chunk_bwd_xla(
+        q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray | None = None,
+    axis_name: str = SEQUENCE_AXIS,
+    causal: bool = True,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+    impl: str = "auto",
+) -> jnp.ndarray:
+    """Causal ring attention over sequence-sharded chunks.
+
+    Must be called inside `shard_map` (or any context where `axis_name` is a
+    bound SPMD axis). Arguments are the per-device chunks:
+    q/k/v [B, C, H, D], segment_ids [B, C] with GLOBAL document ids.
+    Sliding-window is not supported under the ring (the window would have to
+    cut inside rotated chunks); the reference has no context parallelism at
+    all, so there is no parity constraint here.
+    """
+    if not causal:
+        raise NotImplementedError("ring attention currently requires causal=True")
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if segment_ids is None:
+        segment_ids = jnp.ones(q.shape[:2], jnp.int32)
+    segment_ids = segment_ids.astype(jnp.int32)
+
+    ring = _make_ring(
+        axis_name=axis_name,
+        scale=scale,
+        logits_soft_cap=logits_soft_cap,
+        impl=impl,
+    )
+    return ring(q, k, v, segment_ids)
+
+
+@functools.cache
+def _make_ring(*, axis_name: str, scale: float, logits_soft_cap: float | None, impl: str):
+    chunk_fwd = functools.partial(
+        _chunk_fwd, scale=scale, logits_soft_cap=logits_soft_cap, impl=impl
+    )
+    chunk_bwd = functools.partial(
+        _chunk_bwd, scale=scale, logits_soft_cap=logits_soft_cap, impl=impl
+    )
+
+    def _rotate(tree):
+        n = lax.axis_size(axis_name)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        return jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), tree)
+
+    def _fwd(q, k, v, seg_q):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        batch, c, hq, d = q.shape
+
+        o_acc = jnp.zeros((batch, c, hq, d), jnp.float32)
+        lse_acc = jnp.full((batch, hq, c), -jnp.inf, jnp.float32)
+        k_cur, v_cur, seg_cur = k, v, seg_q
+        for s in range(n):
+            src = (idx - s) % n
+            # 0: diagonal (causal), 1: src earlier (full), 2: src later (skip)
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            o_s, lse_s = lax.switch(
+                branch,
+                [
+                    lambda args: chunk_fwd(*args, causal=True),
+                    lambda args: chunk_fwd(*args, causal=False),
+                    lambda args: (
+                        jnp.zeros((batch, c, hq, d), jnp.float32),
+                        jnp.full((batch, hq, c), -jnp.inf, jnp.float32),
+                    ),
+                ],
+                (q, k_cur, v_cur, seg_q, seg_cur),
+            )
+            lse_new = jnp.logaddexp(lse_acc, lse_s)
+            w_acc = _safe_weight(lse_acc, lse_new)[..., None].swapaxes(1, 2)
+            w_s = _safe_weight(lse_s, lse_new)[..., None].swapaxes(1, 2)
+            o_acc = o_acc * w_acc + o_s * w_s
+            lse_acc = lse_new
+            if s < n - 1:
+                k_cur, v_cur, seg_cur = _rotate((k_cur, v_cur, seg_cur))
+        return o_acc.astype(q.dtype), lse_acc
+
+    def _bwd_ring(q, k, v, seg_q, o, lse, do):
+        n = lax.axis_size(axis_name)
+        idx = lax.axis_index(axis_name)
+        batch, c, hq, d = q.shape
+
+        delta = jnp.sum(
+            do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+        ).transpose(0, 2, 1)  # [B, Hq, C]
+
+        dq_acc = jnp.zeros_like(q, jnp.float32)
+        k_cur, v_cur, seg_cur = k, v, seg_q
+        dk_cur = jnp.zeros_like(k, jnp.float32)
+        dv_cur = jnp.zeros_like(v, jnp.float32)
+        zeros = lambda: (
+            jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
+        )
+        for s in range(n):
+            src = (idx - s) % n
+            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
+            dq_s, dk_s, dv_s = lax.switch(
+                branch,
+                [
+                    lambda args: chunk_bwd(*args, causal=True),
+                    lambda args: chunk_bwd(*args, causal=False),
+                    lambda args: zeros(),
+                ],
+                (q, k_cur, v_cur, seg_q, seg_cur, do, lse, delta),
+            )
+            dq_acc = dq_acc + dq_s.astype(jnp.float32)
+            dk_cur = dk_cur + dk_s.astype(jnp.float32)
+            dv_cur = dv_cur + dv_s.astype(jnp.float32)
+            # rotate the kv chunk together with its gradient accumulators;
+            # after the final (n-th) rotation each dk/dv is home at its owner
+            k_cur, v_cur, seg_cur, dk_cur, dv_cur = _rotate(
+                (k_cur, v_cur, seg_cur, dk_cur, dv_cur)
+            )
+        return dq_acc.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
+
+    @jax.custom_vjp
+    def ring(q, k, v, seg_q):
+        o, _ = _fwd(q, k, v, seg_q)
+        return o
+
+    def ring_fwd(q, k, v, seg_q):
+        o, lse = _fwd(q, k, v, seg_q)
+        return o, (q, k, v, seg_q, o, lse)
+
+    def ring_bwd(res, do):
+        q, k, v, seg_q, o, lse = res
+        dq, dk, dv = _bwd_ring(q, k, v, seg_q, o, lse, do)
+        return dq, dk, dv, None
+
+    ring.defvjp(ring_fwd, ring_bwd)
+    return ring

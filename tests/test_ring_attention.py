@@ -1,0 +1,173 @@
+"""Ring attention (context parallelism) vs single-device attention.
+
+Runs on the virtual 8-device CPU mesh (conftest). The invariant: attention
+over the full sequence must be bit-for-bit reproduced (up to fp tolerance)
+when the sequence is sharded over the ring — forward AND gradients, with
+packed segment ids crossing chunk boundaries. The reference has no context
+parallelism (SURVEY.md §2.8), so this subsystem is validated purely against
+our own single-device path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from llm_training_tpu.ops.attention import dot_product_attention
+from llm_training_tpu.parallel.ring_attention import ring_attention
+
+
+def _ring_mesh(n=4):
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(1, 1, 1, n),
+                ("data", "fsdp", "tensor", "sequence"))
+
+
+def _shard_mapped_ring(mesh, **kw):
+    spec = P(None, "sequence", None, None)
+    seg_spec = P(None, "sequence")
+    return jax.shard_map(
+        functools.partial(ring_attention, axis_name="sequence", **kw),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def _data(rng, batch=2, seq=64, hq=4, hkv=2, d=16):
+    q = jnp.asarray(rng.standard_normal((batch, seq, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((batch, seq, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((batch, seq, hkv, d)), jnp.float32)
+    # segments crossing chunk boundaries + trailing padding
+    seg = np.ones((batch, seq), np.int32)
+    seg[:, seq // 3:] = 2
+    seg[:, 3 * seq // 4:] = 3
+    seg[:, -5:] = 0
+    return q, k, v, jnp.asarray(seg)
+
+
+def test_ring_forward_matches_single_device():
+    rng = np.random.default_rng(0)
+    q, k, v, seg = _data(rng)
+    mesh = _ring_mesh(4)
+    expected = dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+    got = _shard_mapped_ring(mesh)(q, k, v, seg)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_forward_eight_way():
+    rng = np.random.default_rng(1)
+    q, k, v, seg = _data(rng, seq=80)
+    mesh = _ring_mesh(8)
+    expected = dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+    got = _shard_mapped_ring(mesh)(q, k, v, seg)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_gradients_match_single_device():
+    rng = np.random.default_rng(2)
+    q, k, v, seg = _data(rng)
+    mesh = _ring_mesh(4)
+    cot = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+    ring = _shard_mapped_ring(mesh)
+    g_ring = jax.grad(lambda q, k, v: (ring(q, k, v, seg) * cot).sum(), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, segment_ids=seg, impl="xla") * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, err_msg=f"d{name}")
+
+
+def test_ring_soft_cap():
+    rng = np.random.default_rng(3)
+    q, k, v, seg = _data(rng, hq=2, hkv=2)
+    mesh = _ring_mesh(4)
+    expected = dot_product_attention(
+        q, k, v, segment_ids=seg, logits_soft_cap=15.0, impl="xla"
+    )
+    got = _shard_mapped_ring(mesh, logits_soft_cap=15.0)(q, k, v, seg)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_pallas_chunks_match():
+    """Ring with the pallas per-chunk kernels (interpret mode): chunk sizes
+    lane-aligned so the kernel path is exercised end to end."""
+    rng = np.random.default_rng(4)
+    q, k, v, _ = _data(rng, batch=1, seq=512, hq=2, hkv=1, d=128)
+    seg = np.ones((1, 512), np.int32)
+    seg[:, 300:] = 2
+    seg = jnp.asarray(seg)
+    mesh = _ring_mesh(4)
+    expected = dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+    got = _shard_mapped_ring(mesh, impl="pallas")(q, k, v, seg)
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_pallas_non_block_multiple_chunks():
+    """Regression: chunk 640 (a 128-multiple but not a 512-block multiple)
+    must pick a dividing block, not silently truncate the kernel grid."""
+    rng = np.random.default_rng(7)
+    q, k, v, _ = _data(rng, batch=1, seq=2560, hq=2, hkv=2, d=128)
+    seg = jnp.ones((1, 2560), jnp.int32)
+    mesh = _ring_mesh(4)
+    expected = dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+    got = _shard_mapped_ring(mesh, impl="pallas")(q, k, v, seg)
+    assert not np.any(np.isnan(np.asarray(got)))
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_inside_jit_under_mesh():
+    """The model-integration shape: ring inside jit with sharded inputs."""
+    rng = np.random.default_rng(5)
+    q, k, v, seg = _data(rng)
+    mesh = _ring_mesh(4)
+    ring = _shard_mapped_ring(mesh)
+    with mesh:
+        got = jax.jit(ring)(q, k, v, seg)
+    expected = dot_product_attention(q, k, v, segment_ids=seg, impl="xla")
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_model_level_ring_matches_non_ring():
+    """Llama forward/backward with ring_attention=True on a sequence-sharded
+    mesh equals the plain GSPMD run."""
+    import flax.linen as nn
+
+    from llm_training_tpu.models.llama import Llama, LlamaConfig
+    from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, attention_impl="xla", param_dtype="float32",
+        compute_dtype="float32",
+    )
+    rng = np.random.default_rng(6)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 64)), jnp.int32)
+    seg = jnp.ones((2, 64), jnp.int32)
+
+    model_ref = Llama(LlamaConfig(**base))
+    params = model_ref.init(jax.random.key(0), ids)
+
+    def loss(model, params):
+        out = model.apply(params, ids, segment_ids=seg)
+        return (out.logits.astype(jnp.float32) ** 2).mean()
+
+    l_ref, g_ref = jax.value_and_grad(lambda p: loss(model_ref, p))(params)
+
+    model_ring = Llama(LlamaConfig(**base, ring_attention=True))
+    mesh = _ring_mesh(4)
+    with mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+        l_ring, g_ring = jax.jit(
+            jax.value_and_grad(lambda p: loss(model_ring, p))
+        )(params)
+    np.testing.assert_allclose(l_ring, l_ref, rtol=1e-5)
+    flat_ref = jax.tree.leaves(g_ref)
+    flat_ring = jax.tree.leaves(g_ring)
+    for a, b in zip(flat_ring, flat_ref):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
