@@ -1,0 +1,28 @@
+"""Trainer callbacks: observability and run management.
+
+Capability parity: the reference's Lightning callbacks/loggers layer
+(SURVEY.md §2.7) — `TrainingTimeEstimator`
+(`lightning/callbacks/training_time_estimator.py:12`), `OutputRedirection`
+(`lightning/callbacks/output_redirection.py:13`), `WandbLogger`
+(`lightning/loggers/wandb.py:10`) — plus TPU-native additions the reference
+lacks: MFU reporting and a `jax.profiler` trace hook (SURVEY.md §5.1 notes
+the reference has no profiler integration at all).
+"""
+
+from llm_training_tpu.callbacks.loggers import JsonlLogger, JsonlLoggerConfig, WandbLogger, WandbLoggerConfig
+from llm_training_tpu.callbacks.output_redirection import OutputRedirection, OutputRedirectionConfig
+from llm_training_tpu.callbacks.profiler import ProfilerCallback, ProfilerCallbackConfig
+from llm_training_tpu.callbacks.time_estimator import TrainingTimeEstimator, TrainingTimeEstimatorConfig
+
+__all__ = [
+    "JsonlLogger",
+    "JsonlLoggerConfig",
+    "WandbLogger",
+    "WandbLoggerConfig",
+    "OutputRedirection",
+    "OutputRedirectionConfig",
+    "ProfilerCallback",
+    "ProfilerCallbackConfig",
+    "TrainingTimeEstimator",
+    "TrainingTimeEstimatorConfig",
+]

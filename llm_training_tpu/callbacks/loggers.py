@@ -1,0 +1,124 @@
+"""Metric loggers.
+
+Capability parity: reference `lightning/loggers/wandb.py:10` (W&B logger
+with project/name-scoped save dirs) and `SaveConfigCallback`'s resolved-
+config upload (`save_config_callback.py:15-41`). W&B is optional at runtime
+(this image has no wandb and zero egress), so the always-available default
+is a JSONL metrics file per run — machine-readable like a W&B history file.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+
+from pydantic import BaseModel, ConfigDict
+
+logger = logging.getLogger(__name__)
+
+
+class JsonlLoggerConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    save_dir: str = "runs"
+    project: str = "llm-training-tpu"
+    name: str | None = None  # default: timestamp
+
+
+class JsonlLogger:
+    """Appends one JSON object per logged step to
+    `<save_dir>/<project>/<name>/metrics.jsonl` and writes the resolved run
+    config next to it (the reference embeds it in W&B + checkpoints)."""
+
+    def __init__(self, config: JsonlLoggerConfig | None = None):
+        self.config = config or JsonlLoggerConfig()
+        name = self.config.name or time.strftime("%Y%m%d-%H%M%S")
+        self.run_dir = Path(self.config.save_dir) / self.config.project / name
+        self._file = None
+
+    def _ensure_open(self):
+        if self._file is None:
+            self.run_dir.mkdir(parents=True, exist_ok=True)
+            self._file = open(self.run_dir / "metrics.jsonl", "a")
+        return self._file
+
+    def on_fit_start(self, trainer, objective, datamodule, start_step) -> None:
+        run_config = getattr(getattr(trainer, "checkpointer", None), "run_config", None)
+        if run_config:
+            self.run_dir.mkdir(parents=True, exist_ok=True)
+            (self.run_dir / "config.json").write_text(json.dumps(run_config, indent=2, default=str))
+
+    def on_step_end(self, trainer, step, metrics) -> None:
+        record = {"step": step}
+        for key, value in metrics.items():
+            try:
+                record[key] = float(value)
+            except (TypeError, ValueError):
+                record[key] = str(value)
+        f = self._ensure_open()
+        f.write(json.dumps(record) + "\n")
+        f.flush()
+
+    def on_validation_end(self, trainer, step, metrics) -> None:
+        self.on_step_end(trainer, step, metrics)
+
+    def on_fit_end(self, trainer, state) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+class WandbLoggerConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    save_dir: str = "runs"
+    project: str = "llm-training-tpu"
+    name: str | None = None
+    entity: str | None = None
+    mode: str = "offline"  # zero-egress default; 'online' where permitted
+
+
+class WandbLogger:
+    """W&B metrics logging, import-gated: constructing it without wandb
+    installed raises immediately (no silent no-op), matching the reference's
+    hard dependency (`lightning/loggers/wandb.py`)."""
+
+    def __init__(self, config: WandbLoggerConfig | None = None):
+        import wandb  # noqa: F401 — fail fast if unavailable
+
+        self.config = config or WandbLoggerConfig()
+        self._run = None
+
+    def on_fit_start(self, trainer, objective, datamodule, start_step) -> None:
+        import wandb
+
+        cfg = self.config
+        save_dir = Path(cfg.save_dir) / cfg.project / (cfg.name or "")
+        save_dir.mkdir(parents=True, exist_ok=True)
+        run_config = getattr(getattr(trainer, "checkpointer", None), "run_config", None)
+        self._run = wandb.init(
+            project=cfg.project,
+            name=cfg.name,
+            entity=cfg.entity,
+            dir=str(save_dir),
+            mode=cfg.mode,
+            config=run_config,
+            resume="allow",
+        )
+
+    def on_step_end(self, trainer, step, metrics) -> None:
+        if self._run is not None:
+            self._run.log(
+                {k: v for k, v in metrics.items() if isinstance(v, (int, float)) or hasattr(v, "item")},
+                step=step,
+            )
+
+    def on_validation_end(self, trainer, step, metrics) -> None:
+        self.on_step_end(trainer, step, metrics)
+
+    def on_fit_end(self, trainer, state) -> None:
+        if self._run is not None:
+            self._run.finish()
+            self._run = None

@@ -1,0 +1,51 @@
+"""jax.profiler trace capture over a step window.
+
+TPU-native addition with no reference analogue (SURVEY.md §5.1: the
+reference has no profiler integration). Captures an XLA/TensorBoard trace
+for steps [start_step, start_step + num_steps) — the standard workflow for
+finding HBM-bound ops and collective stalls.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+from pydantic import BaseModel, ConfigDict
+
+logger = logging.getLogger(__name__)
+
+
+class ProfilerCallbackConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    trace_dir: str = "runs/profile"
+    start_step: int = 5  # past compile/warmup
+    num_steps: int = 3
+
+
+class ProfilerCallback:
+    def __init__(self, config: ProfilerCallbackConfig | None = None):
+        self.config = config or ProfilerCallbackConfig()
+        self._active = False
+
+    def on_train_step(self, trainer, step) -> None:
+        cfg = self.config
+        if not self._active and step >= cfg.start_step:
+            end = cfg.start_step + cfg.num_steps
+            if step < end:
+                jax.profiler.start_trace(cfg.trace_dir)
+                self._active = True
+                logger.info("profiler trace started at step %d -> %s", step, cfg.trace_dir)
+        elif self._active and step >= cfg.start_step + cfg.num_steps:
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info("profiler trace stopped at step %d", step)
+
+    def on_fit_end(self, trainer, state) -> None:
+        self.teardown()
+
+    def teardown(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

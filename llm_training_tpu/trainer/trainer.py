@@ -76,6 +76,14 @@ class Trainer:
         # host-side persistent counters (reference metrics/consumed_*.py);
         # python ints — no overflow; saved/restored via checkpoint metadata
         self.counters = {"consumed_samples": 0, "consumed_tokens": 0}
+        # callback-visible run state (time/MFU estimator reads these).
+        # abstract_state is the jax.eval_shape tree — safe to inspect any
+        # time; live TrainState buffers are donated into the next step and
+        # must never be cached by callbacks outside the current hook call
+        self.should_stop = False
+        self.abstract_state = None
+        self.last_step: int | None = None
+        self.last_seq_len: int | None = None
 
     # ------------------------------------------------------------ setup
 
@@ -149,8 +157,15 @@ class Trainer:
         self.mesh = build_mesh(cfg.mesh)
         datamodule.setup()
 
-        with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
-            return self._fit_inner(objective, datamodule, resume_step, state)
+        try:
+            with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+                return self._fit_inner(objective, datamodule, resume_step, state)
+        finally:
+            # callbacks that alter process state (output tees, profiler
+            # traces) must restore it even when fit raises mid-run
+            for cb in self.callbacks:
+                if hasattr(cb, "teardown"):
+                    cb.teardown()
 
     def _fit_inner(self, objective, datamodule, resume_step, state) -> TrainState:
         cfg = self.config
@@ -179,6 +194,7 @@ class Trainer:
         abstract_boxed = self._abstract_state(objective, sample_batch, tx)
         self.state_shardings = self._state_shardings(abstract_boxed)
         abstract_state = nn.meta.unbox(abstract_boxed)
+        self.abstract_state = abstract_state
         batch_shardings = _batch_shardings(sample_batch, self.mesh)
 
         # restore or initialize, directly into sharded buffers
@@ -245,6 +261,10 @@ class Trainer:
                     self, objective, datamodule, start_micro // cfg.accumulate_grad_batches
                 )
 
+        self.should_stop = False
+        self.last_seq_len = (
+            sample_batch["input_ids"].shape[1] if "input_ids" in sample_batch else None
+        )
         step_time = time.perf_counter()
         for micro in range(start_micro, micro_steps):
             batch = next(batches)
@@ -255,6 +275,12 @@ class Trainer:
             if (micro + 1) % cfg.accumulate_grad_batches != 0:
                 continue
             step = (micro + 1) // cfg.accumulate_grad_batches
+            self.last_step = step
+            for cb in self.callbacks:
+                # fires EVERY optimizer step (no metrics, no device sync);
+                # on_step_end below fires only on log steps with host metrics
+                if hasattr(cb, "on_train_step"):
+                    cb.on_train_step(self, step)
 
             if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
                 metrics = {k: np.asarray(jax.device_get(v)) for k, v in metrics.items()}
@@ -281,9 +307,15 @@ class Trainer:
             ):
                 self.checkpointer.save(step, state, counters=dict(self.counters))
 
-        if self.checkpointer is not None:
+            if self.should_stop:
+                logger.info("stopping at step %d (callback request)", step)
+                break
+
+        if self.checkpointer is not None and self.last_step is not None:
+            # label with the step actually reached: an early stop
+            # (should_stop) must not masquerade as a completed run
             self.checkpointer.save(
-                cfg.max_steps, state, counters=dict(self.counters), force=True
+                self.last_step, state, counters=dict(self.counters), force=True
             )
             self.checkpointer.wait()
         for cb in self.callbacks:

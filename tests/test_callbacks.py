@@ -1,0 +1,151 @@
+"""Callbacks: time/MFU estimator, JSONL logger, output redirection, profiler.
+
+The reference exercised its callbacks only inside live Lightning runs
+(SURVEY.md §4 — no tests existed); here each one runs against a real tiny
+fit on the CPU mesh.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from llm_training_tpu.callbacks import (
+    JsonlLogger,
+    JsonlLoggerConfig,
+    OutputRedirection,
+    OutputRedirectionConfig,
+    TrainingTimeEstimator,
+    TrainingTimeEstimatorConfig,
+)
+from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+from llm_training_tpu.parallel import MeshConfig
+from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+
+def _tiny_objective():
+    return CLM(
+        CLMConfig(
+            model=ModelProvider(
+                model_class="Llama",
+                model_kwargs=dict(
+                    vocab_size=128, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2, max_position_embeddings=64,
+                    attention_impl="xla", param_dtype="float32",
+                    compute_dtype="float32",
+                ),
+            )
+        )
+    )
+
+
+def _tiny_dm(batch=8):
+    return DummyDataModule(
+        DummyDataModuleConfig(batch_size=batch, max_length=32, num_samples=256, vocab_size=128)
+    )
+
+
+def _fit(callbacks, max_steps=12, log_every=2):
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=max_steps, log_every_n_steps=log_every,
+            mesh=MeshConfig(),
+        ),
+        callbacks=callbacks,
+    )
+    trainer.fit(_tiny_objective(), _tiny_dm())
+    return trainer
+
+
+def test_time_estimator_reports_throughput_and_extrapolation():
+    est = TrainingTimeEstimator(
+        TrainingTimeEstimatorConfig(num_steps=4, skip_first_n_steps=2)
+    )
+    _fit([est])
+    assert est.result is not None
+    assert est.result["steps_per_sec"] > 0
+    assert est.result["tokens_per_sec"] > 0
+    assert est.result["estimated_total_hours"] > 0
+    # CPU has no peak-FLOPs entry, so MFU is absent there; on TPU it appears
+    if jax.default_backend() == "tpu":
+        assert 0 < est.result["mfu"] < 1
+
+
+def test_time_estimator_dry_run_stops_training():
+    est = TrainingTimeEstimator(
+        TrainingTimeEstimatorConfig(num_steps=2, skip_first_n_steps=0, stop_after_steps=4)
+    )
+    trainer = _fit([est], max_steps=100, log_every=2)
+    assert trainer.last_step < 100
+    assert est.result is not None
+
+
+def test_early_stop_checkpoint_labeled_with_actual_step(tmp_path):
+    """Regression: a dry-run stop must not write its checkpoint under
+    max_steps — that would block the real final save on resume."""
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    est = TrainingTimeEstimator(
+        TrainingTimeEstimatorConfig(num_steps=2, skip_first_n_steps=0, stop_after_steps=3)
+    )
+    ckpt = Checkpointer(CheckpointConfig(dirpath=str(tmp_path / "ckpt")))
+    trainer = Trainer(
+        TrainerConfig(max_steps=50, log_every_n_steps=1, mesh=MeshConfig()),
+        callbacks=[est],
+        checkpointer=ckpt,
+    )
+    trainer.fit(_tiny_objective(), _tiny_dm())
+    steps = ckpt.manager.all_steps()
+    assert trainer.last_step < 50
+    assert max(steps) == trainer.last_step
+
+
+def test_jsonl_logger_writes_metrics_and_config(tmp_path):
+    logger = JsonlLogger(JsonlLoggerConfig(save_dir=str(tmp_path), name="run1"))
+    _fit([logger], max_steps=6, log_every=2)
+    lines = (tmp_path / "llm-training-tpu" / "run1" / "metrics.jsonl").read_text().splitlines()
+    records = [json.loads(line) for line in lines]
+    assert [r["step"] for r in records] == [2, 4, 6]
+    assert all("loss" in r and "grad_norm" in r for r in records)
+
+
+def test_output_redirection_tees_to_log_file(tmp_path):
+    import logging
+
+    # the CLI sets INFO via basicConfig (cli/main.py); do the equivalent here
+    # so the trainer's log records pass the level check
+    logging.getLogger("llm_training_tpu").setLevel(logging.INFO)
+    cb = OutputRedirection(OutputRedirectionConfig(log_dir=str(tmp_path)))
+    _fit([cb], max_steps=4, log_every=2)
+    assert cb.log_path is not None and cb.log_path.exists()
+    content = cb.log_path.read_text()
+    assert "step 4" in content  # trainer log line captured
+    # numbered files: a second run gets 1.log
+    cb2 = OutputRedirection(OutputRedirectionConfig(log_dir=str(tmp_path)))
+    _fit([cb2], max_steps=2, log_every=2)
+    assert cb2.log_path.name == "1.log"
+
+
+def test_wandb_logger_requires_wandb():
+    from llm_training_tpu.callbacks import WandbLogger
+
+    try:
+        import wandb  # noqa: F401
+
+        pytest.skip("wandb installed; gating not testable")
+    except ImportError:
+        with pytest.raises(ImportError):
+            WandbLogger()
+
+
+def test_mfu_model():
+    from llm_training_tpu.callbacks.time_estimator import transformer_step_flops
+
+    # 6·N·T exactly when no shape hints
+    assert transformer_step_flops(1000, 10) == 60000
+    # attention term adds 12·L·H·S·T
+    flops = transformer_step_flops(1000, 10, num_layers=2, hidden_size=8, seq_len=4)
+    assert flops == 60000 + 12 * 2 * 8 * 4 * 10
