@@ -52,7 +52,10 @@ class OutputRedirection:
     def on_fit_start(self, trainer, objective, datamodule, start_step) -> None:
         log_dir = Path(self.config.log_dir)
         log_dir.mkdir(parents=True, exist_ok=True)
-        n = sum(1 for p in log_dir.glob("*.log"))
+        taken = [
+            int(p.stem) for p in log_dir.glob("*.log") if p.stem.isdigit()
+        ]
+        n = max(taken, default=-1) + 1  # gaps never clobber an existing log
         self.log_path = log_dir / f"{n}.log"
         self._file = open(self.log_path, "w")
         self._saved = (sys.stdout, sys.stderr)

@@ -16,7 +16,7 @@ import logging
 import time
 
 import jax
-from pydantic import BaseModel, ConfigDict
+from pydantic import BaseModel, ConfigDict, Field
 
 logger = logging.getLogger(__name__)
 
@@ -59,9 +59,9 @@ class TrainingTimeEstimatorConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     # measure steps [skip_first_n_steps, skip_first_n_steps + num_steps)
-    num_steps: int = 20
-    skip_first_n_steps: int = 2  # compile + warmup excluded, like `:40-62`
-    stop_after_steps: int | None = None  # dry-run mode: end the fit afterwards
+    num_steps: int = Field(20, ge=1)
+    skip_first_n_steps: int = Field(2, ge=0)  # compile + warmup excluded, like `:40-62`
+    stop_after_steps: int | None = Field(None, ge=1)  # dry run: end the fit afterwards
 
 
 class TrainingTimeEstimator:
@@ -96,6 +96,9 @@ class TrainingTimeEstimator:
         cfg = self.config
         begin = self._fit_start_step + cfg.skip_first_n_steps
         if step >= begin and self._t0 is None:
+            # drain the async dispatch queue: without this, perf_counter
+            # timestamps measure dispatch rate, not device step time
+            self._sync(trainer)
             self._t0 = time.perf_counter()
             self._start_step = step
             self._start_tokens = trainer.counters["consumed_tokens"]
@@ -104,8 +107,14 @@ class TrainingTimeEstimator:
         if cfg.stop_after_steps and step - self._fit_start_step >= cfg.stop_after_steps:
             trainer.should_stop = True
 
+    @staticmethod
+    def _sync(trainer) -> None:
+        if getattr(trainer, "last_metrics", None) is not None:
+            jax.block_until_ready(trainer.last_metrics)
+
     def _finish(self, trainer, step) -> None:
         self._maybe_count_params(trainer)
+        self._sync(trainer)
         elapsed = time.perf_counter() - self._t0
         steps = step - self._start_step
         tokens = trainer.counters["consumed_tokens"] - self._start_tokens

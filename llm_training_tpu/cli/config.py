@@ -63,11 +63,7 @@ def load_config(path: str | Path, overrides: list[str] | None = None) -> dict:
     return _interpolate(config, config)
 
 
-def import_class(class_path: str) -> type:
-    module_name, _, class_name = class_path.rpartition(".")
-    if not module_name:
-        raise ValueError(f"class_path must be fully qualified, got {class_path!r}")
-    return getattr(importlib.import_module(module_name), class_name)
+from llm_training_tpu.imports import import_class  # noqa: E402 — re-export
 
 
 def instantiate_from_config(node: dict, default_class: str | None = None) -> Any:

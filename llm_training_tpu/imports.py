@@ -1,0 +1,13 @@
+"""Dotted-path class resolution shared by the config system, model
+provider, and HF architecture router."""
+
+from __future__ import annotations
+
+import importlib
+
+
+def import_class(class_path: str) -> type:
+    module_name, _, class_name = class_path.rpartition(".")
+    if not module_name:
+        raise ValueError(f"class_path must be fully qualified, got {class_path!r}")
+    return getattr(importlib.import_module(module_name), class_name)

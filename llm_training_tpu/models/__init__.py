@@ -10,12 +10,15 @@ families. Here, models are flax.linen Modules whose parameters carry
 """
 
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
+from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
 from llm_training_tpu.models.phi3 import Phi3, Phi3Config
 
 __all__ = [
     "BaseModelConfig",
     "CausalLMOutput",
+    "HFCausalLM",
+    "HFCausalLMConfig",
     "Llama",
     "LlamaConfig",
     "Phi3",

@@ -1,0 +1,71 @@
+"""HFCausalLM: build a TPU-native model directly from an HF checkpoint dir.
+
+Capability parity: reference `models/hf_causal_lm/hf_causal_lm.py:22` — the
+"wrap any `AutoModelForCausalLM`" escape hatch. On TPU the executable graph
+must be one of our flax modules, so this is an *architecture router*, not a
+wrapper: `config.json`'s `model_type` selects the TPU module family that
+reproduces the computation graph (llama/mistral/qwen2 -> Llama,
+phi3 -> Phi3; see `hf_io.model_class_for_hf`), hparams are merged via the
+family's `config_from_hf` (the `merge_hf_config` analogue,
+`hf_compat_model.py:96-100`), and weights stream from safetensors shards
+straight into sharded device buffers at fit time.
+
+Arbitrary unknown architectures (the one reference capability that cannot
+exist without executing torch code on TPU — flagged in SURVEY.md §7 step 3)
+fail loudly with the supported-family list.
+
+Usage (YAML):
+    model:
+      init_args:
+        model:
+          model_class: HFCausalLM
+          model_kwargs:
+            hf_path: /path/to/hf-checkpoint
+            enable_gradient_checkpointing: true
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict
+
+from llm_training_tpu.imports import import_class
+from llm_training_tpu.models.hf_io import load_hf_config, model_class_for_hf
+
+
+class HFCausalLMConfig(BaseModel):
+    """`hf_path` plus any family-config overrides (validated by the family's
+    own pydantic config, so typos still fail loudly)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    hf_path: str
+    load_hf_weights: bool = True
+
+
+def resolve_hf_model(config: HFCausalLMConfig) -> Any:
+    hf_config = load_hf_config(config.hf_path)
+    model_cls = import_class(model_class_for_hf(hf_config))
+    conversion = importlib.import_module(
+        model_cls.__module__.rsplit(".", 1)[0] + ".hf_conversion"
+    )
+
+    overrides = {
+        k: v for k, v in config.model_dump().items()
+        if k not in ("hf_path", "load_hf_weights")
+    }
+    if config.load_hf_weights:
+        overrides.setdefault("pre_trained_weights", config.hf_path)
+    family_config = conversion.config_from_hf(hf_config, **overrides)
+    return model_cls(family_config)
+
+
+class HFCausalLM:
+    """Constructing `HFCausalLM(config)` returns the routed family model
+    (a flax module) — callers never see this class itself, mirroring how the
+    reference's HFCausalLM disappears behind the HF model it wraps."""
+
+    def __new__(cls, config: HFCausalLMConfig):
+        return resolve_hf_model(config)

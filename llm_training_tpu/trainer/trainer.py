@@ -262,6 +262,8 @@ class Trainer:
                 )
 
         self.should_stop = False
+        self.last_step = None
+        self.last_metrics = None
         self.last_seq_len = (
             sample_batch["input_ids"].shape[1] if "input_ids" in sample_batch else None
         )
@@ -276,6 +278,9 @@ class Trainer:
                 continue
             step = (micro + 1) // cfg.accumulate_grad_batches
             self.last_step = step
+            # fresh (non-donated) device arrays; callbacks that need wall-
+            # clock accuracy can jax.block_until_ready(trainer.last_metrics)
+            self.last_metrics = metrics
             for cb in self.callbacks:
                 # fires EVERY optimizer step (no metrics, no device sync);
                 # on_step_end below fires only on log steps with host metrics

@@ -237,3 +237,58 @@ def test_trainer_pretrained_init(tmp_path, hf_llama_dir):
         ),
         got, expected,
     )
+
+
+# ---------------------------------------------------------------- HFCausalLM
+
+
+def test_hf_causal_lm_routes_to_family(hf_llama_dir):
+    """HFCausalLM(config) returns the routed flax family model with merged
+    hparams and the checkpoint wired as pre-trained weights (the reference's
+    wrap-any-AutoModelForCausalLM escape hatch, hf_causal_lm.py:22)."""
+    from llm_training_tpu.models import HFCausalLM, HFCausalLMConfig, Llama
+
+    model = HFCausalLM(HFCausalLMConfig(hf_path=str(hf_llama_dir)))
+    assert isinstance(model, Llama)
+    assert model.config.hidden_size == TINY_HF["hidden_size"]
+    assert model.config.num_key_value_heads == TINY_HF["num_key_value_heads"]
+    assert model.config.pre_trained_weights == str(hf_llama_dir)
+
+
+def test_hf_causal_lm_overrides_and_validation(hf_llama_dir):
+    from llm_training_tpu.models import HFCausalLM, HFCausalLMConfig
+
+    model = HFCausalLM(
+        HFCausalLMConfig(
+            hf_path=str(hf_llama_dir),
+            enable_gradient_checkpointing=True,
+            attention_impl="xla",
+        )
+    )
+    assert model.config.enable_gradient_checkpointing is True
+    with pytest.raises(Exception):  # family pydantic config rejects typos
+        HFCausalLM(
+            HFCausalLMConfig(hf_path=str(hf_llama_dir), hiden_size=12)
+        )
+
+
+def test_hf_causal_lm_unknown_arch_fails_loudly(tmp_path):
+    from llm_training_tpu.models import HFCausalLM, HFCausalLMConfig
+
+    (tmp_path / "config.json").write_text(json.dumps({"model_type": "mamba"}))
+    with pytest.raises(ValueError, match="unsupported HF model_type"):
+        HFCausalLM(HFCausalLMConfig(hf_path=str(tmp_path)))
+
+
+def test_hf_causal_lm_through_model_provider(hf_llama_dir):
+    """The YAML path: ModelProvider with model_class=HFCausalLM."""
+    from llm_training_tpu.lms.base import ModelProvider
+    from llm_training_tpu.models import Llama
+
+    provider = ModelProvider(
+        model_class="HFCausalLM",
+        model_kwargs=dict(hf_path=str(hf_llama_dir), scan_layers=False),
+    )
+    model = provider.get_model()
+    assert isinstance(model, Llama)
+    assert model.config.scan_layers is False
