@@ -33,6 +33,25 @@ class InstructionTuningDataCollator:
         width = self._padded_len(max(len(e["input_ids"]) for e in examples))
         batch = len(examples)
 
+        if self.padding_side == "right":
+            from llm_training_tpu import native
+
+            segs_rows = [np.asarray(e["segment_ids"], np.int32) for e in examples]
+            # the native kernel restarts positions on segment-id CHANGE; that
+            # equals the Python per-unique-segment rule only for monotonic ids
+            # (the only thing our packers emit) — fall back otherwise
+            if all(np.all(np.diff(s) >= 0) for s in segs_rows):
+                out = native.pad_batch(
+                    [np.asarray(e["input_ids"], np.int32) for e in examples],
+                    segs_rows,
+                    [np.asarray(e["labels"], np.int32) for e in examples],
+                    width,
+                    self.pad_token_id,
+                    restart_positions=True,
+                )
+                if out is not None:
+                    return out
+
         input_ids = np.full((batch, width), self.pad_token_id, np.int32)
         labels = np.full((batch, width), -100, np.int32)
         segment_ids = np.zeros((batch, width), np.int32)

@@ -38,6 +38,27 @@ class PreTrainingDataCollator:
         width = self._padded_len(max(lengths))
         batch = len(examples)
 
+        if self.padding_side == "right":
+            from llm_training_tpu import native
+
+            rows = [np.asarray(e["input_ids"], np.int32) for e in examples]
+            row_labels = [
+                np.where(ids == self.bos_token_id, -100, ids).astype(np.int32)
+                if self.bos_token_id is not None
+                else ids
+                for ids in rows
+            ]
+            out = native.pad_batch(
+                rows,
+                [np.asarray(e["segment_ids"], np.int32) for e in examples],
+                row_labels,
+                width,
+                self.pad_token_id,
+                restart_positions=False,  # one shared position stream per row
+            )
+            if out is not None:
+                return out
+
         input_ids = np.full((batch, width), self.pad_token_id, np.int32)
         segment_ids = np.zeros((batch, width), np.int32)
         labels = np.full((batch, width), -100, np.int32)

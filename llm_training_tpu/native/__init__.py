@@ -1,0 +1,174 @@
+"""ctypes loader for the native data-engine library (native/packing.cc).
+
+Compilation model: the shared object is built on first use with the system
+g++ (`-O3 -shared -fPIC`) into the package's `_build/` directory, keyed by a
+source hash so edits recompile automatically. No pybind11 (not in the
+image): the C ABI + ctypes + numpy buffers is the whole binding layer.
+Every entry point has a pure-Python twin (the original implementations in
+the data layer); `lib()` returning None means "fall back", never an error —
+a missing compiler must not break training.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SOURCE = Path(__file__).resolve().parent.parent.parent / "native" / "packing.cc"
+_BUILD_DIR = Path(__file__).resolve().parent / "_build"
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> Path | None:
+    if not _SOURCE.exists():
+        return None
+    digest = hashlib.sha256(_SOURCE.read_bytes()).hexdigest()[:16]
+    so_path = _BUILD_DIR / f"packing-{digest}.so"
+    if so_path.exists():
+        return so_path
+    _BUILD_DIR.mkdir(exist_ok=True)
+    # compile to a per-process temp name, then atomically rename: concurrent
+    # builders (datasets.map workers) never see a half-written .so, and a
+    # loser's rename just re-installs identical bytes
+    tmp_path = so_path.with_suffix(f".tmp-{os.getpid()}")
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SOURCE), "-o", str(tmp_path)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp_path, so_path)
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native packing build failed (%s); using Python fallback", e)
+        tmp_path.unlink(missing_ok=True)
+        return None
+    return so_path
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded library, compiling on first call; None => use Python."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("LLM_TRAINING_TPU_NO_NATIVE"):
+        return None
+    so_path = _compile()
+    if so_path is None:
+        return None
+    try:
+        cdll = ctypes.CDLL(str(so_path))
+    except OSError as e:
+        logger.warning("native packing load failed (%s); using Python fallback", e)
+        return None
+    cdll.bfd_pack.restype = ctypes.c_int64
+    cdll.bfd_pack.argtypes = [
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    cdll.pad_batch.restype = None
+    cdll.pad_batch.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    _lib = cdll
+    logger.info("native packing library loaded: %s", so_path.name)
+    return _lib
+
+
+def _i64_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def bfd_pack(capacity: int, lengths: list[int]) -> list[list[int]] | None:
+    """Native best-fit packing; groups of item indices, or None if the
+    library is unavailable. Grouping is identical to the Python
+    `best_fit_bin_packing`."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    n = len(lengths)
+    arr = np.asarray(lengths, np.int64)
+    bins = np.empty(n, np.int64)
+    num_bins = cdll.bfd_pack(capacity, _i64_ptr(arr), n, _i64_ptr(bins))
+    if num_bins < 0:
+        raise ValueError(f"an item exceeds capacity {capacity}")
+    groups: list[list[int]] = [[] for _ in range(num_bins)]
+    for i in range(n):
+        groups[bins[i]].append(i)
+    return groups
+
+
+def pad_batch(
+    rows_tokens: list[np.ndarray],
+    rows_segments: list[np.ndarray] | None,
+    rows_labels: list[np.ndarray] | None,
+    width: int,
+    pad_id: int,
+    ignore_index: int = -100,
+    restart_positions: bool = True,
+) -> dict[str, np.ndarray] | None:
+    """Fused padded-batch assembly; None if the library is unavailable."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    n = len(rows_tokens)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(r) for r in rows_tokens], out=offsets[1:])
+    tokens = np.concatenate(rows_tokens).astype(np.int32) if n else np.zeros(0, np.int32)
+    segments = (
+        np.concatenate(rows_segments).astype(np.int32) if rows_segments is not None else None
+    )
+    labels = (
+        np.concatenate(rows_labels).astype(np.int32) if rows_labels is not None else None
+    )
+    ids_out = np.empty((n, width), np.int32)
+    segs_out = np.empty((n, width), np.int32)
+    labels_out = np.empty((n, width), np.int32)
+    pos_out = np.empty((n, width), np.int32)
+    null_i32 = ctypes.POINTER(ctypes.c_int32)()
+    cdll.pad_batch(
+        _i32_ptr(tokens),
+        _i32_ptr(segments) if segments is not None else null_i32,
+        _i32_ptr(labels) if labels is not None else null_i32,
+        _i64_ptr(offsets),
+        n,
+        width,
+        pad_id,
+        ignore_index,
+        _i32_ptr(ids_out),
+        _i32_ptr(segs_out),
+        _i32_ptr(labels_out),
+        _i32_ptr(pos_out),
+        1 if restart_positions else 0,
+    )
+    return {
+        "input_ids": ids_out,
+        "segment_ids": segs_out,
+        "labels": labels_out,
+        "position_ids": pos_out,
+    }

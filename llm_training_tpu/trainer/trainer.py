@@ -47,6 +47,9 @@ class TrainerConfig(BaseModel):
     val_check_interval: int | None = None
     limit_val_batches: int | None = None
     checkpoint_every_n_steps: int | None = None
+    # batches placed on device ahead of the step loop by a worker thread
+    # (the reference's pin_memory/prefetch_factor analogue); 0 disables
+    prefetch_batches: int = 2
     mesh: MeshConfig = MeshConfig()
 
 
@@ -254,6 +257,17 @@ class Trainer:
         start_micro = int(jax.device_get(state.step))
         micro_steps = cfg.max_steps * cfg.accumulate_grad_batches
         batches = datamodule.train_batches(start_step=start_micro)
+        prefetcher = None
+        if cfg.prefetch_batches > 0:
+            from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+            prefetcher = DevicePrefetcher(
+                batches,
+                batch_shardings,
+                depth=cfg.prefetch_batches,
+                host_aux_fn=self._batch_counts,
+            )
+            batches = iter(prefetcher)
 
         for cb in self.callbacks:
             if hasattr(cb, "on_fit_start"):
@@ -268,53 +282,61 @@ class Trainer:
             sample_batch["input_ids"].shape[1] if "input_ids" in sample_batch else None
         )
         step_time = time.perf_counter()
-        for micro in range(start_micro, micro_steps):
-            batch = next(batches)
-            state, metrics = train_step(state, batch)
+        try:
+            for micro in range(start_micro, micro_steps):
+                if prefetcher is not None:
+                    batch, counts = next(batches)
+                else:
+                    batch = next(batches)
+                    counts = self._batch_counts(batch)
+                state, metrics = train_step(state, batch)
 
-            self._update_counters(batch)
+                self._apply_counts(counts)
 
-            if (micro + 1) % cfg.accumulate_grad_batches != 0:
-                continue
-            step = (micro + 1) // cfg.accumulate_grad_batches
-            self.last_step = step
-            # fresh (non-donated) device arrays; callbacks that need wall-
-            # clock accuracy can jax.block_until_ready(trainer.last_metrics)
-            self.last_metrics = metrics
-            for cb in self.callbacks:
-                # fires EVERY optimizer step (no metrics, no device sync);
-                # on_step_end below fires only on log steps with host metrics
-                if hasattr(cb, "on_train_step"):
-                    cb.on_train_step(self, step)
-
-            if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
-                metrics = {k: np.asarray(jax.device_get(v)) for k, v in metrics.items()}
-                now = time.perf_counter()
-                metrics["lr"] = np.asarray(schedule(step))
-                metrics["steps_per_sec"] = cfg.log_every_n_steps / (now - step_time)
-                metrics.update(self.counters)
-                step_time = now
-                logger.info(
-                    "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s",
-                    step, metrics["loss"], metrics["grad_norm"], metrics["steps_per_sec"],
-                )
+                if (micro + 1) % cfg.accumulate_grad_batches != 0:
+                    continue
+                step = (micro + 1) // cfg.accumulate_grad_batches
+                self.last_step = step
+                # fresh (non-donated) device arrays; callbacks that need wall-
+                # clock accuracy can jax.block_until_ready(trainer.last_metrics)
+                self.last_metrics = metrics
                 for cb in self.callbacks:
-                    if hasattr(cb, "on_step_end"):
-                        cb.on_step_end(self, step, metrics)
+                    # fires EVERY optimizer step (no metrics, no device sync);
+                    # on_step_end below fires only on log steps with host metrics
+                    if hasattr(cb, "on_train_step"):
+                        cb.on_train_step(self, step)
 
-            if cfg.val_check_interval and step % cfg.val_check_interval == 0:
-                self._run_validation(eval_step, state, datamodule, step)
+                if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
+                    metrics = {k: np.asarray(jax.device_get(v)) for k, v in metrics.items()}
+                    now = time.perf_counter()
+                    metrics["lr"] = np.asarray(schedule(step))
+                    metrics["steps_per_sec"] = cfg.log_every_n_steps / (now - step_time)
+                    metrics.update(self.counters)
+                    step_time = now
+                    logger.info(
+                        "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s",
+                        step, metrics["loss"], metrics["grad_norm"], metrics["steps_per_sec"],
+                    )
+                    for cb in self.callbacks:
+                        if hasattr(cb, "on_step_end"):
+                            cb.on_step_end(self, step, metrics)
 
-            if (
-                self.checkpointer is not None
-                and cfg.checkpoint_every_n_steps
-                and step % cfg.checkpoint_every_n_steps == 0
-            ):
-                self.checkpointer.save(step, state, counters=dict(self.counters))
+                if cfg.val_check_interval and step % cfg.val_check_interval == 0:
+                    self._run_validation(eval_step, state, datamodule, step)
 
-            if self.should_stop:
-                logger.info("stopping at step %d (callback request)", step)
-                break
+                if (
+                    self.checkpointer is not None
+                    and cfg.checkpoint_every_n_steps
+                    and step % cfg.checkpoint_every_n_steps == 0
+                ):
+                    self.checkpointer.save(step, state, counters=dict(self.counters))
+
+                if self.should_stop:
+                    logger.info("stopping at step %d (callback request)", step)
+                    break
+        finally:
+            if prefetcher is not None:
+                prefetcher.close()
 
         if self.checkpointer is not None and self.last_step is not None:
             # label with the step actually reached: an early stop
@@ -343,19 +365,25 @@ class Trainer:
                 if hasattr(cb, "on_validation_end"):
                     cb.on_validation_end(self, step, {"val_loss": val_loss})
 
-    def _update_counters(self, batch: dict) -> None:
-        """Consumed samples/tokens from the host-side numpy batch; handles
-        both CLM batches (`input_ids`) and preference batches
-        (`chosen_/rejected_input_ids`)."""
+    @staticmethod
+    def _batch_counts(batch: dict) -> tuple[int, int]:
+        """(samples, tokens) from the HOST-side numpy batch; handles both CLM
+        batches (`input_ids`) and preference batches
+        (`chosen_/rejected_input_ids`). Must run before device placement —
+        on a device copy it would force a blocking sync each step."""
         id_keys = [k for k in batch if k == "input_ids" or k.endswith("_input_ids")]
         first = batch[id_keys[0]]
-        self.counters["consumed_samples"] += int(first.shape[0])
+        samples = int(first.shape[0])
+        tokens = 0
         for key in id_keys:
             prefix = key[: -len("input_ids")]
             seg = batch.get(prefix + "segment_ids")
-            self.counters["consumed_tokens"] += (
-                int((seg > 0).sum()) if seg is not None else int(batch[key].size)
-            )
+            tokens += int((seg > 0).sum()) if seg is not None else int(batch[key].size)
+        return samples, tokens
+
+    def _apply_counts(self, counts: tuple[int, int]) -> None:
+        self.counters["consumed_samples"] += counts[0]
+        self.counters["consumed_tokens"] += counts[1]
 
     # ------------------------------------------------------------ validate
 

@@ -1,0 +1,165 @@
+"""Native C++ data engine vs its pure-Python twins.
+
+The grouping must be byte-identical across implementations — the HF datasets
+fingerprint cache and resume determinism depend on it, so these are equality
+property tests, not just smoke tests.
+"""
+
+import numpy as np
+import pytest
+
+from llm_training_tpu import native
+from llm_training_tpu.data.pre_training.datamodule import (
+    best_fit_bin_packing,
+    best_fit_bin_packing_py,
+)
+
+
+def test_native_library_builds_and_loads():
+    # g++ is in the image; a silent fallback here would hide a broken build
+    assert native.lib() is not None
+
+
+def test_bfd_groups_identical_to_python():
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        n = int(rng.integers(1, 2000))
+        capacity = int(rng.integers(64, 4096))
+        lengths = rng.integers(1, capacity + 1, n).tolist()
+        got = native.bfd_pack(capacity, lengths)
+        expected = best_fit_bin_packing_py(capacity, lengths)
+        assert got == expected, f"trial {trial}: n={n} capacity={capacity}"
+
+
+def test_bfd_decreasing_order_fills_bins():
+    lengths = sorted([700, 300, 300, 500, 200, 900, 100], reverse=True)
+    groups = native.bfd_pack(1000, lengths)
+    # every bin's total fits
+    for group in groups:
+        assert sum(lengths[i] for i in group) <= 1000
+    # all items placed exactly once
+    assert sorted(i for g in groups for i in g) == list(range(len(lengths)))
+
+
+def test_bfd_oversize_item_raises():
+    with pytest.raises(ValueError):
+        native.bfd_pack(10, [5, 11])
+
+
+def test_dispatcher_uses_native_above_threshold():
+    lengths = list(np.random.default_rng(1).integers(1, 512, 500))
+    assert best_fit_bin_packing(512, [int(x) for x in lengths]) == \
+        best_fit_bin_packing_py(512, [int(x) for x in lengths])
+
+
+def test_pad_batch_matches_collator_semantics():
+    rows = [
+        np.asarray([5, 6, 7, 8, 9], np.int32),
+        np.asarray([1, 2], np.int32),
+        np.asarray([3, 3, 3, 3, 3, 3, 3], np.int32),
+    ]
+    segs = [
+        np.asarray([1, 1, 2, 2, 2], np.int32),
+        np.asarray([1, 1], np.int32),
+        np.asarray([1, 2, 2, 3, 3, 3, 3], np.int32),
+    ]
+    labels = [r * 10 for r in rows]
+    out = native.pad_batch(rows, segs, labels, width=8, pad_id=0, restart_positions=True)
+    assert out is not None
+
+    np.testing.assert_array_equal(out["input_ids"][1], [1, 2, 0, 0, 0, 0, 0, 0])
+    np.testing.assert_array_equal(out["segment_ids"][0], [1, 1, 2, 2, 2, 0, 0, 0])
+    np.testing.assert_array_equal(out["labels"][0][:5], [50, 60, 70, 80, 90])
+    np.testing.assert_array_equal(out["labels"][0][5:], [-100, -100, -100])
+    # positions restart at each packed document boundary (IT collator rule)
+    np.testing.assert_array_equal(out["position_ids"][0], [0, 1, 0, 1, 2, 0, 0, 0])
+    np.testing.assert_array_equal(out["position_ids"][2][:7], [0, 0, 1, 0, 1, 2, 3])
+
+
+def test_pad_batch_shared_positions():
+    rows = [np.asarray([4, 4, 4, 4], np.int32)]
+    segs = [np.asarray([1, 1, 2, 2], np.int32)]
+    out = native.pad_batch(rows, segs, None, width=6, pad_id=9, restart_positions=False)
+    # pre-training collator rule: one shared position stream across docs
+    np.testing.assert_array_equal(out["position_ids"][0], [0, 1, 2, 3, 0, 0])
+    np.testing.assert_array_equal(out["labels"][0], [4, 4, 4, 4, -100, -100])
+
+
+def test_prefetcher_preserves_order_and_closes():
+    import jax
+
+    from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+    batches = ({"x": np.full((2, 2), i, np.int32)} for i in range(10))
+    pf = DevicePrefetcher(batches, None, depth=2, host_aux_fn=lambda b: int(b["x"].sum()))
+    pairs = list(pf)
+    seen = [int(b["x"][0, 0]) for b, _ in pairs]
+    assert seen == list(range(10))
+    assert [aux for _, aux in pairs] == [i * 4 for i in range(10)]
+    # exhausted iterator keeps raising StopIteration instead of blocking
+    assert list(pf) == []
+
+    # close() mid-stream stops the worker without hanging
+    endless = ({"x": np.zeros((1,), np.int32)} for _ in iter(int, 1))
+    pf2 = DevicePrefetcher(endless, None, depth=2)
+    next(iter(pf2))
+    pf2.close()
+    pf2._thread.join(timeout=5)
+    assert not pf2._thread.is_alive()
+
+
+def test_prefetcher_propagates_worker_errors():
+    from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+    def bad():
+        yield {"x": np.zeros((1,), np.int32)}
+        raise RuntimeError("boom")
+
+    pf = DevicePrefetcher(bad(), None, depth=2)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+        next(it)
+
+
+def test_collators_native_equals_python(monkeypatch):
+    """The collators' native fast path must be indistinguishable from the
+    Python loop."""
+    from llm_training_tpu.data.instruction_tuning.collator import (
+        InstructionTuningDataCollator,
+    )
+    from llm_training_tpu.data.pre_training.collator import PreTrainingDataCollator
+
+    class Tok:
+        pad_token_id = 0
+        bos_token_id = 1
+
+    class Cfg:
+        tokenizer = Tok()
+        pad_to_multiple_of = 8
+
+    examples = [
+        {
+            "input_ids": [1, 5, 6, 2, 1, 7, 2],
+            "segment_ids": [1, 1, 1, 1, 2, 2, 2],
+            "labels": [-100, 5, 6, 2, -100, 7, 2],
+        },
+        {
+            "input_ids": [1, 9, 2],
+            "segment_ids": [1, 1, 1],
+            "labels": [-100, 9, 2],
+        },
+    ]
+
+    for collator_cls in (PreTrainingDataCollator, InstructionTuningDataCollator):
+        collator = collator_cls(Cfg())
+        fast = collator(examples)
+        import llm_training_tpu.native as native_mod
+
+        monkeypatch.setattr(native_mod, "pad_batch", lambda *a, **k: None)
+        slow = collator(examples)
+        monkeypatch.undo()
+        assert set(fast) == set(slow)
+        for key in fast:
+            np.testing.assert_array_equal(fast[key], slow[key], err_msg=f"{collator_cls.__name__}:{key}")
