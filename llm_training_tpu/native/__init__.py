@@ -30,26 +30,33 @@ _tried = False
 
 
 def _compile() -> Path | None:
-    if not _SOURCE.exists():
-        return None
-    digest = hashlib.sha256(_SOURCE.read_bytes()).hexdigest()[:16]
-    so_path = _BUILD_DIR / f"packing-{digest}.so"
-    if so_path.exists():
-        return so_path
-    _BUILD_DIR.mkdir(exist_ok=True)
-    # compile to a per-process temp name, then atomically rename: concurrent
-    # builders (datasets.map workers) never see a half-written .so, and a
-    # loser's rename just re-installs identical bytes
-    tmp_path = so_path.with_suffix(f".tmp-{os.getpid()}")
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SOURCE), "-o", str(tmp_path)]
+    # EVERYTHING here falls back to None on failure — an unwritable package
+    # dir or missing compiler must never break training (module contract)
+    tmp_path = None
     try:
+        if not _SOURCE.exists():
+            return None
+        digest = hashlib.sha256(_SOURCE.read_bytes()).hexdigest()[:16]
+        so_path = _BUILD_DIR / f"packing-{digest}.so"
+        if so_path.exists():
+            return so_path
+        _BUILD_DIR.mkdir(exist_ok=True)
+        # compile to a per-process temp name, then atomically rename:
+        # concurrent builders (datasets.map workers) never see a half-written
+        # .so, and a loser's rename just re-installs identical bytes
+        tmp_path = so_path.with_suffix(f".tmp-{os.getpid()}")
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SOURCE), "-o", str(tmp_path)]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.rename(tmp_path, so_path)
+        return so_path
     except (OSError, subprocess.SubprocessError) as e:
         logger.warning("native packing build failed (%s); using Python fallback", e)
-        tmp_path.unlink(missing_ok=True)
+        if tmp_path is not None:
+            try:
+                tmp_path.unlink(missing_ok=True)
+            except OSError:
+                pass
         return None
-    return so_path
 
 
 def lib() -> ctypes.CDLL | None:

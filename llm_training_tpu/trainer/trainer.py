@@ -258,16 +258,6 @@ class Trainer:
         micro_steps = cfg.max_steps * cfg.accumulate_grad_batches
         batches = datamodule.train_batches(start_step=start_micro)
         prefetcher = None
-        if cfg.prefetch_batches > 0:
-            from llm_training_tpu.data.prefetch import DevicePrefetcher
-
-            prefetcher = DevicePrefetcher(
-                batches,
-                batch_shardings,
-                depth=cfg.prefetch_batches,
-                host_aux_fn=self._batch_counts,
-            )
-            batches = iter(prefetcher)
 
         for cb in self.callbacks:
             if hasattr(cb, "on_fit_start"):
@@ -283,6 +273,18 @@ class Trainer:
         )
         step_time = time.perf_counter()
         try:
+            # constructed inside the try so an exception anywhere after the
+            # worker thread starts still reaches prefetcher.close()
+            if cfg.prefetch_batches > 0:
+                from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+                prefetcher = DevicePrefetcher(
+                    batches,
+                    batch_shardings,
+                    depth=cfg.prefetch_batches,
+                    host_aux_fn=self._batch_counts,
+                )
+                batches = iter(prefetcher)
             for micro in range(start_micro, micro_steps):
                 if prefetcher is not None:
                     batch, counts = next(batches)
