@@ -9,12 +9,16 @@ lacks: MFU reporting and a `jax.profiler` trace hook (SURVEY.md §5.1 notes
 the reference has no profiler integration at all).
 """
 
+from llm_training_tpu.callbacks.nan_guard import NanGuard, NanGuardConfig, NonFiniteLossError
 from llm_training_tpu.callbacks.loggers import JsonlLogger, JsonlLoggerConfig, WandbLogger, WandbLoggerConfig
 from llm_training_tpu.callbacks.output_redirection import OutputRedirection, OutputRedirectionConfig
 from llm_training_tpu.callbacks.profiler import ProfilerCallback, ProfilerCallbackConfig
 from llm_training_tpu.callbacks.time_estimator import TrainingTimeEstimator, TrainingTimeEstimatorConfig
 
 __all__ = [
+    "NanGuard",
+    "NanGuardConfig",
+    "NonFiniteLossError",
     "JsonlLogger",
     "JsonlLoggerConfig",
     "WandbLogger",

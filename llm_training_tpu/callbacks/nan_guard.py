@@ -1,0 +1,66 @@
+"""Divergence detection: stop or raise on non-finite loss / gradients.
+
+Capability parity: the reference's failure-detection surface (SURVEY.md
+§5.3) is fp16-specific — DeepSpeed loss-scale underflow with
+`raise_error_at_min_scale` (`deepspeed_strategy.py:104-108`) plus a
+skipped-steps metric (`:131-142`). bf16 training has no loss scale; the
+TPU-native equivalent watches the loss and grad norm directly, counts
+non-finite steps, and kills the run before it burns accelerator-hours on a
+diverged model. Checks run on log steps (host metrics already materialized
+there — no extra device sync)."""
+
+from __future__ import annotations
+
+import logging
+import math
+
+from pydantic import BaseModel, ConfigDict, Field
+
+logger = logging.getLogger(__name__)
+
+
+class NanGuardConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # consecutive non-finite log-steps tolerated before aborting; 0 = abort
+    # on the first one
+    patience: int = Field(0, ge=0)
+    # raise (crash the run, let the scheduler restart from the checkpoint)
+    # vs stop (end the fit cleanly)
+    action: str = Field("raise", pattern="^(raise|stop)$")
+
+
+class NonFiniteLossError(RuntimeError):
+    pass
+
+
+class NanGuard:
+    def __init__(self, config: NanGuardConfig | None = None):
+        self.config = config or NanGuardConfig()
+        self.non_finite_steps = 0  # total, the skipped-steps metric analogue
+        self._streak = 0
+
+    def on_step_end(self, trainer, step, metrics) -> None:
+        loss = float(metrics.get("loss", 0.0))
+        grad_norm = float(metrics.get("grad_norm", 0.0))
+        if math.isfinite(loss) and math.isfinite(grad_norm):
+            self._streak = 0
+            return
+        self.non_finite_steps += 1
+        self._streak += 1
+        logger.warning(
+            "non-finite training signal at step %d (loss=%s grad_norm=%s), streak %d",
+            step, loss, grad_norm, self._streak,
+        )
+        if self._streak > self.config.patience:
+            message = (
+                f"training diverged: non-finite loss/grad_norm for "
+                f"{self._streak} consecutive log steps (step {step})"
+            )
+            if self.config.action == "raise":
+                raise NonFiniteLossError(message)
+            logger.error("%s — stopping", message)
+            trainer.should_stop = True
+            # the diverged state must not become the newest checkpoint: a
+            # resume would restart from NaN weights
+            trainer.abort_final_save = True

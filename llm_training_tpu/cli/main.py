@@ -23,6 +23,27 @@ def _seed_everything(seed: int) -> None:
     np.random.seed(seed)
 
 
+def _apply_extra_config(config: dict) -> None:
+    """Top-level runtime flags (the reference ExtraConfig callback,
+    `lightning/callbacks/extra_config.py:13-45`): matmul precision (its
+    `float32_matmul_precision`) and a persistent XLA compilation cache (its
+    per-rank TRITON_CACHE_DIR analogue — one dir is safe for all hosts,
+    unlike Triton's)."""
+    import jax
+
+    precision = config.get("matmul_precision") or config.get("float32_matmul_precision")
+    if precision:
+        # torch names -> XLA precisions
+        precision = {"highest": "float32", "high": "tensorfloat32", "medium": "bfloat16"}.get(
+            str(precision), str(precision)
+        )
+        jax.config.update("jax_default_matmul_precision", precision)
+    cache_dir = config.get("compilation_cache_dir")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def _build(config: dict):
     from llm_training_tpu.trainer import Trainer, TrainerConfig
     from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
@@ -73,6 +94,7 @@ def main(argv: list[str] | None = None) -> int:
     from llm_training_tpu.parallel import initialize_distributed
 
     initialize_distributed()
+    _apply_extra_config(config)
 
     trainer, objective, datamodule = _build(config)
 

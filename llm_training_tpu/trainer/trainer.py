@@ -84,6 +84,9 @@ class Trainer:
         # time; live TrainState buffers are donated into the next step and
         # must never be cached by callbacks outside the current hook call
         self.should_stop = False
+        # callbacks set this when the state must NOT be persisted (e.g. the
+        # NaN guard stopping on divergence — saving would poison resume)
+        self.abort_final_save = False
         self.abstract_state = None
         self.last_step: int | None = None
         self.last_seq_len: int | None = None
@@ -266,6 +269,7 @@ class Trainer:
                 )
 
         self.should_stop = False
+        self.abort_final_save = False
         self.last_step = None
         self.last_metrics = None
         self.last_seq_len = (
@@ -340,7 +344,11 @@ class Trainer:
             if prefetcher is not None:
                 prefetcher.close()
 
-        if self.checkpointer is not None and self.last_step is not None:
+        if (
+            self.checkpointer is not None
+            and self.last_step is not None
+            and not self.abort_final_save
+        ):
             # label with the step actually reached: an early stop
             # (should_stop) must not masquerade as a completed run
             self.checkpointer.save(

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod launcher (the reference's SLURM wrapper scripts/train.sh
+# re-imagined for TPU pods): starts one llm-training-tpu process per host.
+#
+# Two launch modes:
+#   1. Cloud TPU pod slice (gcloud): fan the same command out to every worker;
+#      JAX self-discovers rank/coordinator from the TPU metadata server.
+#        ./scripts/train_tpu_pod.sh --tpu-name my-pod --zone us-east5-a \
+#            fit --config config/examples/llama-3.1/llama-3.1-8b_pt.yaml
+#   2. SLURM (sbatch/srun): one task per host; coordinates come from SLURM_*
+#      env (parallel/mesh.py::initialize_distributed reads them).
+#        sbatch --ntasks=16 --ntasks-per-node=1 scripts/train_tpu_pod.sh \
+#            fit --config cfg.yaml
+set -euo pipefail
+
+TPU_NAME=""
+ZONE=""
+ARGS=()
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --tpu-name) TPU_NAME="$2"; shift 2 ;;
+    --zone) ZONE="$2"; shift 2 ;;
+    *) ARGS+=("$1"); shift ;;
+  esac
+done
+
+if [[ -n "${TPU_NAME}" ]]; then
+  zone_flag=()
+  [[ -n "${ZONE}" ]] && zone_flag=(--zone "${ZONE}")
+  # %q-quote every arg so spaces/metacharacters survive the remote shell
+  remote_cmd="cd $(printf '%q' "$(pwd)") && python -m llm_training_tpu"
+  for a in "${ARGS[@]}"; do remote_cmd+=" $(printf '%q' "$a")"; done
+  exec gcloud compute tpus tpu-vm ssh "${TPU_NAME}" "${zone_flag[@]}" \
+    --worker=all \
+    --command "${remote_cmd}"
+fi
+
+if [[ -n "${SLURM_JOB_ID:-}" ]]; then
+  # under sbatch: launch one task per host; each process finds its rank in
+  # SLURM_PROCID and the coordinator via JAX_COORDINATOR_ADDRESS
+  head_node=$(scontrol show hostnames "${SLURM_JOB_NODELIST}" | head -n1)
+  export JAX_COORDINATOR_ADDRESS="${JAX_COORDINATOR_ADDRESS:-${head_node}:12345}"
+  exec srun --ntasks-per-node=1 python -m llm_training_tpu "${ARGS[@]}"
+fi
+
+# single host fallback
+exec python -m llm_training_tpu "${ARGS[@]}"

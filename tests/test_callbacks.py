@@ -149,3 +149,75 @@ def test_mfu_model():
     # attention term adds 12·L·H·S·T
     flops = transformer_step_flops(1000, 10, num_layers=2, hidden_size=8, seq_len=4)
     assert flops == 60000 + 12 * 2 * 8 * 4 * 10
+
+
+def test_nan_guard_raises_on_divergence():
+    from llm_training_tpu.callbacks import NanGuard, NanGuardConfig, NonFiniteLossError
+
+    guard = NanGuard(NanGuardConfig(patience=1))
+
+    class T:
+        should_stop = False
+
+    guard.on_step_end(T(), 1, {"loss": 1.0, "grad_norm": 2.0})
+    guard.on_step_end(T(), 2, {"loss": float("nan"), "grad_norm": 1.0})  # within patience
+    guard.on_step_end(T(), 3, {"loss": 1.0, "grad_norm": 1.0})  # streak resets
+    guard.on_step_end(T(), 4, {"loss": float("inf"), "grad_norm": 1.0})
+    with pytest.raises(NonFiniteLossError):
+        guard.on_step_end(T(), 5, {"loss": float("nan"), "grad_norm": 1.0})
+    assert guard.non_finite_steps == 3
+
+
+def test_nan_guard_stop_mode():
+    from llm_training_tpu.callbacks import NanGuard, NanGuardConfig
+
+    guard = NanGuard(NanGuardConfig(patience=0, action="stop"))
+
+    class T:
+        should_stop = False
+
+    trainer = T()
+    guard.on_step_end(trainer, 1, {"loss": float("nan"), "grad_norm": 1.0})
+    assert trainer.should_stop is True
+
+
+def test_extra_config_flags(monkeypatch):
+    import jax
+
+    from llm_training_tpu.cli.main import _apply_extra_config
+
+    before = jax.config.jax_default_matmul_precision
+    try:
+        _apply_extra_config({"matmul_precision": "highest"})
+        assert jax.config.jax_default_matmul_precision == "float32"
+        _apply_extra_config({"float32_matmul_precision": "bfloat16"})
+        assert jax.config.jax_default_matmul_precision == "bfloat16"
+    finally:
+        jax.config.update("jax_default_matmul_precision", before)
+
+
+def test_nan_guard_stop_skips_final_checkpoint(tmp_path):
+    """Regression: a divergence stop must not persist the NaN state as the
+    newest checkpoint."""
+    from llm_training_tpu.callbacks import NanGuard, NanGuardConfig
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    class Poison:
+        """Forces should_stop + abort via the guard on a fabricated metric."""
+
+        def __init__(self):
+            self.guard = NanGuard(NanGuardConfig(patience=0, action="stop"))
+
+        def on_step_end(self, trainer, step, metrics):
+            if step >= 2:
+                self.guard.on_step_end(trainer, step, {"loss": float("nan"), "grad_norm": 1.0})
+
+    ckpt = Checkpointer(CheckpointConfig(dirpath=str(tmp_path / "ckpt"), async_save=False))
+    trainer = Trainer(
+        TrainerConfig(max_steps=50, log_every_n_steps=1, mesh=MeshConfig()),
+        callbacks=[Poison()],
+        checkpointer=ckpt,
+    )
+    trainer.fit(_tiny_objective(), _tiny_dm())
+    assert trainer.last_step < 50
+    assert ckpt.manager.all_steps() == []  # nothing persisted
