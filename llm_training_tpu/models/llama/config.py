@@ -28,11 +28,14 @@ class LlamaConfig(BaseModelConfig):
     initializer_range: float = 0.02
     rms_norm_eps: float = 1e-6
     pad_token_id: int | None = None
-    bos_token_id: int = 1
-    eos_token_id: int = 2
+    bos_token_id: int | None = 1
+    eos_token_id: int | None = 2
     tie_word_embeddings: bool = False
     rope_theta: float = 10000.0
     attention_bias: bool = False
+    # Qwen2-style asymmetry: q/k/v carry biases, o_proj does not.
+    # None = same as attention_bias
+    attention_out_bias: bool | None = None
     attention_dropout: float = 0.0
     mlp_bias: bool = False
     rope_scaling: dict[str, Any] | None = None
@@ -58,6 +61,8 @@ class LlamaConfig(BaseModelConfig):
                 f"num_attention_heads ({self.num_attention_heads}) must be divisible "
                 f"by num_key_value_heads ({self.num_key_value_heads})"
             )
+        if self.attention_out_bias is None:
+            self.attention_out_bias = self.attention_bias
         if self.attention_dropout != 0.0:
             # fail loudly rather than silently training without the dropout a
             # user (or an HF config) asked for

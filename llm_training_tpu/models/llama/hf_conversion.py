@@ -30,12 +30,25 @@ _LAYER_PARAMS = [
     (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
 ]
 
-_LAYER_BIAS_PARAMS = [
+_LAYER_QKV_BIAS_PARAMS = [
     (("self_attn", "q_proj", "bias"), "self_attn.q_proj.bias", False),
     (("self_attn", "k_proj", "bias"), "self_attn.k_proj.bias", False),
     (("self_attn", "v_proj", "bias"), "self_attn.v_proj.bias", False),
+]
+
+# o_proj bias is gated separately: Qwen2 has q/k/v biases but none on o
+_LAYER_O_BIAS_PARAMS = [
     (("self_attn", "o_proj", "bias"), "self_attn.o_proj.bias", False),
 ]
+
+
+def _bias_params(config: LlamaConfig) -> list:
+    extra = []
+    if config.attention_bias:
+        extra += _LAYER_QKV_BIAS_PARAMS
+    if config.attention_out_bias:
+        extra += _LAYER_O_BIAS_PARAMS
+    return extra
 
 
 def _set_path(tree: dict, path: tuple[str, ...], value: Any) -> None:
@@ -85,9 +98,7 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    layer_params = list(_LAYER_PARAMS)
-    if config.attention_bias:
-        layer_params += _LAYER_BIAS_PARAMS
+    layer_params = _LAYER_PARAMS + _bias_params(config)
 
     def layer_value(i: int, hf_name: str, transpose: bool) -> np.ndarray:
         value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
@@ -118,9 +129,7 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    layer_params = list(_LAYER_PARAMS)
-    if config.attention_bias:
-        layer_params += _LAYER_BIAS_PARAMS
+    layer_params = _LAYER_PARAMS + _bias_params(config)
 
     for path, hf_name, transpose in layer_params:
         if config.scan_layers:
@@ -171,6 +180,15 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             if config.sliding_window
             else {}
         ),
+        # asymmetric bias (q/k/v yes, o no) only exists as Qwen2 in HF —
+        # exporting it as llama+attention_bias would leave o_proj.bias a
+        # missing key randomly initialized at from_pretrained time
+        **(
+            {"model_type": "qwen2", "architectures": ["Qwen2ForCausalLM"],
+             "attention_bias": None}
+            if config.attention_bias and not config.attention_out_bias
+            else {}
+        ),
     }
 
 
@@ -200,7 +218,14 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         eos_token_id=get("eos_token_id", 2),
         tie_word_embeddings=get("tie_word_embeddings", False),
         rope_theta=get("rope_theta", 10000.0),
-        attention_bias=get("attention_bias", False),
+        # Qwen2 hardcodes q/k/v biases with no o_proj bias (no config field
+        # in its HF config); explicit attention_bias wins where present
+        attention_bias=get("attention_bias", get("model_type") == "qwen2"),
+        attention_out_bias=(
+            False
+            if get("model_type") == "qwen2" and get("attention_bias") is None
+            else get("attention_bias", False)
+        ),
         attention_dropout=get("attention_dropout", 0.0),
         mlp_bias=get("mlp_bias", False),
         rope_scaling=get("rope_scaling"),

@@ -114,7 +114,7 @@ class LlamaAttention(nn.Module):
         out = self._attention(q, k, v, segment_ids)
         out = out.astype(hidden.dtype)
         out = out.reshape(batch, seq, cfg.num_attention_heads * head_dim)
-        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj", cfg.attention_bias)(out)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj", cfg.attention_out_bias)(out)
 
     def _attention(self, q, k, v, segment_ids):
         """Dispatch: ring attention over a sequence-sharded mesh when enabled,

@@ -334,6 +334,9 @@ class Trainer:
                     self.checkpointer is not None
                     and cfg.checkpoint_every_n_steps
                     and step % cfg.checkpoint_every_n_steps == 0
+                    # a guard may have flagged THIS step's state as diverged
+                    # (on_step_end runs first) — never persist it
+                    and not self.abort_final_save
                 ):
                     self.checkpointer.save(step, state, counters=dict(self.counters))
 

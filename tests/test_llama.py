@@ -174,3 +174,88 @@ def test_hf_round_trip():
     assert set(back) == set(sd)
     for key in sd:
         np.testing.assert_array_equal(back[key], sd[key], err_msg=key)
+
+
+# -------------------------------------------- sibling architectures (routing)
+
+
+def test_logits_parity_with_hf_mistral():
+    """Mistral routes to the Llama module (sliding window + GQA + SwiGLU)."""
+    torch = pytest.importorskip("torch")
+    from transformers import MistralConfig, MistralForCausalLM
+
+    hf_config = MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+    )
+    torch.manual_seed(0)
+    hf_model = MistralForCausalLM(hf_config).eval()
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.sliding_window == 8
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(4).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_qwen2():
+    """Qwen2 routes to the Llama module; its q/k/v projections carry biases
+    while o_proj does not — the asymmetry must survive conversion."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_config = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf_model = Qwen2ForCausalLM(hf_config).eval()
+    # qwen2 really has the asymmetric bias layout
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    assert "model.layers.0.self_attn.o_proj.bias" not in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(5).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_export_round_trip(tmp_path):
+    """Exporting a Qwen2-derived config must produce a checkpoint that
+    transformers loads with NO missing keys (asymmetric bias preserved)."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM, Qwen2Config, Qwen2ForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    hf_config = Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf_model = Qwen2ForCausalLM(hf_config).eval()
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    params = params_from_hf(hf_model.state_dict(), cfg)
+
+    out = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+    reloaded = AutoModelForCausalLM.from_pretrained(out).eval()
+    assert reloaded.config.model_type == "qwen2"
+
+    ids = np.random.default_rng(6).integers(0, 128, (1, 16))
+    with torch.no_grad():
+        a = hf_model(torch.tensor(ids)).logits.numpy()
+        b = reloaded(torch.tensor(ids)).logits.numpy()
+    np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-5)
