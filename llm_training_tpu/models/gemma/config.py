@@ -1,0 +1,89 @@
+"""Gemma 1/2 model config.
+
+Family member beyond the reference's named models (it covered Gemma only
+through `HFCausalLM`'s torch wrapping, `hf_causal_lm.py:22`); here the
+computation graph is native. `version=2` adds the Gemma-2 graph changes:
+pre+post sandwich norms, attention/final logit soft-capping, alternating
+sliding-window layers, and the query_pre_attn_scalar attention scale.
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class GemmaConfig(BaseModelConfig):
+    version: Literal[1, 2] = 1
+
+    vocab_size: int = 256000
+    hidden_size: int = 2048
+    intermediate_size: int = 16384
+    num_hidden_layers: int = 18
+    num_attention_heads: int = 8
+    num_key_value_heads: int = 1
+    head_dim: int = 256
+    max_position_embeddings: int = 8192
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    attention_bias: bool = False
+    pad_token_id: int | None = 0
+    bos_token_id: int | None = 2
+    eos_token_id: int | None = 1
+    tie_word_embeddings: bool = True  # always, both versions
+
+    # --- gemma 2 graph features
+    query_pre_attn_scalar: int | None = None  # None -> head_dim
+    attn_logit_softcapping: float | None = None
+    final_logit_softcapping: float | None = None
+    # sliding window on even layer indices (HF layer_types pattern)
+    sliding_window: int | None = None
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    scan_layers: bool = True
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "GemmaConfig":
+        if self.num_attention_heads % self.num_key_value_heads != 0:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be divisible "
+                f"by num_key_value_heads ({self.num_key_value_heads})"
+            )
+        if self.version == 1 and (
+            self.attn_logit_softcapping or self.final_logit_softcapping or self.sliding_window
+        ):
+            raise ValueError("softcapping/sliding_window are Gemma-2 (version=2) features")
+        if self.version == 2 and self.scan_layers and self.num_hidden_layers % 2 != 0:
+            raise ValueError(
+                "gemma-2 scan_layers scans (sliding, full) layer pairs; "
+                "num_hidden_layers must be even (disable scan_layers otherwise)"
+            )
+        return self
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import RoPEConfig
+
+        return RoPEConfig(
+            type="default",
+            base=self.rope_theta,
+            dim=self.head_dim,
+            max_position_embeddings=self.max_position_embeddings,
+        )
+
+    @property
+    def attention_scale(self) -> float:
+        base = self.query_pre_attn_scalar if self.query_pre_attn_scalar else self.head_dim
+        return float(base) ** -0.5
+
+    def layer_sliding_window(self, layer_idx: int) -> int | None:
+        """HF Gemma2 `layer_types`: 'sliding_attention' on even indices."""
+        if self.version == 2 and self.sliding_window and layer_idx % 2 == 0:
+            return self.sliding_window
+        return None

@@ -1,0 +1,259 @@
+"""Gemma 1/2 decoder, TPU-native.
+
+Graph differences vs Llama (all verified against HF
+`modeling_gemma.py`/`modeling_gemma2.py`):
+- RMSNorm multiplies by (1 + weight) with zero-initialized weight, and the
+  product happens in fp32 BEFORE the downcast ((x̂ * w).to(dtype), not
+  x̂.to(dtype) * w)
+- embeddings are scaled by sqrt(hidden_size) (cast to the compute dtype
+  first — the cast is numerics-visible in bf16 and HF does it this way)
+- MLP is GeGLU: down(gelu_tanh(gate) * up)
+- always-tied lm_head
+Gemma-2 (version=2) additionally:
+- sandwich norms: residual + post_norm(block(pre_norm(x))) for both attn
+  and mlp
+- attention soft-capping (the flash kernel's logits_soft_cap) and final
+  logit soft-capping (applied in compute_logits AND by the fused CE)
+- attention scale from query_pre_attn_scalar, not head_dim
+- sliding window on even layer indices; under scan_layers the scanned body
+  is a (sliding, full) layer PAIR so the alternation stays static
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.gemma.config import GemmaConfig
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+class GemmaRMSNorm(nn.Module):
+    eps: float
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (normed * (1.0 + weight.astype(jnp.float32))).astype(x.dtype)
+
+
+def _dense(config: GemmaConfig, features: int, logical_axes: tuple[str, str], name: str) -> nn.Dense:
+    return nn.Dense(
+        features=features,
+        use_bias=config.attention_bias,
+        dtype=config.compute_jnp_dtype,
+        param_dtype=config.param_jnp_dtype,
+        kernel_init=nn.with_logical_partitioning(
+            nn.initializers.normal(config.initializer_range), logical_axes
+        ),
+        bias_init=nn.with_logical_partitioning(
+            nn.initializers.zeros_init(), (logical_axes[-1],)
+        ),
+        name=name,
+    )
+
+
+class GemmaAttention(nn.Module):
+    config: GemmaConfig
+    sliding_window: int | None
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        q = _dense(cfg, cfg.num_attention_heads * cfg.head_dim, ("embed", "heads"), "q_proj")(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * cfg.head_dim, ("embed", "kv_heads"), "k_proj")(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * cfg.head_dim, ("embed", "kv_heads"), "v_proj")(hidden)
+        q = q.reshape(batch, seq, cfg.num_attention_heads, cfg.head_dim)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, cfg.head_dim)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, cfg.head_dim)
+        q, k = apply_rope(q, k, cos, sin)
+        out = dot_product_attention(
+            q, k, v,
+            segment_ids=segment_ids,
+            causal=True,
+            sliding_window=self.sliding_window,
+            logits_soft_cap=cfg.attn_logit_softcapping,
+            scale=cfg.attention_scale,
+            impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, cfg.num_attention_heads * cfg.head_dim)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj")(out)
+
+
+class GemmaMLP(nn.Module):
+    config: GemmaConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        gate = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "gate_proj")(hidden)
+        up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj")(hidden)
+        return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj")(
+            nn.gelu(gate, approximate=True) * up
+        )
+
+
+class GemmaDecoderLayer(nn.Module):
+    config: GemmaConfig
+    sliding_window: int | None
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+
+        attn_in = norm("input_layernorm")(hidden)
+        attn_out = GemmaAttention(cfg, self.sliding_window, name="self_attn")(
+            attn_in, segment_ids, cos, sin
+        )
+        if cfg.version == 2:
+            attn_out = norm("post_attention_layernorm")(attn_out)
+            hidden = hidden + attn_out
+            mlp_in = norm("pre_feedforward_layernorm")(hidden)
+            mlp_out = norm("post_feedforward_layernorm")(GemmaMLP(cfg, name="mlp")(mlp_in))
+            return hidden + mlp_out
+        hidden = hidden + attn_out
+        mlp_in = norm("post_attention_layernorm")(hidden)
+        return hidden + GemmaMLP(cfg, name="mlp")(mlp_in)
+
+
+class _ScannedBody(nn.Module):
+    """Scan body: one layer (gemma 1 / windowless gemma 2) or a
+    (sliding, full) pair (gemma 2 with sliding_window)."""
+
+    config: GemmaConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        if cfg.version == 2 and cfg.sliding_window:
+            hidden = GemmaDecoderLayer(cfg, cfg.sliding_window, name="sliding")(
+                hidden, segment_ids, cos, sin
+            )
+            hidden = GemmaDecoderLayer(cfg, None, name="full")(
+                hidden, segment_ids, cos, sin
+            )
+        else:
+            hidden = GemmaDecoderLayer(cfg, None, name="layer")(
+                hidden, segment_ids, cos, sin
+            )
+        return hidden, None
+
+
+def _remat_policy(config: GemmaConfig) -> Callable | None:
+    if not config.enable_gradient_checkpointing:
+        return None
+    if config.recompute_granularity == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+
+
+class Gemma(nn.Module):
+    """Gemma causal LM with the `CausalLMProto` surface."""
+
+    config: GemmaConfig
+
+    def _layers(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        policy = _remat_policy(cfg)
+        paired = cfg.version == 2 and cfg.sliding_window
+        if cfg.scan_layers:
+            body = _ScannedBody
+            if policy is not None:
+                body = nn.remat(_ScannedBody, policy=policy, prevent_cse=False)
+            length = cfg.num_hidden_layers // 2 if paired else cfg.num_hidden_layers
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=length,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            hidden, _ = scanned(hidden, segment_ids, cos, sin)
+            return hidden
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = GemmaDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(GemmaDecoderLayer, policy=policy, static_argnums=())
+            hidden = layer_cls(
+                cfg, cfg.layer_sliding_window(i), name=f"layers_{i}"
+            )(hidden, segment_ids, cos, sin)
+        return hidden
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        # sqrt(hidden) normalizer, cast before multiplying (HF numerics)
+        normalizer = jnp.asarray(cfg.hidden_size**0.5, dtype=inputs_embeds.dtype)
+        hidden = inputs_embeds * normalizer
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        hidden = self._layers(hidden, segment_ids, cos, sin)
+        hidden = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        logits = None
+        if compute_logits:
+            logits = embed_tokens.attend(hidden)
+            if cfg.final_logit_softcapping:
+                cap = cfg.final_logit_softcapping
+                logits = cap * jnp.tanh(logits / cap)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"  # always tied
