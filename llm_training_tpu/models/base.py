@@ -12,7 +12,7 @@ from typing import Literal
 
 import flax.struct
 import jax.numpy as jnp
-from pydantic import BaseModel, ConfigDict
+from pydantic import BaseModel, ConfigDict, field_validator
 
 
 _DTYPE_MAP = {
@@ -49,6 +49,21 @@ class BaseModelConfig(BaseModel):
     pre_trained_weights: str | None = None
     compute_dtype: DTypeName = "bfloat16"
     param_dtype: DTypeName = "float32"
+
+    @field_validator("compute_dtype")
+    @classmethod
+    def _no_fp16_compute(cls, value: str) -> str:
+        # fp16 without dynamic loss scaling silently under/overflows; TPUs are
+        # bf16-native (same exponent range as fp32), so the reference's fp16 +
+        # DeepSpeed loss-scale path (deepspeed_strategy.py:104-108) has no TPU
+        # analogue — reject rather than train broken
+        if value == "float16":
+            raise ValueError(
+                "compute_dtype='float16' is not supported: fp16 requires "
+                "dynamic loss scaling, which TPUs don't need — use 'bfloat16' "
+                "(same exponent range as fp32, MXU-native)"
+            )
+        return value
 
     @property
     def compute_jnp_dtype(self) -> jnp.dtype:

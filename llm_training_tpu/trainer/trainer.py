@@ -337,6 +337,9 @@ class Trainer:
                     # a guard may have flagged THIS step's state as diverged
                     # (on_step_end runs first) — never persist it
                     and not self.abort_final_save
+                    # guards only see metrics on log steps; the save gate must
+                    # not trust log cadence — check this step's loss directly
+                    and self._loss_finite(metrics, step)
                 ):
                     self.checkpointer.save(step, state, counters=dict(self.counters))
 
@@ -351,6 +354,7 @@ class Trainer:
             self.checkpointer is not None
             and self.last_step is not None
             and not self.abort_final_save
+            and self._loss_finite(self.last_metrics, self.last_step)
         ):
             # label with the step actually reached: an early stop
             # (should_stop) must not masquerade as a completed run
@@ -377,6 +381,21 @@ class Trainer:
             for cb in self.callbacks:
                 if hasattr(cb, "on_validation_end"):
                     cb.on_validation_end(self, step, {"val_loss": val_loss})
+
+    @staticmethod
+    def _loss_finite(metrics, step) -> bool:
+        """True when this step's loss can be persisted. Forces a device sync,
+        so it is called only on checkpoint steps — a diverged state must never
+        become the newest checkpoint regardless of log cadence."""
+        if metrics is None or "loss" not in metrics:
+            return True
+        loss = float(jax.device_get(metrics["loss"]))
+        if np.isfinite(loss):
+            return True
+        logger.warning(
+            "skipping checkpoint at step %d: non-finite loss %s", step, loss
+        )
+        return False
 
     @staticmethod
     def _batch_counts(batch: dict) -> tuple[int, int]:
@@ -447,10 +466,24 @@ class Trainer:
 
     def validate(self, objective, datamodule, state: TrainState) -> dict[str, float]:
         datamodule.setup()
-        with self.mesh or build_mesh(self.config.mesh), nn.logical_axis_rules(LOGICAL_AXIS_RULES):
-            eval_step = jax.jit(self._build_eval_step(objective))
+        mesh = self.mesh or build_mesh(self.config.mesh)
+        # same sharding discipline as fit/validate_from_checkpoint: explicit
+        # in_shardings (state shardings from fit if available, else the live
+        # arrays' own shardings)
+        state_shardings = (
+            self.state_shardings
+            if self.state_shardings is not None
+            else jax.tree.map(lambda x: x.sharding, state)
+        )
+        with mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+            eval_step = None
             losses, weights = [], []
             for batch in datamodule.val_batches():
+                if eval_step is None:
+                    eval_step = jax.jit(
+                        self._build_eval_step(objective),
+                        in_shardings=(state_shardings, _batch_shardings(batch, mesh)),
+                    )
                 out = jax.device_get(eval_step(state, batch))
                 losses.append(out["loss"])
                 weights.append(out["target_tokens"])

@@ -60,6 +60,7 @@ def _fit(callbacks, max_steps=12, log_every=2):
     return trainer
 
 
+@pytest.mark.slow
 def test_time_estimator_reports_throughput_and_extrapolation():
     est = TrainingTimeEstimator(
         TrainingTimeEstimatorConfig(num_steps=4, skip_first_n_steps=2)
@@ -74,6 +75,7 @@ def test_time_estimator_reports_throughput_and_extrapolation():
         assert 0 < est.result["mfu"] < 1
 
 
+@pytest.mark.slow
 def test_time_estimator_dry_run_stops_training():
     est = TrainingTimeEstimator(
         TrainingTimeEstimatorConfig(num_steps=2, skip_first_n_steps=0, stop_after_steps=4)
@@ -83,6 +85,7 @@ def test_time_estimator_dry_run_stops_training():
     assert est.result is not None
 
 
+@pytest.mark.slow
 def test_early_stop_checkpoint_labeled_with_actual_step(tmp_path):
     """Regression: a dry-run stop must not write its checkpoint under
     max_steps — that would block the real final save on resume."""
@@ -103,6 +106,7 @@ def test_early_stop_checkpoint_labeled_with_actual_step(tmp_path):
     assert max(steps) == trainer.last_step
 
 
+@pytest.mark.slow
 def test_jsonl_logger_writes_metrics_and_config(tmp_path):
     logger = JsonlLogger(JsonlLoggerConfig(save_dir=str(tmp_path), name="run1"))
     _fit([logger], max_steps=6, log_every=2)
@@ -112,6 +116,7 @@ def test_jsonl_logger_writes_metrics_and_config(tmp_path):
     assert all("loss" in r and "grad_norm" in r for r in records)
 
 
+@pytest.mark.slow
 def test_output_redirection_tees_to_log_file(tmp_path):
     import logging
 
@@ -196,6 +201,45 @@ def test_extra_config_flags(monkeypatch):
         jax.config.update("jax_default_matmul_precision", before)
 
 
+@pytest.mark.slow
+def test_non_log_step_divergence_never_checkpointed(tmp_path):
+    """The save gate must check the CURRENT step's loss, independent of log
+    cadence: with checkpoint_every_n_steps not a multiple of
+    log_every_n_steps, a divergence between log steps must not be persisted
+    as the newest checkpoint (VERDICT r2 weak #4)."""
+    import jax.numpy as jnp
+
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    class PoisonedTrainer(Trainer):
+        """Loss turns NaN from step 4 on — inside the jitted step, so the
+        host only ever sees it through the save gate / log-step pulls."""
+
+        def _build_step(self, objective, tx):
+            base = super()._build_step(objective, tx)
+
+            def step(state, batch):
+                new_state, metrics = base(state, batch)
+                metrics["loss"] = jnp.where(
+                    new_state.step >= 4, jnp.float32(jnp.nan), metrics["loss"]
+                )
+                return new_state, metrics
+
+            return step
+
+    ckpt = Checkpointer(CheckpointConfig(dirpath=str(tmp_path / "ckpt"), async_save=False))
+    trainer = PoisonedTrainer(
+        # log every 5, checkpoint every 3: steps 6/9 and the final save all
+        # fall between log steps — only the pre-divergence step 3 may persist
+        TrainerConfig(max_steps=7, log_every_n_steps=5, checkpoint_every_n_steps=3,
+                      mesh=MeshConfig()),
+        checkpointer=ckpt,
+    )
+    trainer.fit(_tiny_objective(), _tiny_dm())
+    assert ckpt.manager.all_steps() == [3]
+
+
+@pytest.mark.slow
 def test_nan_guard_stop_skips_final_checkpoint(tmp_path):
     """Regression: a divergence stop must not persist the NaN state as the
     newest checkpoint."""
