@@ -45,9 +45,21 @@ class JsonlLogger:
         return self._file
 
     def on_fit_start(self, trainer, objective, datamodule, start_step) -> None:
-        run_config = getattr(getattr(trainer, "checkpointer", None), "run_config", None)
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        # one metadata snapshot per run: reuse the checkpointer's (collected
+        # at construction) so the checkpoint meta and the run dir record the
+        # SAME world/env/rev; collect only when no checkpointer exists
+        ckpt = getattr(trainer, "checkpointer", None)
+        run_metadata = getattr(ckpt, "run_metadata", None)
+        if run_metadata is None:
+            from llm_training_tpu.run_metadata import collect_run_metadata
+
+            run_metadata = collect_run_metadata()
+        (self.run_dir / "run_metadata.json").write_text(
+            json.dumps(run_metadata, indent=2, default=str)
+        )
+        run_config = getattr(ckpt, "run_config", None)
         if run_config:
-            self.run_dir.mkdir(parents=True, exist_ok=True)
             (self.run_dir / "config.json").write_text(json.dumps(run_config, indent=2, default=str))
 
     def on_step_end(self, trainer, step, metrics) -> None:

@@ -1,0 +1,81 @@
+"""Run-environment metadata capture.
+
+Capability parity: the reference's `SaveConfigCallback` uploads a code
+snapshot plus SLURM/world-size metadata alongside the resolved config
+(`lightning/callbacks/save_config_callback.py:15-41`) so a run can be
+reconstructed post-hoc. Here the equivalent record — world topology, launcher
+environment, git revision, library versions — is embedded in every checkpoint
+(`Checkpointer.save` meta) and written to the JSONL run dir.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+# launcher / cluster env vars worth preserving (SLURM + TPU pod + explicit
+# coordinator wiring — the same set `initialize_distributed` reads)
+_ENV_KEYS = (
+    "SLURM_JOB_ID",
+    "SLURM_JOB_NAME",
+    "SLURM_NNODES",
+    "SLURM_NODEID",
+    "SLURM_PROCID",
+    "SLURM_NTASKS",
+    "SLURM_NODELIST",
+    "JAX_COORDINATOR_ADDRESS",
+    "JAX_NUM_PROCESSES",
+    "JAX_PROCESS_ID",
+    "TPU_WORKER_ID",
+    "TPU_WORKER_HOSTNAMES",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def _git_revision(cwd: str | None = None) -> dict:
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=5,
+        )
+        if rev.returncode != 0:
+            return {}
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=cwd, capture_output=True, text=True, timeout=5,
+        )
+        return {
+            "git_rev": rev.stdout.strip(),
+            "git_dirty": bool(dirty.stdout.strip()) if dirty.returncode == 0 else None,
+        }
+    except (OSError, subprocess.TimeoutExpired):
+        return {}
+
+
+def collect_run_metadata() -> dict:
+    """World size, launcher env, git rev, versions — JSON-serializable."""
+    meta: dict = {
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "argv": list(sys.argv),
+        "python": sys.version.split()[0],
+        "env": {k: os.environ[k] for k in _ENV_KEYS if k in os.environ},
+    }
+    # resolve the rev of the framework checkout itself, not the caller's cwd
+    meta.update(_git_revision(cwd=os.path.dirname(os.path.dirname(__file__))))
+    try:
+        import jax
+
+        meta["world"] = {
+            "num_processes": jax.process_count(),
+            "process_index": jax.process_index(),
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "backend": jax.default_backend(),
+            "device_kind": jax.devices()[0].device_kind,
+        }
+        meta["jax_version"] = jax.__version__
+    except Exception:  # pragma: no cover — jax init failure must not kill saves
+        pass
+    return meta

@@ -52,6 +52,11 @@ class Checkpointer:
             raise ValueError("CheckpointConfig.dirpath is required")
         self.config = config
         self.run_config = run_config or {}
+        # world size / launcher env / git rev, captured once at run start
+        # (reference save_config_callback.py:15-41) — embedded in every save
+        from llm_training_tpu.run_metadata import collect_run_metadata
+
+        self.run_metadata = collect_run_metadata()
         self.directory = Path(config.dirpath).absolute()
         self.manager = ocp.CheckpointManager(
             self.directory,
@@ -75,6 +80,7 @@ class Checkpointer:
             "step": step,
             "counters": counters or {},
             "config": self.run_config,
+            "run_metadata": self.run_metadata,
         }
         self.manager.save(
             step,
