@@ -12,6 +12,7 @@ the reference has no profiler integration at all).
 from llm_training_tpu.callbacks.nan_guard import NanGuard, NanGuardConfig, NonFiniteLossError
 from llm_training_tpu.callbacks.loggers import JsonlLogger, JsonlLoggerConfig, WandbLogger, WandbLoggerConfig
 from llm_training_tpu.callbacks.output_redirection import OutputRedirection, OutputRedirectionConfig
+from llm_training_tpu.callbacks.progress import ProgressBar, ProgressBarConfig
 from llm_training_tpu.callbacks.profiler import ProfilerCallback, ProfilerCallbackConfig
 from llm_training_tpu.callbacks.time_estimator import TrainingTimeEstimator, TrainingTimeEstimatorConfig
 
@@ -25,6 +26,8 @@ __all__ = [
     "WandbLoggerConfig",
     "OutputRedirection",
     "OutputRedirectionConfig",
+    "ProgressBar",
+    "ProgressBarConfig",
     "ProfilerCallback",
     "ProfilerCallbackConfig",
     "TrainingTimeEstimator",
