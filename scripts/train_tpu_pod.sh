@@ -15,14 +15,29 @@ set -euo pipefail
 
 TPU_NAME=""
 ZONE=""
+DRY_RUN=0
 ARGS=()
 while [[ $# -gt 0 ]]; do
   case "$1" in
     --tpu-name) TPU_NAME="$2"; shift 2 ;;
     --zone) ZONE="$2"; shift 2 ;;
+    --dry-run) DRY_RUN=1; shift ;;  # print the composed command; don't launch
     *) ARGS+=("$1"); shift ;;
   esac
 done
+
+# run or (under --dry-run) print the final command — lets tests assert the
+# exact composed command line without hardware or gcloud/srun installed
+launch() {
+  if [[ "${DRY_RUN}" -eq 1 ]]; then
+    printf '%q ' "$@"; printf '\n'
+    # env the command would run with, for tests to assert (stderr keeps the
+    # stdout contract to exactly the composed command line)
+    echo "JAX_COORDINATOR_ADDRESS=${JAX_COORDINATOR_ADDRESS:-}" >&2
+    exit 0
+  fi
+  exec "$@"
+}
 
 if [[ -n "${TPU_NAME}" ]]; then
   zone_flag=()
@@ -30,7 +45,7 @@ if [[ -n "${TPU_NAME}" ]]; then
   # %q-quote every arg so spaces/metacharacters survive the remote shell
   remote_cmd="cd $(printf '%q' "$(pwd)") && python -m llm_training_tpu"
   for a in "${ARGS[@]}"; do remote_cmd+=" $(printf '%q' "$a")"; done
-  exec gcloud compute tpus tpu-vm ssh "${TPU_NAME}" "${zone_flag[@]}" \
+  launch gcloud compute tpus tpu-vm ssh "${TPU_NAME}" "${zone_flag[@]}" \
     --worker=all \
     --command "${remote_cmd}"
 fi
@@ -40,8 +55,8 @@ if [[ -n "${SLURM_JOB_ID:-}" ]]; then
   # SLURM_PROCID and the coordinator via JAX_COORDINATOR_ADDRESS
   head_node=$(scontrol show hostnames "${SLURM_JOB_NODELIST}" | head -n1)
   export JAX_COORDINATOR_ADDRESS="${JAX_COORDINATOR_ADDRESS:-${head_node}:12345}"
-  exec srun --ntasks-per-node=1 python -m llm_training_tpu "${ARGS[@]}"
+  launch srun --ntasks-per-node=1 python -m llm_training_tpu "${ARGS[@]}"
 fi
 
 # single host fallback
-exec python -m llm_training_tpu "${ARGS[@]}"
+launch python -m llm_training_tpu "${ARGS[@]}"
