@@ -47,6 +47,7 @@ class _Rec:
         self.losses[step] = float(metrics["loss"])
 
 
+@pytest.mark.slow
 def test_save_resume_matches_uninterrupted(devices, tmp_path):
     # straight 10-step run
     rec_full = _Rec()
@@ -87,6 +88,7 @@ def test_save_resume_matches_uninterrupted(devices, tmp_path):
     assert t2.counters == full_counters
 
 
+@pytest.mark.slow
 def test_validate_from_checkpoint(devices, tmp_path):
     ckpt_dir = str(tmp_path / "v")
     trainer = Trainer(
@@ -103,6 +105,7 @@ def test_validate_from_checkpoint(devices, tmp_path):
     assert np.isfinite(result["val_loss"])
 
 
+@pytest.mark.slow
 def test_checkpoint_embeds_config(devices, tmp_path):
     ckpt_dir = str(tmp_path / "c")
     run_config = {"model": {"class_path": "llm_training_tpu.lms.CLM"}, "note": "hi"}
@@ -153,6 +156,7 @@ def _write_config(tmp_path, **extra):
     return path
 
 
+@pytest.mark.slow
 def test_cli_fit_and_validate(devices, tmp_path, capsys):
     from llm_training_tpu.cli.main import main
 

@@ -4,6 +4,7 @@ import sys
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 from data_fixtures import text_dataset, tiny_tokenizer
 from llm_training_tpu.data.pre_training import (
@@ -30,6 +31,7 @@ def _module(**kwargs):
     return module
 
 
+@pytest.mark.slow
 def test_packed_pretraining_trains(devices):
     datamodule = _module()
     objective = CLM(

@@ -68,6 +68,7 @@ class _Rec:
         self.metrics.append({k: float(v) for k, v in metrics.items() if np.ndim(v) == 0})
 
 
+@pytest.mark.slow
 def test_dpo_initial_loss_is_log2_and_improves(devices):
     objective = DPO(
         DPOConfig(
@@ -122,6 +123,7 @@ def test_dpo_label_smoothing_changes_loss():
     np.testing.assert_allclose(float(got), expected, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_orpo_trains_and_metrics(devices):
     objective = ORPO(
         ORPOConfig(

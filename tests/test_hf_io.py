@@ -83,6 +83,7 @@ def test_load_pretrained_with_shardings(hf_llama_dir, devices):
     assert isinstance(leaf, jax.Array) and leaf.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_export_roundtrip_through_transformers(tmp_path):
     """our params -> save_hf_checkpoint -> transformers forward == ours."""
     import torch
@@ -104,6 +105,7 @@ def test_export_roundtrip_through_transformers(tmp_path):
     np.testing.assert_allclose(np.asarray(ours), theirs, atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_sharded_export(tmp_path):
     """Multiple safetensors shards + index.json when over the shard budget."""
     cfg = LlamaConfig(**TINY_HF, compute_dtype="float32", param_dtype="float32")
@@ -173,6 +175,7 @@ def _tiny_fit(tmp_path, pre_trained=None, max_steps=1, lr=1e-3):
     return trainer, objective, state, tmp_path / "ckpt"
 
 
+@pytest.mark.slow
 def test_convert_to_hf_script(tmp_path):
     """fit -> checkpoint -> convert -> transformers can load the export."""
     import torch
@@ -225,6 +228,7 @@ def test_dpo_pretrained_loads_policy_and_ref(hf_llama_dir):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_trainer_pretrained_init(tmp_path, hf_llama_dir):
     """pre_trained_weights + lr=0: params after one step == the HF weights."""
     _, objective, state, _ = _tiny_fit(tmp_path, pre_trained=hf_llama_dir, lr=0.0)

@@ -31,6 +31,7 @@ def _init_and_run(cfg, ids, **kwargs):
     return model.apply(params, ids, **kwargs), params
 
 
+@pytest.mark.slow
 def test_forward_shapes_and_dtypes():
     cfg = LlamaConfig(**TINY)
     ids = jnp.ones((2, 10), jnp.int32)
@@ -47,6 +48,7 @@ def test_hidden_only_forward():
     assert out.last_hidden_states is not None
 
 
+@pytest.mark.slow
 def test_scan_and_loop_layers_agree():
     ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 12)))
     cfg_scan = LlamaConfig(**TINY, scan_layers=True)
@@ -64,6 +66,7 @@ def test_scan_and_loop_layers_agree():
 
 
 @pytest.mark.parametrize("granularity", ["full", "selective"])
+@pytest.mark.slow
 def test_remat_matches_no_remat(granularity):
     ids = jnp.asarray(np.random.default_rng(1).integers(0, 128, (1, 8)))
     cfg = LlamaConfig(**TINY)
@@ -98,6 +101,7 @@ def test_tied_embeddings():
     assert out.logits.shape == (1, 4, 128)
 
 
+@pytest.mark.slow
 def test_packed_forward_matches_separate_docs():
     """End-to-end (full model) packing parity: one packed row with segment ids
     == two separate unpadded forwards."""

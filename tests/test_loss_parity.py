@@ -119,6 +119,7 @@ def _train_ours(hf_model, data) -> list[float]:
     return losses
 
 
+@pytest.mark.slow
 def test_loss_curves_match_torch_reference():
     data = _data()
     hf_model = _hf_model()

@@ -73,6 +73,7 @@ def test_forward_matches_xla(name, hq, hkv, window, cap, packed):
     np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_gradients_match_xla():
     rng = np.random.default_rng(0)
     batch, seq, hq, hkv, d = 1, 256, 4, 2, 32
@@ -95,6 +96,7 @@ def test_gradients_match_xla():
         np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_gradients_match_xla_softcap_window():
     rng = np.random.default_rng(1)
     batch, seq, h, d = 1, 128, 2, 32

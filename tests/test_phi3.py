@@ -63,6 +63,7 @@ def test_hf_round_trip_fused():
         np.testing.assert_array_equal(back[key], sd[key], err_msg=key)
 
 
+@pytest.mark.slow
 def test_sliding_window_changes_output():
     cfg_full = Phi3Config(**TINY, compute_dtype="float32")
     cfg_win = Phi3Config(**TINY, compute_dtype="float32", sliding_window=4)
@@ -132,6 +133,7 @@ def test_longrope_short_long_parity_with_hf():
         )
 
 
+@pytest.mark.slow
 def test_attention_compute_dtype():
     cfg = Phi3Config(**TINY, compute_dtype="bfloat16", attention_compute_dtype="float32")
     ids = jnp.ones((1, 8), jnp.int32)

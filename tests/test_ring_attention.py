@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # every case spins the 8-way CPU mesh
 from jax.sharding import Mesh, PartitionSpec as P
 
 from llm_training_tpu.ops.attention import dot_product_attention

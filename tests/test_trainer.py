@@ -56,6 +56,7 @@ class _LossRecorder:
         self.losses.append(float(metrics["loss"]))
 
 
+@pytest.mark.slow
 def test_loss_decreases_fsdp(devices):
     trainer, objective, datamodule = _make()
     rec = _LossRecorder()
@@ -67,6 +68,7 @@ def test_loss_decreases_fsdp(devices):
     assert trainer.counters["consumed_tokens"] == 40 * 8 * 64
 
 
+@pytest.mark.slow
 def test_tp_matches_fsdp_losses(devices):
     results = []
     for mesh in (MeshConfig(), MeshConfig(fsdp_size=2, tensor_parallel_size=4)):
@@ -78,6 +80,7 @@ def test_tp_matches_fsdp_losses(devices):
     np.testing.assert_allclose(results[0], results[1], rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_neftune_trains(devices):
     trainer, objective, datamodule = _make(max_steps=10, neftune_alpha=5.0)
     rec = _LossRecorder()
@@ -86,6 +89,7 @@ def test_neftune_trains(devices):
     assert np.isfinite(rec.losses).all()
 
 
+@pytest.mark.slow
 def test_grad_accumulation(devices):
     objective = CLM(
         CLMConfig(
@@ -116,6 +120,7 @@ def test_indivisible_batch_raises(devices):
         trainer.fit(objective, datamodule)
 
 
+@pytest.mark.slow
 def test_frozen_modules(devices):
     trainer, objective, datamodule = _make(max_steps=3)
     objective.config.frozen_modules = ["embed_tokens"]
