@@ -10,6 +10,7 @@ families. Here, models are flax.linen Modules whose parameters carry
 """
 
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
+from llm_training_tpu.models.gemma import Gemma, GemmaConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
 from llm_training_tpu.models.phi3 import Phi3, Phi3Config
@@ -17,6 +18,8 @@ from llm_training_tpu.models.phi3 import Phi3, Phi3Config
 __all__ = [
     "BaseModelConfig",
     "CausalLMOutput",
+    "Gemma",
+    "GemmaConfig",
     "HFCausalLM",
     "HFCausalLMConfig",
     "Llama",

@@ -1,0 +1,4 @@
+from llm_training_tpu.models.gemma.config import GemmaConfig
+from llm_training_tpu.models.gemma.model import Gemma
+
+__all__ = ["Gemma", "GemmaConfig"]

@@ -1,0 +1,190 @@
+"""Gemma 1/2 <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to the Gemma
+family (which the reference reaches only through `HFCausalLM`'s torch
+wrapping, `hf_causal_lm.py:22`). HF layer names match our module names
+one-to-one; the wrinkles are (a) always-tied embeddings (no lm_head key in
+either direction), (b) Gemma-2's two extra sandwich norms per layer, and
+(c) the scan layout for Gemma-2 with sliding windows, which stacks
+(sliding, full) layer *pairs*: HF layer 2k -> ('layers','sliding',...)[k],
+HF layer 2k+1 -> ('layers','full',...)[k].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.gemma.config import GemmaConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+
+# (our in-layer path, hf in-layer name, transpose) — shared by both versions
+_LAYER_PARAMS = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+    (("mlp", "gate_proj", "kernel"), "mlp.gate_proj.weight", True),
+    (("mlp", "up_proj", "kernel"), "mlp.up_proj.weight", True),
+    (("mlp", "down_proj", "kernel"), "mlp.down_proj.weight", True),
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+_V2_NORM_PARAMS = [
+    (("pre_feedforward_layernorm", "weight"), "pre_feedforward_layernorm.weight", False),
+    (("post_feedforward_layernorm", "weight"), "post_feedforward_layernorm.weight", False),
+]
+
+
+def _layer_params(config: GemmaConfig) -> list:
+    return _LAYER_PARAMS + (_V2_NORM_PARAMS if config.version == 2 else [])
+
+
+def _paired(config: GemmaConfig) -> bool:
+    return config.version == 2 and bool(config.sliding_window)
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: GemmaConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path: tuple[str, ...], value: np.ndarray) -> None:
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    # always-tied: HF gemma checkpoints carry no lm_head key
+
+    layer_params = _layer_params(config)
+
+    def layer_value(i: int, hf_name: str, transpose: bool) -> np.ndarray:
+        value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+        return value.T if transpose else value
+
+    if config.scan_layers and _paired(config):
+        # even HF layers are the sliding half of each scanned pair, odd the full
+        for branch, offset in (("sliding", 0), ("full", 1)):
+            for path, hf_name, transpose in layer_params:
+                stacked = np.stack([
+                    layer_value(2 * k + offset, hf_name, transpose)
+                    for k in range(config.num_hidden_layers // 2)
+                ])
+                put(("layers", branch) + path, stacked)
+    elif config.scan_layers:
+        for path, hf_name, transpose in layer_params:
+            stacked = np.stack([
+                layer_value(i, hf_name, transpose)
+                for i in range(config.num_hidden_layers)
+            ])
+            put(("layers", "layer") + path, stacked)
+    else:
+        for i in range(config.num_hidden_layers):
+            for path, hf_name, transpose in layer_params:
+                put((f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: GemmaConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+
+    def emit(i: int, path: tuple[str, ...], hf_name: str, transpose: bool,
+             value: np.ndarray) -> None:
+        out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+
+    for path, hf_name, transpose in _layer_params(config):
+        if config.scan_layers and _paired(config):
+            for branch, offset in (("sliding", 0), ("full", 1)):
+                stacked = np.asarray(_get_path(p, ("layers", branch) + path))
+                for k in range(config.num_hidden_layers // 2):
+                    emit(2 * k + offset, path, hf_name, transpose, stacked[k])
+        elif config.scan_layers:
+            stacked = np.asarray(_get_path(p, ("layers", "layer") + path))
+            for i in range(config.num_hidden_layers):
+                emit(i, path, hf_name, transpose, stacked[i])
+        else:
+            for i in range(config.num_hidden_layers):
+                value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+                emit(i, path, hf_name, transpose, value)
+    return out
+
+
+def config_to_hf(config: GemmaConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    common = {
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.head_dim,
+        "hidden_act": "gelu_pytorch_tanh",
+        "hidden_activation": "gelu_pytorch_tanh",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": True,
+        "rope_theta": config.rope_theta,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": 0.0,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+    if config.version == 2:
+        return {
+            "architectures": ["Gemma2ForCausalLM"],
+            "model_type": "gemma2",
+            "query_pre_attn_scalar": config.query_pre_attn_scalar or config.head_dim,
+            "attn_logit_softcapping": config.attn_logit_softcapping,
+            "final_logit_softcapping": config.final_logit_softcapping,
+            "sliding_window": config.sliding_window,
+            **common,
+        }
+    return {"architectures": ["GemmaForCausalLM"], "model_type": "gemma", **common}
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> GemmaConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    version = 2 if get("model_type") == "gemma2" else 1
+    return GemmaConfig(**{**dict(
+        version=version,
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        head_dim=get("head_dim", 256),
+        max_position_embeddings=get("max_position_embeddings", 8192),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        rope_theta=get("rope_theta", 10000.0),
+        attention_bias=get("attention_bias", False),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id", 2),
+        eos_token_id=get("eos_token_id", 1),
+        **(dict(
+            query_pre_attn_scalar=get("query_pre_attn_scalar"),
+            attn_logit_softcapping=get("attn_logit_softcapping"),
+            final_logit_softcapping=get("final_logit_softcapping"),
+            sliding_window=get("sliding_window"),
+        ) if version == 2 else {}),
+    ), **overrides})

@@ -21,13 +21,12 @@ Gemma-2 (version=2) additionally:
 
 from __future__ import annotations
 
-from typing import Callable
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.gemma.config import GemmaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention
 from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
@@ -157,12 +156,6 @@ class _ScannedBody(nn.Module):
         return hidden, None
 
 
-def _remat_policy(config: GemmaConfig) -> Callable | None:
-    if not config.enable_gradient_checkpointing:
-        return None
-    if config.recompute_granularity == "full":
-        return jax.checkpoint_policies.nothing_saveable
-    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
 
 
 class Gemma(nn.Module):

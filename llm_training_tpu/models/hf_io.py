@@ -32,6 +32,7 @@ _SAFE_SINGLE = "model.safetensors"
 _FAMILIES: dict[str, str] = {
     "LlamaConfig": "llm_training_tpu.models.llama.hf_conversion",
     "Phi3Config": "llm_training_tpu.models.phi3.hf_conversion",
+    "GemmaConfig": "llm_training_tpu.models.gemma.hf_conversion",
 }
 
 
@@ -226,6 +227,8 @@ _ARCH_TO_FAMILY = {
     "mistral": "llm_training_tpu.models.Llama",  # same graph: GQA + SwiGLU + RMSNorm
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
     "phi3": "llm_training_tpu.models.Phi3",
+    "gemma": "llm_training_tpu.models.Gemma",
+    "gemma2": "llm_training_tpu.models.Gemma",  # version=2 graph features
 }
 
 
