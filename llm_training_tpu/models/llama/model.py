@@ -21,13 +21,12 @@ sharding plans (`:197-268`) — re-designed as a single flax.linen module tree:
 
 from __future__ import annotations
 
-from typing import Callable
-
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.llama.config import LlamaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention, rms_norm
 from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
@@ -225,15 +224,6 @@ class _ScannedLayer(nn.Module):
         return hidden, None
 
 
-def _remat_policy(config: LlamaConfig) -> Callable | None:
-    if not config.enable_gradient_checkpointing:
-        return None
-    if config.recompute_granularity == "full":
-        return jax.checkpoint_policies.nothing_saveable
-    # 'selective': save matmul (MXU) outputs, recompute elementwise/softmax —
-    # the spirit of the reference's core-attention-only checkpointing
-    # (`llama_model.py:506-534`): cheap ops recompute, big ops persist.
-    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
 
 
 class Llama(nn.Module):

@@ -1,0 +1,36 @@
+"""Shared activation-checkpointing policy for decoder models.
+
+Capability parity: the reference's full/selective recompute switch
+(`llama_model.py:98-121,506-534`), one policy for every family:
+
+- 'full': save nothing inside a layer; recompute the whole layer body in
+  the backward (the memory floor — mandatory on 16G-HBM chips at practical
+  batch sizes).
+- 'selective': save the attention output + logsumexp (tagged 'flash_out' /
+  'flash_lse' in ops/attention.py and ops/pallas/flash_attention.py),
+  recompute everything else — the mirror image of the reference's
+  core-attention-only checkpointing. Attention is the one block whose
+  recompute re-runs a whole kernel; projections/MLP recompute is plain
+  matmuls the MXU overlaps with the backward. Costs seq*hidden*2B per
+  layer, vs `dots_with_no_batch_dims_saveable` (the usual 'save all
+  matmuls'), which needs ~10x more HBM than exists at practical batches
+  (54G at batch 64x2048 on a 317M model, measured r3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+
+def remat_policy(config: Any) -> Callable | None:
+    """Checkpoint policy from a config carrying
+    `enable_gradient_checkpointing` + `recompute_granularity`."""
+    if not config.enable_gradient_checkpointing:
+        return None
+    if config.recompute_granularity == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    return jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse"
+    )

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -95,7 +96,12 @@ def _xla_attention(
         # the invariant the flash kernel and ring combiner provide
         probs = jnp.where(mask[:, :, None].any(-1, keepdims=True), probs, 0.0)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v.dtype), v)
-    return out.reshape(batch, q_len, num_q_heads, head_dim)
+    out = out.reshape(batch, q_len, num_q_heads, head_dim)
+    # same remat tag the flash kernel carries, so
+    # recompute_granularity='selective' saves the attention output on this
+    # path too (its backward still rebuilds softmax internals from q/k —
+    # autodiff residuals, unlike the flash kernel's O/LSE-only backward)
+    return checkpoint_name(out, "flash_out")
 
 
 def dot_product_attention(

@@ -27,15 +27,25 @@ wins in the reference, reproduced at the tile level.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _LANES = 128
+
+# default tile sizes (overridable per call, or via env for experiments).
+# 1024x1024 measured best on v5e @ seq 2048: per-invocation grid overhead
+# (~us of scalar-core dispatch + DMA descriptor setup) dominates the 0.7us
+# of MXU work in a 512 tile; quadrupling the tile amortizes it 4x and still
+# fits VMEM (scores f32 4M + q/k/v/acc ~1.3M of ~16M).
+_DEFAULT_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", 1024))
+_DEFAULT_BLOCK_K = int(os.environ.get("FLASH_BLOCK_K", 1024))
 
 
 def _round_up(x: int, m: int) -> int:
@@ -72,7 +82,38 @@ def _check_block_divisibility(sq: int, skv: int, block_q: int, block_k: int) -> 
         )
 
 
-def _block_mask(
+def _seg_mask(seg_q, seg_kv):
+    """(block_q, block_k) segment mask (True = attend): same packed document,
+    and the q row is not padding (seg 0)."""
+    return (seg_q[:, None] == seg_kv[None, :]) & (seg_q[:, None] > 0)
+
+
+def _seg_uniform(seg_q, seg_kv):
+    """Scalar predicate: both blocks hold one identical non-padding segment,
+    so the segment mask is all-True and can be skipped. Four cheap vector
+    reduces per tile buy skipping the (block_q, block_k) broadcast compare +
+    select on the common case (unpacked data, or packed tiles away from
+    document boundaries)."""
+    q_min = jnp.min(seg_q)
+    return (
+        (q_min == jnp.max(seg_q))
+        & (q_min == jnp.min(seg_kv))
+        & (q_min == jnp.max(seg_kv))
+        & (q_min > 0)
+    )
+
+
+def _masked_dispatch(visit, interior, uniform, body):
+    """Run `body(with_pos, with_seg)` under the cheapest applicable mask
+    variant. All four specializations are compiled; exactly one executes per
+    tile (scalar-predicated branches, not lane masking)."""
+    pl.when(visit & interior & uniform)(lambda: body(False, False))
+    pl.when(visit & interior & ~uniform)(lambda: body(False, True))
+    pl.when(visit & ~interior & uniform)(lambda: body(True, False))
+    pl.when(visit & ~interior & ~uniform)(lambda: body(True, True))
+
+
+def _pos_mask(
     i,
     j,
     block_q: int,
@@ -80,22 +121,45 @@ def _block_mask(
     q_offset: int,
     causal: bool,
     sliding_window: int | None,
-    seg_q,
-    seg_kv,
 ):
-    """(block_q, block_k) boolean mask (True = attend) for tile (i, j)."""
+    """(block_q, block_k) position mask for tile (i, j) — built only on
+    boundary tiles (see `_pos_interior`); interior tiles skip the iota and
+    compare VPU work entirely, which is most of a flash tile's non-MXU cost."""
     q_pos = (
         i * block_q
         + q_offset
         + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     )
     k_pos = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    mask = (seg_q[:, None] == seg_kv[None, :]) & (seg_q[:, None] > 0)
+    mask = jnp.ones((block_q, block_k), jnp.bool_)
     if causal:
         mask &= k_pos <= q_pos
     if sliding_window is not None:
         mask &= q_pos - k_pos < sliding_window
     return mask
+
+
+def _pos_interior(
+    i,
+    j,
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    causal: bool,
+    sliding_window: int | None,
+):
+    """Scalar predicate: every (q, k) position pair in tile (i, j) satisfies
+    the causal/window constraints, so only the segment mask applies."""
+    interior = jnp.bool_(True)
+    q_lo = i * block_q + q_offset
+    q_hi = q_lo + block_q - 1
+    k_lo = j * block_k
+    k_hi = k_lo + block_k - 1
+    if causal:
+        interior &= k_hi <= q_lo
+    if sliding_window is not None:
+        interior &= q_hi - k_lo < sliding_window
+    return interior
 
 
 def _should_visit(
@@ -161,28 +225,36 @@ def _fwd_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(_should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window))
-    def _visit():
+    def _visit(with_pos_mask: bool, with_seg_mask: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        seg_q = q_seg_ref[0, 0]
-        seg_kv = kv_seg_ref[0, 0]
 
         s = _scores(q, k, scale, logits_soft_cap)
-        mask = _block_mask(
-            i, j, block_q, block_k, q_offset, causal, sliding_window, seg_q, seg_kv
-        )
-        s = jnp.where(mask, s, _MASK_VALUE)
+        mask = None
+        if with_seg_mask:
+            mask = _seg_mask(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+        if with_pos_mask:
+            pos = _pos_mask(i, j, block_q, block_k, q_offset, causal, sliding_window)
+            mask = pos if mask is None else mask & pos
 
+        # masked entries must be numerically inert BEFORE the running max: a
+        # masked outlier logit ~88 above the row's true max would otherwise
+        # lock m_new and underflow every valid probability (0/0 at flush).
+        # The uniform branch has no masked entries, so its raw max is exact
+        # and it skips both selects.
+        if mask is not None:
+            s = jnp.where(mask, s, _MASK_VALUE)
         m_prev = m_scr[:, :1]
         l_prev = l_scr[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        # explicit zeroing (not just the additive mask) keeps fully-masked
-        # rows exactly at l == 0 so padding rows emit O = 0, LSE = -inf
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        p = jnp.exp(s - m_new)
+        if mask is not None:
+            # explicit zeroing keeps fully-masked rows exactly at l == 0 so
+            # padding rows emit O = 0, LSE = -inf (exp(MASK - MASK) == 1)
+            p = jnp.where(mask, p, 0.0)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
         m_scr[:, :1] = m_new
@@ -190,6 +262,11 @@ def _fwd_kernel(
         acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
+
+    visit = _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    _masked_dispatch(visit, interior, uniform, _visit)
 
     @pl.when(j == nk - 1)
     def _flush():
@@ -229,8 +306,7 @@ def _dq_kernel(
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    @pl.when(_should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window))
-    def _visit():
+    def _visit(with_pos_mask: bool, with_seg_mask: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -239,11 +315,18 @@ def _dq_kernel(
         delta = delta_ref[0, 0][:, None]
 
         s = _scores(q, k, scale, logits_soft_cap)
-        mask = _block_mask(
-            i, j, block_q, block_k, q_offset, causal, sliding_window,
-            q_seg_ref[0, 0], kv_seg_ref[0, 0],
-        )
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        mask = None
+        if with_seg_mask:
+            mask = _seg_mask(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+        if with_pos_mask:
+            pos = _pos_mask(i, j, block_q, block_k, q_offset, causal, sliding_window)
+            mask = pos if mask is None else mask & pos
+        # lse == -inf on fully-padded rows would give exp(inf); the uniform
+        # (maskless) branch only runs when every q row is non-padding, so
+        # those rows always carry a finite lse there
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -254,6 +337,11 @@ def _dq_kernel(
         dq_scr[:] += jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
+
+    visit = _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    _masked_dispatch(visit, interior, uniform, _visit)
 
     @pl.when(j == nk - 1)
     def _flush():
@@ -293,8 +381,7 @@ def _dkv_kernel(
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    @pl.when(_should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window))
-    def _visit():
+    def _visit(with_pos_mask: bool, with_seg_mask: bool):
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
@@ -303,11 +390,15 @@ def _dkv_kernel(
         delta = delta_ref[0, 0][:, None]
 
         s = _scores(q, k, scale, logits_soft_cap)
-        mask = _block_mask(
-            i, j, block_q, block_k, q_offset, causal, sliding_window,
-            q_seg_ref[0, 0], kv_seg_ref[0, 0],
-        )
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        mask = None
+        if with_seg_mask:
+            mask = _seg_mask(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+        if with_pos_mask:
+            pos = _pos_mask(i, j, block_q, block_k, q_offset, causal, sliding_window)
+            mask = pos if mask is None else mask & pos
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         # dV_j += P^T dO ; contraction over the q rows (dim 0 of both)
         dv_scr[:] += lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -324,6 +415,11 @@ def _dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    visit = _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    _masked_dispatch(visit, interior, uniform, _visit)
 
     @pl.when((g == ng - 1) & (i == nq - 1))
     def _flush():
@@ -345,8 +441,8 @@ def flash_fwd_flat(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     q_offset: int = 0,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = _DEFAULT_BLOCK_Q,
+    block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Forward kernel over flat padded inputs: q [B*Hq, Sq, D], k/v
@@ -393,7 +489,13 @@ def flash_fwd_flat(
         ),
         interpret=interpret,
     )(seg_q[:, None], seg_kv[:, None], q, k, v)
-    return o, lse[:, 0]
+    # remat tags: under `recompute_granularity='selective'` the model policy
+    # saves exactly these two (save_only_these_names), so the backward pass
+    # reads O/LSE instead of re-running this kernel — attention is the one
+    # block whose recompute costs as much as its forward
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse[:, 0], "flash_lse")
+    return o, lse
 
 
 def flash_bwd_flat(
@@ -413,8 +515,8 @@ def flash_bwd_flat(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     q_offset: int = 0,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = _DEFAULT_BLOCK_Q,
+    block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Backward kernels over flat padded inputs. `lse`/`delta` are [B*Hq, Sq]
@@ -553,8 +655,8 @@ def flash_attention(
     logits_soft_cap: float | None = None,
     scale: float | None = None,
     q_offset: int = 0,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = _DEFAULT_BLOCK_Q,
+    block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Flash attention over packed sequences.
