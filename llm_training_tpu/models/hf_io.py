@@ -226,6 +226,7 @@ _ARCH_TO_FAMILY = {
     "llama": "llm_training_tpu.models.Llama",
     "mistral": "llm_training_tpu.models.Llama",  # same graph: GQA + SwiGLU + RMSNorm
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
+    "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
     "phi3": "llm_training_tpu.models.Phi3",
     "gemma": "llm_training_tpu.models.Gemma",
     "gemma2": "llm_training_tpu.models.Gemma",  # version=2 graph features

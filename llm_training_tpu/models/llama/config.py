@@ -42,6 +42,8 @@ class LlamaConfig(BaseModelConfig):
     # Mistral/Qwen2-style local attention (None = full causal); consumed by
     # LlamaAttention via ops.dot_product_attention's sliding_window arg
     sliding_window: int | None = None
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE)
+    qk_norm: bool = False
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"

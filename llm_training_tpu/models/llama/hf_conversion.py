@@ -41,6 +41,11 @@ _LAYER_O_BIAS_PARAMS = [
     (("self_attn", "o_proj", "bias"), "self_attn.o_proj.bias", False),
 ]
 
+_LAYER_QK_NORM_PARAMS = [
+    (("self_attn", "q_norm", "weight"), "self_attn.q_norm.weight", False),
+    (("self_attn", "k_norm", "weight"), "self_attn.k_norm.weight", False),
+]
+
 
 def _bias_params(config: LlamaConfig) -> list:
     extra = []
@@ -48,6 +53,8 @@ def _bias_params(config: LlamaConfig) -> list:
         extra += _LAYER_QKV_BIAS_PARAMS
     if config.attention_out_bias:
         extra += _LAYER_O_BIAS_PARAMS
+    if config.qk_norm:
+        extra += _LAYER_QK_NORM_PARAMS
     return extra
 
 
@@ -189,6 +196,13 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             if config.attention_bias and not config.attention_out_bias
             else {}
         ),
+        # per-head qk-norm only exists as Qwen3 in HF
+        **(
+            {"model_type": "qwen3", "architectures": ["Qwen3ForCausalLM"],
+             "head_dim": config.resolved_head_dim}
+            if config.qk_norm
+            else {}
+        ),
     }
 
 
@@ -229,11 +243,13 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_dropout=get("attention_dropout", 0.0),
         mlp_bias=get("mlp_bias", False),
         rope_scaling=get("rope_scaling"),
-        # Mistral sets sliding_window unconditionally; Qwen2 gates it behind
-        # use_sliding_window (default False)
+        # Mistral sets sliding_window unconditionally; Qwen2/Qwen3 gate it
+        # behind use_sliding_window (default False)
         sliding_window=(
             get("sliding_window")
-            if get("use_sliding_window", get("model_type") != "qwen2")
+            if get("use_sliding_window",
+                   get("model_type") not in ("qwen2", "qwen3"))
             else None
         ),
+        qk_norm=get("model_type") == "qwen3",
     ), **overrides})

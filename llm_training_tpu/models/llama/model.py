@@ -101,6 +101,12 @@ class LlamaAttention(nn.Module):
         k = k.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
         v = v.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
 
+        if cfg.qk_norm:
+            # Qwen3: per-head RMSNorm over head_dim, before RoPE (HF
+            # Qwen3Attention applies q_norm/k_norm on the reshaped heads)
+            q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+
         q, k = apply_rope(q, k, cos, sin)
 
         attention_dtype = getattr(cfg, "attention_compute_dtype", None)

@@ -236,6 +236,58 @@ def test_logits_parity_with_hf_qwen2():
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_logits_parity_with_hf_qwen3():
+    """Qwen3 routes to the Llama module; its per-head q/k RMSNorm (over
+    head_dim, before RoPE) must be applied and its weights converted."""
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    hf_config = Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = Qwen3ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    assert "model.layers.0.self_attn.q_proj.bias" not in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.qk_norm and not cfg.attention_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(6).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen3_export_round_trip(tmp_path):
+    """Export a qk_norm model -> HF reloads it as Qwen3 with matching
+    logits (the norm weights must survive both directions)."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(**TINY, qk_norm=True, head_dim=16)
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(11).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(2), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(out_dir, attn_implementation="eager").eval()
+    assert type(hf_model).__name__ == "Qwen3ForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
 def test_qwen2_export_round_trip(tmp_path):
     """Exporting a Qwen2-derived config must produce a checkpoint that
     transformers loads with NO missing keys (asymmetric bias preserved)."""
