@@ -144,5 +144,15 @@ class CLM:
             "target_tokens": count,
         }
         if self.config.log_perplexity:
+            # exp of the TOKEN-LEVEL cross entropy only — never the MoE
+            # balancing penalty, so curves stay comparable to dense/HF evals
             metrics["perplexity"] = jnp.exp(loss)
+        if out.aux_loss is not None:
+            # MoE load-balancing loss (HF load_balancing_loss_func analogue):
+            # the model returns it unscaled; the coefficient lives in the
+            # model config (mixtral/qwen-moe: router_aux_loss_coef)
+            coef = getattr(model.config, "router_aux_loss_coef", 0.0)
+            metrics["aux_loss"] = out.aux_loss
+            loss = loss + coef * out.aux_loss
+            metrics["loss"] = loss
         return loss, metrics

@@ -79,7 +79,9 @@ class CausalLMOutput:
     """Forward output (reference `modeling_outputs.py:11-13`).
 
     `logits` is None when the objective requests hidden states only (for
-    fused-linear-CE, which needs the pre-head activations)."""
+    fused-linear-CE, which needs the pre-head activations). `aux_loss` is
+    the unscaled MoE load-balancing loss (None for dense models)."""
 
     logits: jnp.ndarray | None = None
     last_hidden_states: jnp.ndarray | None = None
+    aux_loss: jnp.ndarray | None = None

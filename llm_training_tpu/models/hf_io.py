@@ -227,6 +227,11 @@ _ARCH_TO_FAMILY = {
     "mistral": "llm_training_tpu.models.Llama",  # same graph: GQA + SwiGLU + RMSNorm
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
     "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
+    "olmo2": "llm_training_tpu.models.Llama",  # + post-norm blocks, full qk-norm
+    # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
+    "mixtral": "llm_training_tpu.models.Llama",
+    "qwen2_moe": "llm_training_tpu.models.Llama",
+    "qwen3_moe": "llm_training_tpu.models.Llama",
     "phi3": "llm_training_tpu.models.Phi3",
     "gemma": "llm_training_tpu.models.Gemma",
     "gemma2": "llm_training_tpu.models.Gemma",  # version=2 graph features

@@ -42,8 +42,28 @@ class LlamaConfig(BaseModelConfig):
     # Mistral/Qwen2-style local attention (None = full causal); consumed by
     # LlamaAttention via ops.dot_product_attention's sliding_window arg
     sliding_window: int | None = None
-    # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE)
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE);
+    # scope 'full' is the OLMo-2 variant (one norm over the whole projected
+    # width, applied before the head reshape)
     qk_norm: bool = False
+    qk_norm_scope: Literal["head", "full"] = "head"
+    # 'pre' = Llama pre-norm blocks; 'post' = OLMo-2 reordering
+    # (x + norm(block(x)) with NO input norms)
+    norm_scheme: Literal["pre", "post"] = "pre"
+
+    # --- mixture of experts (Mixtral / Qwen2-MoE / Qwen3-MoE); None = dense
+    num_experts: int | None = None
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int | None = None
+    norm_topk_prob: bool = True
+    shared_expert_intermediate_size: int | None = None  # Qwen2-MoE
+    router_aux_loss_coef: float = 0.001
+    # conversion/export naming: 'qwen' (mlp.experts.{i}.gate_proj) vs
+    # 'mixtral' (block_sparse_moe.experts.{i}.w1/w3/w2)
+    moe_style: Literal["qwen", "mixtral"] = "qwen"
+    # 'ragged' = dropless grouped matmul (lax.ragged_dot, the TPU training
+    # path); 'dense' = every expert on every token (exact, for parity tests)
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
@@ -69,6 +89,14 @@ class LlamaConfig(BaseModelConfig):
             # fail loudly rather than silently training without the dropout a
             # user (or an HF config) asked for
             raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.num_experts is not None:
+            if self.moe_intermediate_size is None:
+                raise ValueError("num_experts requires moe_intermediate_size")
+            if not 0 < self.num_experts_per_tok <= self.num_experts:
+                raise ValueError(
+                    f"num_experts_per_tok ({self.num_experts_per_tok}) must be "
+                    f"in [1, num_experts={self.num_experts}]"
+                )
         self.rope_config  # construct to trigger RoPEConfig validation
         return self
 

@@ -18,7 +18,7 @@ import numpy as np
 from llm_training_tpu.models.llama.config import LlamaConfig
 
 # (our in-layer path, hf in-layer name, transpose)
-_LAYER_PARAMS = [
+_LAYER_MATMUL_PARAMS = [
     (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
     (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
     (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
@@ -26,9 +26,22 @@ _LAYER_PARAMS = [
     (("mlp", "gate_proj", "kernel"), "mlp.gate_proj.weight", True),
     (("mlp", "up_proj", "kernel"), "mlp.up_proj.weight", True),
     (("mlp", "down_proj", "kernel"), "mlp.down_proj.weight", True),
+]
+
+_PRE_NORM_PARAMS = [
     (("input_layernorm", "weight"), "input_layernorm.weight", False),
     (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
 ]
+
+# OLMo-2 post-norm scheme: no input norms, block outputs normed instead
+_POST_NORM_PARAMS = [
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+    (("post_feedforward_layernorm", "weight"), "post_feedforward_layernorm.weight", False),
+]
+
+# the pre-norm full list (kept under this name for the Phi-3 conversion,
+# which filters fused projections out of it)
+_LAYER_PARAMS = _LAYER_MATMUL_PARAMS + _PRE_NORM_PARAMS
 
 _LAYER_QKV_BIAS_PARAMS = [
     (("self_attn", "q_proj", "bias"), "self_attn.q_proj.bias", False),
@@ -56,6 +69,65 @@ def _bias_params(config: LlamaConfig) -> list:
     if config.qk_norm:
         extra += _LAYER_QK_NORM_PARAMS
     return extra
+
+
+def _layer_params(config: LlamaConfig) -> list:
+    matmuls = _LAYER_MATMUL_PARAMS
+    if config.num_experts:
+        # MoE layers have no dense MLP; expert stacks are converted by
+        # _moe_layer_parts / _moe_layer_out
+        matmuls = [p for p in matmuls if p[0][0] != "mlp"]
+    norms = _POST_NORM_PARAMS if config.norm_scheme == "post" else _PRE_NORM_PARAMS
+    return matmuls + norms + _bias_params(config)
+
+
+# our MoE projection name -> HF per-expert module name, per naming style
+_MOE_EXPERT_NAMES = {
+    "qwen": ("mlp", {"gate_proj": "gate_proj", "up_proj": "up_proj", "down_proj": "down_proj"}),
+    "mixtral": ("block_sparse_moe", {"gate_proj": "w1", "up_proj": "w3", "down_proj": "w2"}),
+}
+
+_MOE_SHARED = ("gate_proj", "up_proj", "down_proj")
+
+
+def _moe_layer_parts(sd: Mapping, config: LlamaConfig, i: int) -> dict:
+    """HF keys for layer i's MoE block -> {our in-layer path: array}."""
+    prefix, names = _MOE_EXPERT_NAMES[config.moe_style]
+    parts = {
+        ("mlp", "gate", "kernel"): _to_numpy(sd[f"layers.{i}.{prefix}.gate.weight"]).T,
+    }
+    for ours, hf in names.items():
+        parts[("mlp", f"experts_{ours}")] = np.stack([
+            _to_numpy(sd[f"layers.{i}.{prefix}.experts.{e}.{hf}.weight"]).T
+            for e in range(config.num_experts)
+        ])
+    if config.shared_expert_intermediate_size:
+        for ours in _MOE_SHARED:
+            parts[("mlp", f"shared_{ours}")] = _to_numpy(
+                sd[f"layers.{i}.mlp.shared_expert.{ours}.weight"]
+            ).T
+        parts[("mlp", "shared_expert_gate")] = _to_numpy(
+            sd[f"layers.{i}.mlp.shared_expert_gate.weight"]
+        ).T
+    return parts
+
+
+def _moe_layer_out(get, config: LlamaConfig, i: int, out: dict) -> None:
+    """Inverse of _moe_layer_parts: `get(path)` reads our layer-i tree."""
+    prefix, names = _MOE_EXPERT_NAMES[config.moe_style]
+    out[f"model.layers.{i}.{prefix}.gate.weight"] = get(("mlp", "gate", "kernel")).T
+    for ours, hf in names.items():
+        stacked = get(("mlp", f"experts_{ours}"))  # [E, in, out]
+        for e in range(config.num_experts):
+            out[f"model.layers.{i}.{prefix}.experts.{e}.{hf}.weight"] = stacked[e].T
+    if config.shared_expert_intermediate_size:
+        for ours in _MOE_SHARED:
+            out[f"model.layers.{i}.mlp.shared_expert.{ours}.weight"] = get(
+                ("mlp", f"shared_{ours}")
+            ).T
+        out[f"model.layers.{i}.mlp.shared_expert_gate.weight"] = get(
+            ("mlp", "shared_expert_gate")
+        ).T
 
 
 def _set_path(tree: dict, path: tuple[str, ...], value: Any) -> None:
@@ -105,7 +177,7 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    layer_params = _LAYER_PARAMS + _bias_params(config)
+    layer_params = _layer_params(config)
 
     def layer_value(i: int, hf_name: str, transpose: bool) -> np.ndarray:
         value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
@@ -117,10 +189,21 @@ def params_from_hf(
                 [layer_value(i, hf_name, transpose) for i in range(config.num_hidden_layers)]
             )
             put(("layers", "layer") + path, stacked)
+        if config.num_experts:
+            moe_layers = [
+                _moe_layer_parts(sd, config, i)
+                for i in range(config.num_hidden_layers)
+            ]
+            for path in moe_layers[0]:
+                put(("layers", "layer") + path,
+                    np.stack([layer[path] for layer in moe_layers]))
     else:
         for i in range(config.num_hidden_layers):
             for path, hf_name, transpose in layer_params:
                 put((f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
+            if config.num_experts:
+                for path, value in _moe_layer_parts(sd, config, i).items():
+                    put((f"layers_{i}",) + path, value)
     return {"params": params}
 
 
@@ -136,7 +219,7 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    layer_params = _LAYER_PARAMS + _bias_params(config)
+    layer_params = _layer_params(config)
 
     for path, hf_name, transpose in layer_params:
         if config.scan_layers:
@@ -148,6 +231,23 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
             for i in range(config.num_hidden_layers):
                 value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
                 out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    if config.num_experts:
+        # device->host once per stacked path, then slice per layer (a per-
+        # layer np.asarray would re-transfer the full [L, E, ...] stack L
+        # times — O(L^2) copies on real expert-weight sizes)
+        cache: dict = {}
+
+        def fetch(path):
+            if path not in cache:
+                cache[path] = np.asarray(_get_path(p, ("layers", "layer") + path))
+            return cache[path]
+
+        for i in range(config.num_hidden_layers):
+            if config.scan_layers:
+                get = lambda path: fetch(path)[i]
+            else:
+                get = lambda path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            _moe_layer_out(get, config, i, out)
     return out
 
 
@@ -200,8 +300,51 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
         **(
             {"model_type": "qwen3", "architectures": ["Qwen3ForCausalLM"],
              "head_dim": config.resolved_head_dim}
-            if config.qk_norm
+            if config.qk_norm and config.qk_norm_scope == "head"
             else {}
+        ),
+        # post-norm blocks + full-width qk-norm only exist as OLMo-2 in HF
+        **(
+            {"model_type": "olmo2", "architectures": ["Olmo2ForCausalLM"]}
+            if config.norm_scheme == "post"
+            else {}
+        ),
+        **_moe_to_hf(config),
+    }
+
+
+def _moe_to_hf(config: LlamaConfig) -> dict[str, Any]:
+    if not config.num_experts:
+        return {}
+    common = {
+        "num_experts_per_tok": config.num_experts_per_tok,
+        "router_aux_loss_coef": config.router_aux_loss_coef,
+        "output_router_logits": False,
+    }
+    if config.moe_style == "mixtral":
+        return {
+            "model_type": "mixtral",
+            "architectures": ["MixtralForCausalLM"],
+            "num_local_experts": config.num_experts,
+            # HF Mixtral's intermediate_size IS the per-expert width
+            "intermediate_size": config.moe_intermediate_size,
+            **common,
+        }
+    qwen3 = config.qk_norm  # qwen3_moe; else qwen2_moe (shared expert)
+    return {
+        "model_type": "qwen3_moe" if qwen3 else "qwen2_moe",
+        "architectures": ["Qwen3MoeForCausalLM" if qwen3 else "Qwen2MoeForCausalLM"],
+        "num_experts": config.num_experts,
+        "moe_intermediate_size": config.moe_intermediate_size,
+        "norm_topk_prob": config.norm_topk_prob,
+        "decoder_sparse_step": 1,
+        "mlp_only_layers": [],
+        **common,
+        **(
+            {"shared_expert_intermediate_size": config.shared_expert_intermediate_size,
+             "attention_bias": None}
+            if not qwen3
+            else {"head_dim": config.resolved_head_dim}
         ),
     }
 
@@ -216,6 +359,35 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
     get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
         lambda k, d=None: getattr(hf_config, k, d)
     )
+    model_type = get("model_type")
+    moe: dict[str, Any] = {}
+    if model_type == "mixtral":
+        moe = dict(
+            num_experts=get("num_local_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 2),
+            moe_intermediate_size=get("intermediate_size"),
+            norm_topk_prob=True,  # Mixtral always renormalizes top-k
+            moe_style="mixtral",
+            router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+        )
+    elif model_type in ("qwen2_moe", "qwen3_moe"):
+        if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers"):
+            raise ValueError(
+                "mixed dense/sparse layer schedules (decoder_sparse_step != 1 "
+                "or mlp_only_layers) are not supported"
+            )
+        moe = dict(
+            num_experts=get("num_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 4),
+            moe_intermediate_size=get("moe_intermediate_size"),
+            norm_topk_prob=get("norm_topk_prob", False),
+            router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+            shared_expert_intermediate_size=(
+                get("shared_expert_intermediate_size")
+                if model_type == "qwen2_moe"
+                else None
+            ),
+        )
     return LlamaConfig(**{**dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -232,24 +404,32 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         eos_token_id=get("eos_token_id", 2),
         tie_word_embeddings=get("tie_word_embeddings", False),
         rope_theta=get("rope_theta", 10000.0),
-        # Qwen2 hardcodes q/k/v biases with no o_proj bias (no config field
-        # in its HF config); explicit attention_bias wins where present
-        attention_bias=get("attention_bias", get("model_type") == "qwen2"),
+        # Qwen2 / Qwen2-MoE hardcode q/k/v biases with no o_proj bias (no
+        # config field in their HF configs); explicit attention_bias wins.
+        # Present-but-None (our own qwen2-style exports) counts as absent.
+        attention_bias=(
+            get("attention_bias")
+            if get("attention_bias") is not None
+            else model_type in ("qwen2", "qwen2_moe")
+        ),
         attention_out_bias=(
             False
-            if get("model_type") == "qwen2" and get("attention_bias") is None
-            else get("attention_bias", False)
+            if model_type in ("qwen2", "qwen2_moe") and get("attention_bias") is None
+            else (get("attention_bias") or False)
         ),
         attention_dropout=get("attention_dropout", 0.0),
         mlp_bias=get("mlp_bias", False),
         rope_scaling=get("rope_scaling"),
-        # Mistral sets sliding_window unconditionally; Qwen2/Qwen3 gate it
-        # behind use_sliding_window (default False)
+        # Mistral sets sliding_window unconditionally; the Qwen families gate
+        # it behind use_sliding_window (default False)
         sliding_window=(
             get("sliding_window")
             if get("use_sliding_window",
-                   get("model_type") not in ("qwen2", "qwen3"))
+                   model_type not in ("qwen2", "qwen3", "qwen2_moe", "qwen3_moe"))
             else None
         ),
-        qk_norm=get("model_type") == "qwen3",
+        qk_norm=model_type in ("qwen3", "olmo2", "qwen3_moe"),
+        qk_norm_scope="full" if model_type == "olmo2" else "head",
+        norm_scheme="post" if model_type == "olmo2" else "pre",
+        **moe,
     ), **overrides})

@@ -97,11 +97,17 @@ class LlamaAttention(nn.Module):
         v = _dense(cfg, cfg.num_key_value_heads * head_dim, ("embed", "kv_heads"),
                    "v_proj", cfg.attention_bias)(hidden)
 
+        if cfg.qk_norm and cfg.qk_norm_scope == "full":
+            # OLMo-2: one RMSNorm over the whole projected width, before the
+            # head reshape — different statistics than the per-head variant
+            q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+
         q = q.reshape(batch, seq, cfg.num_attention_heads, head_dim)
         k = k.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
         v = v.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
 
-        if cfg.qk_norm:
+        if cfg.qk_norm and cfg.qk_norm_scope == "head":
             # Qwen3: per-head RMSNorm over head_dim, before RoPE (HF
             # Qwen3Attention applies q_norm/k_norm on the reshaped heads)
             q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
@@ -210,24 +216,46 @@ class LlamaDecoderLayer(nn.Module):
     ) -> jnp.ndarray:
         cfg = self.config
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
-        normed = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="input_layernorm")(hidden)
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+
+        def mlp(x):
+            """(out, aux): MoE block returns per-layer router stats
+            (sel_frac, mean_prob) [E]; dense SwiGLU a zero scalar (the ys
+            type is uniform across layers within one model)."""
+            if cfg.num_experts:
+                from llm_training_tpu.models.moe import MoEMLP
+
+                pad_mask = None if segment_ids is None else segment_ids > 0
+                return MoEMLP(cfg, name="mlp")(x, pad_mask)
+            return LlamaMLP(cfg, name="mlp")(x), jnp.float32(0.0)
+
+        if cfg.norm_scheme == "post":
+            # OLMo-2 reordering: no input norms; normalize each block's
+            # OUTPUT before it joins the residual stream
+            attn = LlamaAttention(cfg, name="self_attn")(hidden, segment_ids, cos, sin)
+            hidden = hidden + norm("post_attention_layernorm")(attn)
+            mlp_out, aux = mlp(hidden)
+            hidden = hidden + norm("post_feedforward_layernorm")(mlp_out)
+            return hidden, aux
+        normed = norm("input_layernorm")(hidden)
         hidden = hidden + LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
-        normed = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="post_attention_layernorm")(hidden)
-        hidden = hidden + LlamaMLP(cfg, name="mlp")(normed)
-        return hidden
+        normed = norm("post_attention_layernorm")(hidden)
+        mlp_out, aux = mlp(normed)
+        hidden = hidden + mlp_out
+        return hidden, aux
 
 
 class _ScannedLayer(nn.Module):
     """Adapter giving LlamaDecoderLayer the (carry, xs) -> (carry, ys)
-    signature nn.scan expects."""
+    signature nn.scan expects; ys carries the per-layer MoE aux loss."""
 
     config: LlamaConfig
     layer_cls: type
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden = self.layer_cls(self.config, name="layer")(hidden, segment_ids, cos, sin)
-        return hidden, None
+        hidden, aux = self.layer_cls(self.config, name="layer")(hidden, segment_ids, cos, sin)
+        return hidden, aux
 
 
 
@@ -243,6 +271,11 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     def _layers(self, hidden, segment_ids, cos, sin):
+        """Returns (hidden, aux_loss). For MoE configs the per-layer router
+        stats (sel_frac, mean_prob) are pooled across depth BEFORE the
+        E * sum(f * P) product — matching HF `load_balancing_loss_func`,
+        which concatenates all layers' gate logits first, so the loss stays
+        ~1.0 when balanced regardless of num_hidden_layers."""
         cfg = self.config
         policy = _remat_policy(cfg)
         if cfg.scan_layers:
@@ -259,14 +292,25 @@ class Llama(nn.Module):
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, LlamaDecoderLayer, name="layers")
-            hidden, _ = scanned(hidden, segment_ids, cos, sin)
-            return hidden
-        for i in range(cfg.num_hidden_layers):
-            layer_cls = LlamaDecoderLayer
-            if policy is not None:
-                layer_cls = nn.remat(LlamaDecoderLayer, policy=policy)
-            hidden = layer_cls(cfg, name=f"layers_{i}")(hidden, segment_ids, cos, sin)
-        return hidden
+            hidden, aux = scanned(hidden, segment_ids, cos, sin)
+        else:
+            stats = []
+            for i in range(cfg.num_hidden_layers):
+                layer_cls = LlamaDecoderLayer
+                if policy is not None:
+                    layer_cls = nn.remat(LlamaDecoderLayer, policy=policy)
+                hidden, layer_aux = layer_cls(cfg, name=f"layers_{i}")(
+                    hidden, segment_ids, cos, sin
+                )
+                stats.append(layer_aux)
+            aux = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+        if not cfg.num_experts:
+            return hidden, jnp.float32(0.0)
+        sel_frac, mean_prob = aux  # each [L, E]
+        aux_loss = cfg.num_experts * jnp.sum(
+            sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
+        )
+        return hidden, aux_loss
 
     @nn.compact
     def __call__(
@@ -307,7 +351,7 @@ class Llama(nn.Module):
         )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
-        hidden = self._layers(hidden, segment_ids, cos, sin)
+        hidden, aux_loss = self._layers(hidden, segment_ids, cos, sin)
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
@@ -322,6 +366,9 @@ class Llama(nn.Module):
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
+            # unscaled load-balancing loss; the objective applies
+            # router_aux_loss_coef (None for dense models)
+            aux_loss=aux_loss if cfg.num_experts else None,
         )
 
     def get_input_embeddings_path(self) -> str:

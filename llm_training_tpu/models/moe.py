@@ -1,0 +1,171 @@
+"""Mixture-of-experts MLP block, TPU-native.
+
+Family member beyond the reference's named models (it reaches MoE — Mixtral,
+Qwen2/3-MoE — only through `HFCausalLM`'s torch wrapping,
+`hf_causal_lm.py:22`); here the computation graph is native and dropless:
+
+- router: fp32 softmax over expert logits, top-k, optional renormalization
+  (HF `Qwen2MoeSparseMoeBlock`/`MixtralSparseMoeBlock` semantics).
+- experts: ONE stacked parameter per projection ([E, H, I] / [E, I, H],
+  logical axes ('expert', 'embed', 'mlp')), never E separate modules — the
+  stacked layout is what makes both impls below a single large MXU op.
+- 'ragged' impl (TPU training path): sort the T*K (token, expert-slot)
+  assignments by expert, run the three projections as `jax.lax.ragged_dot`
+  grouped matmuls, scatter-add weighted results back. Static shapes
+  ([T*K, ...] regardless of routing), no token dropping, no capacity factor
+  — the modern JAX MoE formulation, vs the GShard one-hot dispatch einsum
+  whose [T, E, C] tensors waste HBM at high expert counts.
+- 'dense' impl (parity/debug): run every expert on every token and combine
+  with the routing weights — exact, E/K-times the FLOPs; default off-TPU
+  where tiny parity tests run.
+- optional shared expert + sigmoid gate (Qwen2-MoE).
+- load-balancing auxiliary loss (Switch/Mixtral form): E * sum_e f_e * P_e
+  with f_e the fraction of (token, slot) assignments routed to e and P_e
+  the mean fp32 router probability. Returned UNSCALED; the CLM objective
+  applies `router_aux_loss_coef` (HF `load_balancing_loss_func` analogue).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Sparse MoE block with the (config-driven) surface of LlamaMLP.
+
+    __call__(hidden [B, S, H], pad_mask [B, S] bool | None) ->
+    (out [B, S, H], (sel_frac [E], mean_prob [E]) fp32 router stats).
+    The caller pools the per-layer stats across depth and applies the
+    Switch/Mixtral formula E * sum(f * P) — pooling BEFORE the product is
+    what HF's `load_balancing_loss_func` does (it concatenates every
+    layer's gate logits first), and it keeps the loss ~1.0 when balanced
+    regardless of depth. Padding tokens are excluded from both statistics,
+    like HF's attention-mask weighting.
+    """
+
+    config: object  # LlamaConfig with num_experts set
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden: jnp.ndarray,
+        pad_mask: jnp.ndarray | None = None,
+    ) -> tuple[jnp.ndarray, tuple[jnp.ndarray, jnp.ndarray]]:
+        cfg = self.config
+        num_experts = cfg.num_experts
+        top_k = cfg.num_experts_per_tok
+        inter = cfg.moe_intermediate_size
+        compute_dtype = cfg.compute_jnp_dtype
+        param_dtype = cfg.param_jnp_dtype
+        batch, seq, embed = hidden.shape
+        x = hidden.reshape(-1, embed)  # [T, H]
+        n_tokens = x.shape[0]
+
+        # ---- router (fp32 softmax: HF computes routing in float)
+        router = nn.Dense(
+            num_experts,
+            use_bias=False,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("embed", "expert")
+            ),
+            name="gate",
+        )
+        probs = jax.nn.softmax(router(x).astype(jnp.float32), axis=-1)  # [T, E]
+        topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # [T, K]
+        if cfg.norm_topk_prob:
+            topk_probs = topk_probs / topk_probs.sum(axis=-1, keepdims=True)
+        topk_probs = topk_probs.astype(compute_dtype)
+
+        # ---- stacked expert weights
+        def expert_param(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(cfg.initializer_range), axes
+                ),
+                shape,
+                param_dtype,
+            ).astype(compute_dtype)
+
+        w_gate = expert_param(
+            "experts_gate_proj", (num_experts, embed, inter), ("expert", "embed", "mlp")
+        )
+        w_up = expert_param(
+            "experts_up_proj", (num_experts, embed, inter), ("expert", "embed", "mlp")
+        )
+        w_down = expert_param(
+            "experts_down_proj", (num_experts, inter, embed), ("expert", "mlp", "embed")
+        )
+
+        impl = cfg.moe_impl
+        if impl == "auto":
+            impl = "ragged" if jax.default_backend() == "tpu" else "dense"
+
+        xc = x.astype(compute_dtype)
+        if impl == "dense":
+            # every expert on every token; combine with scattered weights
+            gate = jnp.einsum("th,ehi->tei", xc, w_gate)
+            up = jnp.einsum("th,ehi->tei", xc, w_up)
+            y = jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
+            combine = jnp.zeros((n_tokens, num_experts), compute_dtype)
+            combine = combine.at[
+                jnp.arange(n_tokens)[:, None], topk_idx
+            ].set(topk_probs)
+            out = jnp.einsum("teh,te->th", y, combine)
+        else:
+            # dropless grouped matmul over sorted (token, slot) assignments
+            flat_expert = topk_idx.reshape(-1)  # [T*K]
+            flat_weight = topk_probs.reshape(-1)
+            flat_token = jnp.arange(n_tokens * top_k) // top_k
+            order = jnp.argsort(flat_expert)  # stable
+            token_order = flat_token[order]
+            xs = xc[token_order]  # [T*K, H] sorted by expert
+            group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(
+                jnp.int32
+            )
+            gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+            up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+            ys = jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
+            ys = ys * flat_weight[order][:, None]
+            out = jnp.zeros((n_tokens, embed), compute_dtype).at[token_order].add(ys)
+
+        # ---- shared expert (Qwen2-MoE): dense SwiGLU + per-token sigmoid gate
+        if cfg.shared_expert_intermediate_size:
+            si = cfg.shared_expert_intermediate_size
+            sw_gate = expert_param("shared_gate_proj", (embed, si), ("embed", "mlp"))
+            sw_up = expert_param("shared_up_proj", (embed, si), ("embed", "mlp"))
+            sw_down = expert_param("shared_down_proj", (si, embed), ("mlp", "embed"))
+            shared = (nn.silu(xc @ sw_gate) * (xc @ sw_up)) @ sw_down
+            gate_w = self.param(
+                "shared_expert_gate",
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(cfg.initializer_range), ("embed", None)
+                ),
+                (embed, 1),
+                param_dtype,
+            ).astype(compute_dtype)
+            out = out + jax.nn.sigmoid(xc @ gate_w) * shared
+
+        # ---- router statistics for the load-balancing loss (fp32),
+        # excluding padding tokens
+        if pad_mask is None:
+            valid = jnp.ones((n_tokens,), jnp.float32)
+        else:
+            valid = pad_mask.reshape(-1).astype(jnp.float32)
+        n_valid = jnp.maximum(valid.sum(), 1.0)
+        sel_frac = (
+            jnp.zeros((num_experts,), jnp.float32)
+            .at[topk_idx.reshape(-1)]
+            .add(jnp.repeat(valid, top_k))
+            / (n_valid * top_k)
+        )
+        mean_prob = (probs * valid[:, None]).sum(axis=0) / n_valid
+
+        return (
+            out.reshape(batch, seq, embed).astype(hidden.dtype),
+            (sel_frac, mean_prob),
+        )

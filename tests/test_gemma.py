@@ -50,6 +50,7 @@ TINY_V2 = dict(
 )
 
 
+@pytest.mark.slow
 def test_forward_shapes():
     cfg = GemmaConfig(**TINY_V1)
     model = Gemma(cfg)

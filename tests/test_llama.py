@@ -40,6 +40,7 @@ def test_forward_shapes_and_dtypes():
     assert out.last_hidden_states.shape == (2, 10, 64)
 
 
+@pytest.mark.slow
 def test_hidden_only_forward():
     cfg = LlamaConfig(**TINY)
     ids = jnp.ones((2, 10), jnp.int32)
@@ -89,6 +90,7 @@ def test_remat_matches_no_remat(granularity):
     )
 
 
+@pytest.mark.slow
 def test_tied_embeddings():
     cfg = LlamaConfig(**{**TINY, "tie_word_embeddings": True})
     ids = jnp.ones((1, 4), jnp.int32)
@@ -266,6 +268,41 @@ def test_logits_parity_with_hf_qwen3():
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_logits_parity_with_hf_olmo2():
+    """OLMo-2 routes to the Llama module with post-norm blocks (no input
+    norms; block outputs normed into the residual) and a FULL-width qk-norm
+    applied before the head reshape."""
+    torch = pytest.importorskip("torch")
+    from transformers import Olmo2Config, Olmo2ForCausalLM
+
+    hf_config = Olmo2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = Olmo2ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    assert "model.layers.0.post_feedforward_layernorm.weight" in sd
+    assert "model.layers.0.input_layernorm.weight" not in sd
+    # full-width: the norm spans all heads, not one head_dim
+    assert sd["model.layers.0.self_attn.q_norm.weight"].shape == (64,)
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == "post" and cfg.qk_norm_scope == "full"
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(12).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
 def test_qwen3_export_round_trip(tmp_path):
     """Export a qk_norm model -> HF reloads it as Qwen3 with matching
     logits (the norm weights must survive both directions)."""
