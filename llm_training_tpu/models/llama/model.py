@@ -275,7 +275,7 @@ class Llama(nn.Module):
         stats (sel_frac, mean_prob) are pooled across depth BEFORE the
         E * sum(f * P) product — matching HF `load_balancing_loss_func`,
         which concatenates all layers' gate logits first, so the loss stays
-        ~1.0 when balanced regardless of num_hidden_layers."""
+        ~top_k when balanced regardless of num_hidden_layers."""
         cfg = self.config
         policy = _remat_policy(cfg)
         if cfg.scan_layers:

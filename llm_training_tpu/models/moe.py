@@ -40,9 +40,10 @@ class MoEMLP(nn.Module):
     The caller pools the per-layer stats across depth and applies the
     Switch/Mixtral formula E * sum(f * P) — pooling BEFORE the product is
     what HF's `load_balancing_loss_func` does (it concatenates every
-    layer's gate logits first), and it keeps the loss ~1.0 when balanced
-    regardless of depth. Padding tokens are excluded from both statistics,
-    like HF's attention-mask weighting.
+    layer's gate logits first), and it keeps the loss ~top_k when balanced
+    regardless of depth (HF counts each of the K selections per token, and
+    its coefficient is calibrated against that scale). Padding tokens are
+    excluded from both statistics, like HF's attention-mask weighting.
     """
 
     config: object  # LlamaConfig with num_experts set
@@ -157,11 +158,15 @@ class MoEMLP(nn.Module):
         else:
             valid = pad_mask.reshape(-1).astype(jnp.float32)
         n_valid = jnp.maximum(valid.sum(), 1.0)
+        # NOT divided by top_k: HF's load_balancing_loss_func counts each of
+        # the K selections per token (its balanced loss value is top_k, not
+        # 1.0), and router_aux_loss_coef is imported verbatim from HF
+        # configs, so the fraction must carry the same scale
         sel_frac = (
             jnp.zeros((num_experts,), jnp.float32)
             .at[topk_idx.reshape(-1)]
             .add(jnp.repeat(valid, top_k))
-            / (n_valid * top_k)
+            / n_valid
         )
         mean_prob = (probs * valid[:, None]).sum(axis=0) / n_valid
 

@@ -313,7 +313,12 @@ class Trainer:
                         cb.on_train_step(self, step)
 
                 if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
-                    metrics = {k: np.asarray(jax.device_get(v)) for k, v in metrics.items()}
+                    # ONE batched transfer: per-value device_get pays one
+                    # host<->device round trip per metric, which on a
+                    # remote-attached TPU leaves the chip idle between steps
+                    metrics = {
+                        k: np.asarray(v) for k, v in jax.device_get(metrics).items()
+                    }
                     now = time.perf_counter()
                     metrics["lr"] = np.asarray(schedule(step))
                     metrics["steps_per_sec"] = cfg.log_every_n_steps / (now - step_time)

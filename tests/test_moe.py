@@ -48,18 +48,20 @@ def test_dense_and_ragged_impls_agree():
 
 
 @pytest.mark.slow
-def test_aux_loss_near_one_at_init():
-    """Balanced routing at random init: f_e ~ 1/E, P_e ~ 1/E, so the
-    Switch-style aux E * sum(f_pooled * P_pooled) ~ 1 regardless of depth
-    (stats pool across layers BEFORE the product, like HF's
-    load_balancing_loss_func)."""
+def test_aux_loss_near_topk_at_init():
+    """Balanced routing at random init: f_e ~ top_k/E, P_e ~ 1/E, so the
+    HF-scale aux E * sum(f_pooled * P_pooled) ~ top_k regardless of depth
+    (stats pool across layers BEFORE the product, and each of the K
+    selections per token is counted, like HF's load_balancing_loss_func
+    whose coefficient the conversion imports verbatim)."""
     ids = jnp.asarray(np.random.default_rng(1).integers(0, 128, (4, 32)))
     cfg = LlamaConfig(**TINY_MOE)
     model = Llama(cfg)
     params = model.init(jax.random.key(1), ids)
     aux = float(model.apply(params, ids).aux_loss)
     assert np.isfinite(aux)
-    assert 0.9 < aux < 1.6
+    top_k = TINY_MOE["num_experts_per_tok"]
+    assert 0.9 * top_k < aux < 1.6 * top_k
 
 
 @pytest.mark.slow
