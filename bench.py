@@ -164,7 +164,9 @@ def main() -> None:
     trainer.fit(objective, datamodule)
 
     if sync_mode:
-        sec_per_step = float(np.median(np.diff(sync_times[warmup:])))
+        # intervals between consecutive post-warmup syncs; the slice starts
+        # at warmup-1 so the first post-warmup step's interval is kept
+        sec_per_step = float(np.median(np.diff(sync_times[warmup - 1:])))
     else:
         sec_per_step = (window["t1"] - window["t0"]) / (steps - warmup)
     tokens_per_step = batch * max(1, n_dev) * seq

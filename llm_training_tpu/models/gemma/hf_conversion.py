@@ -1,4 +1,4 @@
-"""Gemma 1/2 <-> HuggingFace state-dict conversion.
+"""Gemma 1/2/3 <-> HuggingFace state-dict conversion.
 
 Capability parity: reference `hf_compat_model.py:96-119` applied to the Gemma
 family (which the reference reaches only through `HFCausalLM`'s torch
@@ -41,9 +41,17 @@ _V2_NORM_PARAMS = [
     (("post_feedforward_layernorm", "weight"), "post_feedforward_layernorm.weight", False),
 ]
 
+_V3_QK_NORM_PARAMS = [
+    (("self_attn", "q_norm", "weight"), "self_attn.q_norm.weight", False),
+    (("self_attn", "k_norm", "weight"), "self_attn.k_norm.weight", False),
+]
+
 
 def _layer_params(config: GemmaConfig) -> list:
-    return _LAYER_PARAMS + (_V2_NORM_PARAMS if config.version == 2 else [])
+    extra = _V2_NORM_PARAMS if config.version in (2, 3) else []
+    if config.version == 3 and config.use_qk_norm:
+        extra = extra + _V3_QK_NORM_PARAMS
+    return _LAYER_PARAMS + extra
 
 
 def _paired(config: GemmaConfig) -> bool:
@@ -146,6 +154,18 @@ def config_to_hf(config: GemmaConfig, torch_dtype: str = "bfloat16") -> dict[str
         "use_cache": True,
         "torch_dtype": torch_dtype,
     }
+    if config.version == 3:
+        return {
+            "architectures": ["Gemma3ForCausalLM"],
+            "model_type": "gemma3_text",
+            "query_pre_attn_scalar": config.query_pre_attn_scalar or config.head_dim,
+            "sliding_window": config.sliding_window,
+            "layer_types": config.layer_types,
+            "rope_local_base_freq": config.rope_local_base_freq,
+            "rope_scaling": config.rope_scaling,
+            "use_qk_norm": config.use_qk_norm,
+            **common,
+        }
     if config.version == 2:
         return {
             "architectures": ["Gemma2ForCausalLM"],
@@ -163,7 +183,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> GemmaConfig:
     get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
         lambda k, d=None: getattr(hf_config, k, d)
     )
-    version = 2 if get("model_type") == "gemma2" else 1
+    model_type = get("model_type")
+    version = {"gemma2": 2, "gemma3_text": 3}.get(model_type, 1)
     return GemmaConfig(**{**dict(
         version=version,
         vocab_size=get("vocab_size"),
@@ -187,4 +208,14 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> GemmaConfig:
             final_logit_softcapping=get("final_logit_softcapping"),
             sliding_window=get("sliding_window"),
         ) if version == 2 else {}),
+        **(dict(
+            query_pre_attn_scalar=get("query_pre_attn_scalar"),
+            sliding_window=get("sliding_window"),
+            layer_types=list(get("layer_types") or []) or None,
+            rope_local_base_freq=get("rope_local_base_freq", 10000.0),
+            rope_scaling=get("rope_scaling"),
+            # HF Gemma3Text always applies q/k norms (no config gate on the
+            # text models; use_qk_norm only exists on the VLM variants)
+            use_qk_norm=get("use_qk_norm", True),
+        ) if version == 3 else {}),
     ), **overrides})

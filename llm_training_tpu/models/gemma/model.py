@@ -1,4 +1,4 @@
-"""Gemma 1/2 decoder, TPU-native.
+"""Gemma 1/2/3 decoder, TPU-native.
 
 Graph differences vs Llama (all verified against HF
 `modeling_gemma.py`/`modeling_gemma2.py`):
@@ -17,6 +17,13 @@ Gemma-2 (version=2) additionally:
 - attention scale from query_pre_attn_scalar, not head_dim
 - sliding window on even layer indices; under scan_layers the scanned body
   is a (sliding, full) layer PAIR so the alternation stays static
+Gemma-3 text (version=3, verified against HF `modeling_gemma3.py`)
+additionally:
+- per-head zero-centered qk-norm (Gemma3RMSNorm over head_dim) before RoPE
+- explicit layer_types sliding/full pattern (5:1), looped not scanned
+- DUAL rotary tables: sliding layers rotate with rope_local_base_freq
+  (unscaled), full layers with rope_theta + optional rope_scaling
+- no soft-capping (the fields stay None)
 """
 
 from __future__ import annotations
@@ -81,6 +88,10 @@ class GemmaAttention(nn.Module):
         q = q.reshape(batch, seq, cfg.num_attention_heads, cfg.head_dim)
         k = k.reshape(batch, seq, cfg.num_key_value_heads, cfg.head_dim)
         v = v.reshape(batch, seq, cfg.num_key_value_heads, cfg.head_dim)
+        if getattr(cfg, "use_qk_norm", False):
+            # Gemma3: per-head zero-centered RMSNorm over head_dim, pre-RoPE
+            q = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
+            k = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
         q, k = apply_rope(q, k, cos, sin)
         out = dot_product_attention(
             q, k, v,
@@ -122,7 +133,7 @@ class GemmaDecoderLayer(nn.Module):
         attn_out = GemmaAttention(cfg, self.sliding_window, name="self_attn")(
             attn_in, segment_ids, cos, sin
         )
-        if cfg.version == 2:
+        if cfg.version in (2, 3):
             attn_out = norm("post_attention_layernorm")(attn_out)
             hidden = hidden + attn_out
             mlp_in = norm("pre_feedforward_layernorm")(hidden)
@@ -163,7 +174,7 @@ class Gemma(nn.Module):
 
     config: GemmaConfig
 
-    def _layers(self, hidden, segment_ids, cos, sin):
+    def _layers(self, hidden, segment_ids, cos, sin, cos_local, sin_local):
         cfg = self.config
         policy = _remat_policy(cfg)
         paired = cfg.version == 2 and cfg.sliding_window
@@ -186,9 +197,14 @@ class Gemma(nn.Module):
             layer_cls = GemmaDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(GemmaDecoderLayer, policy=policy, static_argnums=())
+            window = cfg.layer_sliding_window(i)
+            # Gemma3 sliding layers rotate with the LOCAL tables
+            lcos, lsin = (
+                (cos_local, sin_local) if cfg.version == 3 and window else (cos, sin)
+            )
             hidden = layer_cls(
-                cfg, cfg.layer_sliding_window(i), name=f"layers_{i}"
-            )(hidden, segment_ids, cos, sin)
+                cfg, window, name=f"layers_{i}"
+            )(hidden, segment_ids, lcos, lsin)
         return hidden
 
     @nn.compact
@@ -227,8 +243,16 @@ class Gemma(nn.Module):
             cfg.rope_config, seq_len=seq
         )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+        cos_local = sin_local = None
+        if cfg.version == 3:
+            inv_freq_l, scaling_l = compute_rope_frequencies(
+                cfg.local_rope_config, seq_len=seq
+            )
+            cos_local, sin_local = compute_rope_cos_sin(
+                inv_freq_l, position_ids, scaling_l
+            )
 
-        hidden = self._layers(hidden, segment_ids, cos, sin)
+        hidden = self._layers(hidden, segment_ids, cos, sin, cos_local, sin_local)
         hidden = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 

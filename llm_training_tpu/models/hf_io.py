@@ -228,13 +228,16 @@ _ARCH_TO_FAMILY = {
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
     "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
     "olmo2": "llm_training_tpu.models.Llama",  # + post-norm blocks, full qk-norm
+    "granite": "llm_training_tpu.models.Llama",  # + 4 scalar multipliers
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",
     "qwen3_moe": "llm_training_tpu.models.Llama",
+    "olmoe": "llm_training_tpu.models.Llama",  # full qk-norm + qwen-style MoE
     "phi3": "llm_training_tpu.models.Phi3",
     "gemma": "llm_training_tpu.models.Gemma",
     "gemma2": "llm_training_tpu.models.Gemma",  # version=2 graph features
+    "gemma3_text": "llm_training_tpu.models.Gemma",  # version=3 graph features
 }
 
 

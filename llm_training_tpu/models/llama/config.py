@@ -43,13 +43,22 @@ class LlamaConfig(BaseModelConfig):
     # LlamaAttention via ops.dot_product_attention's sliding_window arg
     sliding_window: int | None = None
     # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE);
-    # scope 'full' is the OLMo-2 variant (one norm over the whole projected
-    # width, applied before the head reshape)
+    # scope 'full' is the OLMo-2/OLMoE variant (one norm over the whole
+    # projected width, applied before the head reshape)
     qk_norm: bool = False
     qk_norm_scope: Literal["head", "full"] = "head"
+    # OLMo/OLMoE: clamp q/k/v activations to [-clip_qkv, clip_qkv] after the
+    # projections (and qk-norm), before the head reshape
+    clip_qkv: float | None = None
     # 'pre' = Llama pre-norm blocks; 'post' = OLMo-2 reordering
     # (x + norm(block(x)) with NO input norms)
     norm_scheme: Literal["pre", "post"] = "pre"
+    # Granite (IBM) scalar multipliers; the defaults are the Llama identity
+    # values. attention_multiplier None = the standard 1/sqrt(head_dim).
+    embedding_multiplier: float = 1.0
+    attention_multiplier: float | None = None
+    residual_multiplier: float = 1.0
+    logits_scaling: float = 1.0
 
     # --- mixture of experts (Mixtral / Qwen2-MoE / Qwen3-MoE); None = dense
     num_experts: int | None = None

@@ -309,6 +309,25 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             if config.norm_scheme == "post"
             else {}
         ),
+        # any non-identity multiplier only exists as Granite in HF; our None
+        # attention scale exports as the explicit 1/sqrt(head_dim) Granite
+        # expects (its config has no "default scale" sentinel)
+        **(
+            {"model_type": "granite", "architectures": ["GraniteForCausalLM"],
+             "embedding_multiplier": config.embedding_multiplier,
+             "attention_multiplier": (
+                 config.attention_multiplier
+                 if config.attention_multiplier is not None
+                 else config.resolved_head_dim ** -0.5
+             ),
+             "residual_multiplier": config.residual_multiplier,
+             "logits_scaling": config.logits_scaling}
+            if (config.embedding_multiplier != 1.0
+                or config.attention_multiplier is not None
+                or config.residual_multiplier != 1.0
+                or config.logits_scaling != 1.0)
+            else {}
+        ),
         **_moe_to_hf(config),
     }
 
@@ -328,6 +347,17 @@ def _moe_to_hf(config: LlamaConfig) -> dict[str, Any]:
             "num_local_experts": config.num_experts,
             # HF Mixtral's intermediate_size IS the per-expert width
             "intermediate_size": config.moe_intermediate_size,
+            **common,
+        }
+    if config.qk_norm and config.qk_norm_scope == "full":
+        # full-width qk-norm + qwen-style experts only exist as OLMoE in HF
+        return {
+            "model_type": "olmoe",
+            "architectures": ["OlmoeForCausalLM"],
+            "num_experts": config.num_experts,
+            "intermediate_size": config.moe_intermediate_size,
+            "norm_topk_prob": config.norm_topk_prob,
+            "clip_qkv": config.clip_qkv,
             **common,
         }
     qwen3 = config.qk_norm  # qwen3_moe; else qwen2_moe (shared expert)
@@ -369,6 +399,16 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             norm_topk_prob=True,  # Mixtral always renormalizes top-k
             moe_style="mixtral",
             router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+        )
+    elif model_type == "olmoe":
+        # OLMoE: qwen-style expert naming, no shared expert, and HF's
+        # intermediate_size IS the per-expert width
+        moe = dict(
+            num_experts=get("num_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 8),
+            moe_intermediate_size=get("intermediate_size"),
+            norm_topk_prob=get("norm_topk_prob", False),
+            router_aux_loss_coef=get("router_aux_loss_coef", 0.01),
         )
     elif model_type in ("qwen2_moe", "qwen3_moe"):
         if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers"):
@@ -428,8 +468,18 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                    model_type not in ("qwen2", "qwen3", "qwen2_moe", "qwen3_moe"))
             else None
         ),
-        qk_norm=model_type in ("qwen3", "olmo2", "qwen3_moe"),
-        qk_norm_scope="full" if model_type == "olmo2" else "head",
+        qk_norm=model_type in ("qwen3", "olmo2", "qwen3_moe", "olmoe"),
+        qk_norm_scope="full" if model_type in ("olmo2", "olmoe") else "head",
         norm_scheme="post" if model_type == "olmo2" else "pre",
+        clip_qkv=get("clip_qkv"),
+        # Granite scalar multipliers (absent on every other family -> the
+        # identity defaults). attention_multiplier stays None for non-Granite
+        # so the standard 1/sqrt(head_dim) applies.
+        embedding_multiplier=get("embedding_multiplier", 1.0),
+        attention_multiplier=(
+            get("attention_multiplier") if model_type == "granite" else None
+        ),
+        residual_multiplier=get("residual_multiplier", 1.0),
+        logits_scaling=get("logits_scaling", 1.0),
         **moe,
     ), **overrides})

@@ -98,10 +98,16 @@ class LlamaAttention(nn.Module):
                    "v_proj", cfg.attention_bias)(hidden)
 
         if cfg.qk_norm and cfg.qk_norm_scope == "full":
-            # OLMo-2: one RMSNorm over the whole projected width, before the
-            # head reshape — different statistics than the per-head variant
+            # OLMo-2/OLMoE: one RMSNorm over the whole projected width, before
+            # the head reshape — different statistics than the per-head variant
             q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
             k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+
+        clip = getattr(cfg, "clip_qkv", None)
+        if clip is not None:  # OLMo/OLMoE activation clamp, after qk-norm
+            q = jnp.clip(q, -clip, clip)
+            k = jnp.clip(k, -clip, clip)
+            v = jnp.clip(v, -clip, clip)
 
         q = q.reshape(batch, seq, cfg.num_attention_heads, head_dim)
         k = k.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
@@ -171,6 +177,7 @@ class LlamaAttention(nn.Module):
                         ring_attention,
                         axis_name=SEQUENCE_AXIS,
                         causal=True,
+                        scale=getattr(cfg, "attention_multiplier", None),
                         impl=cfg.attention_impl,
                     ),
                     mesh=mesh,
@@ -183,6 +190,8 @@ class LlamaAttention(nn.Module):
             segment_ids=segment_ids,
             causal=True,
             sliding_window=getattr(cfg, "sliding_window", None),
+            # Granite replaces 1/sqrt(head_dim) with a config scalar
+            scale=getattr(cfg, "attention_multiplier", None),
             impl=cfg.attention_impl,
         )
 
@@ -229,19 +238,24 @@ class LlamaDecoderLayer(nn.Module):
                 return MoEMLP(cfg, name="mlp")(x, pad_mask)
             return LlamaMLP(cfg, name="mlp")(x), jnp.float32(0.0)
 
+        # Granite scales every block output before the residual add;
+        # rm == 1.0 (the default) folds away at trace time
+        rm = getattr(cfg, "residual_multiplier", 1.0)
+        join = (lambda x: x) if rm == 1.0 else (lambda x: x * jnp.asarray(rm, x.dtype))
+
         if cfg.norm_scheme == "post":
             # OLMo-2 reordering: no input norms; normalize each block's
             # OUTPUT before it joins the residual stream
             attn = LlamaAttention(cfg, name="self_attn")(hidden, segment_ids, cos, sin)
-            hidden = hidden + norm("post_attention_layernorm")(attn)
+            hidden = hidden + join(norm("post_attention_layernorm")(attn))
             mlp_out, aux = mlp(hidden)
-            hidden = hidden + norm("post_feedforward_layernorm")(mlp_out)
+            hidden = hidden + join(norm("post_feedforward_layernorm")(mlp_out))
             return hidden, aux
         normed = norm("input_layernorm")(hidden)
-        hidden = hidden + LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+        hidden = hidden + join(LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin))
         normed = norm("post_attention_layernorm")(hidden)
         mlp_out, aux = mlp(normed)
-        hidden = hidden + mlp_out
+        hidden = hidden + join(mlp_out)
         return hidden, aux
 
 
@@ -338,6 +352,9 @@ class Llama(nn.Module):
                 raise ValueError("one of input_ids / inputs_embeds is required")
             inputs_embeds = embed_tokens(input_ids)
         hidden = inputs_embeds
+        em = getattr(cfg, "embedding_multiplier", 1.0)
+        if em != 1.0:  # Granite scales the embeddings into the residual stream
+            hidden = hidden * jnp.asarray(em, hidden.dtype)
         seq = hidden.shape[1]
 
         if position_ids is None:
@@ -353,6 +370,13 @@ class Llama(nn.Module):
 
         hidden, aux_loss = self._layers(hidden, segment_ids, cos, sin)
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        ls = getattr(cfg, "logits_scaling", 1.0)
+        if ls != 1.0:
+            # Granite divides the logits by logits_scaling; folding the
+            # division into the final hidden states makes the fused-CE path
+            # (which consumes last_hidden_states + the head weights, see
+            # lms/clm.py) see exactly logits/ls too
+            hidden = hidden / jnp.asarray(ls, hidden.dtype)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
         logits = None

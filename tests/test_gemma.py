@@ -210,3 +210,44 @@ def test_scan_and_loop_layers_agree_v2():
     out_scan = Gemma(cfg_scan).apply(params_from_hf(hf_model.state_dict(), cfg_scan), ids)
     out_loop = Gemma(cfg_loop).apply(params_from_hf(hf_model.state_dict(), cfg_loop), ids)
     np.testing.assert_allclose(out_scan.logits, out_loop.logits, rtol=2e-5, atol=1e-5)
+
+
+def test_logits_parity_with_hf_gemma3():
+    """Gemma3 text: per-head zero-centered qk-norm, the 5:1 layer_types
+    sliding/full pattern, and DUAL rotary tables (local theta for sliding
+    layers, scaled global theta for full layers)."""
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma3TextConfig, Gemma3ForCausalLM
+
+    hf_config = Gemma3TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64,
+        query_pre_attn_scalar=24, sliding_window=8,
+        sliding_window_pattern=3,  # layers 0,1 sliding; 2 full; 3 sliding
+        rope_theta=1000000.0, rope_local_base_freq=10000.0,
+        rope_scaling={"rope_type": "linear", "factor": 8.0},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = Gemma3ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    assert sd["model.layers.0.self_attn.q_norm.weight"].shape == (16,)  # per-head
+    assert "model.layers.0.pre_feedforward_layernorm.weight" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.version == 3 and cfg.use_qk_norm
+    assert cfg.layer_types == list(hf_config.layer_types)
+    assert not cfg.scan_layers  # aperiodic pattern -> looped layers
+    # the pattern must mix both kinds or the dual-rope path goes untested
+    assert {"sliding_attention", "full_attention"} <= set(cfg.layer_types)
+    params = params_from_hf(sd, cfg)
+    model = Gemma(cfg)
+
+    # 24 > sliding_window so local attention actually truncates
+    ids = np.random.default_rng(9).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)

@@ -352,3 +352,66 @@ def test_qwen2_export_round_trip(tmp_path):
         a = hf_model(torch.tensor(ids)).logits.numpy()
         b = reloaded(torch.tensor(ids)).logits.numpy()
     np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-5)
+
+
+def test_logits_parity_with_hf_granite():
+    """Granite routes to the Llama module with four scalar multipliers:
+    embeddings scaled into the residual stream, a config attention scale
+    replacing 1/sqrt(head_dim), block outputs scaled before the residual
+    add, and logits divided by logits_scaling."""
+    torch = pytest.importorskip("torch")
+    from transformers import GraniteConfig, GraniteForCausalLM
+
+    hf_config = GraniteConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
+        embedding_multiplier=12.0, attention_multiplier=0.12,
+        residual_multiplier=0.22, logits_scaling=6.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = GraniteForCausalLM(hf_config).eval()
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.embedding_multiplier == 12.0
+    assert cfg.attention_multiplier == 0.12
+    assert cfg.residual_multiplier == 0.22
+    assert cfg.logits_scaling == 6.0
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(13).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_granite_export_round_trip(tmp_path):
+    """A config with non-identity multipliers must export as Granite and
+    reload in transformers with matching logits (multipliers live only in
+    config.json — the weights are plain Llama)."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **TINY, embedding_multiplier=12.0, attention_multiplier=0.12,
+        residual_multiplier=0.22, logits_scaling=6.0,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(14).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(3), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "GraniteForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)

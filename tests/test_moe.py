@@ -277,3 +277,29 @@ def test_moe_trains_and_logs_aux(devices):
     steps = sorted(seen)
     assert seen[steps[-1]]["loss"] < seen[steps[0]]["loss"]
     assert all(np.isfinite(m["aux_loss"]) for m in seen.values())
+
+
+def test_logits_parity_with_hf_olmoe():
+    """OLMoE routes to the Llama module: full-width qk-norm (pre-norm
+    blocks, unlike OLMo-2), clip_qkv clamp, and qwen-style expert naming
+    where HF's intermediate_size is the per-expert width."""
+    torch = pytest.importorskip("torch")
+    from transformers import OlmoeConfig, OlmoeForCausalLM
+
+    hf_config = OlmoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        norm_topk_prob=False, clip_qkv=3.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = OlmoeForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mlp.experts.0.gate_proj.weight" in sd
+    assert "model.layers.0.input_layernorm.weight" in sd  # pre-norm, not OLMo-2
+    # full-width: the q norm spans all heads
+    assert sd["model.layers.0.self_attn.q_norm.weight"].shape == (64,)
+    cfg, _, _ = _parity(hf_model, hf_config, seed=22)
+    assert cfg.qk_norm_scope == "full" and cfg.norm_scheme == "pre"
+    assert cfg.moe_intermediate_size == 48 and cfg.clip_qkv == 3.0
