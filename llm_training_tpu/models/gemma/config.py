@@ -108,20 +108,11 @@ class GemmaConfig(BaseModelConfig):
     def rope_config(self):
         """Global rope: rope_theta, plus Gemma3's optional rope_scaling
         (linear factor 8 on the 4B+ checkpoints)."""
-        from llm_training_tpu.ops.rope_utils import RoPEConfig
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
 
-        scaling = dict(self.rope_scaling) if self.rope_scaling else None
-        rope_type = "default"
-        if scaling:
-            for key in ("rope_type", "type"):  # both HF spellings
-                if key in scaling:
-                    rope_type = scaling.pop(key)
-        return RoPEConfig(
-            type=rope_type,
-            base=self.rope_theta,
-            dim=self.head_dim,
-            max_position_embeddings=self.max_position_embeddings,
-            scaling=scaling or None,
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.head_dim,
+            self.max_position_embeddings,
         )
 
     @property

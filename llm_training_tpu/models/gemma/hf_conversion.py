@@ -155,12 +155,24 @@ def config_to_hf(config: GemmaConfig, torch_dtype: str = "bfloat16") -> dict[str
         "torch_dtype": torch_dtype,
     }
     if config.version == 3:
+        if not config.use_qk_norm:
+            # HF Gemma3 text models build q/k norms unconditionally: a
+            # qk-norm-free export would reload with random-initialized norms
+            raise ValueError(
+                "version=3 with use_qk_norm=False cannot be exported as "
+                "gemma3_text (HF always applies q/k norms)"
+            )
         return {
             "architectures": ["Gemma3ForCausalLM"],
             "model_type": "gemma3_text",
             "query_pre_attn_scalar": config.query_pre_attn_scalar or config.head_dim,
             "sliding_window": config.sliding_window,
-            "layer_types": config.layer_types,
+            # always explicit: HF re-derives a 5:1 sliding pattern from a
+            # null layer_types, which would diverge from an all-global model
+            "layer_types": (
+                config.layer_types
+                or ["full_attention"] * config.num_hidden_layers
+            ),
             "rope_local_base_freq": config.rope_local_base_freq,
             "rope_scaling": config.rope_scaling,
             "use_qk_norm": config.use_qk_norm,

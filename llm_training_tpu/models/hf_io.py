@@ -229,6 +229,8 @@ _ARCH_TO_FAMILY = {
     "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
     "olmo2": "llm_training_tpu.models.Llama",  # + post-norm blocks, full qk-norm
     "granite": "llm_training_tpu.models.Llama",  # + 4 scalar multipliers
+    "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
+    "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

@@ -51,8 +51,17 @@ class LlamaConfig(BaseModelConfig):
     # projections (and qk-norm), before the head reshape
     clip_qkv: float | None = None
     # 'pre' = Llama pre-norm blocks; 'post' = OLMo-2 reordering
-    # (x + norm(block(x)) with NO input norms)
-    norm_scheme: Literal["pre", "post"] = "pre"
+    # (x + norm(block(x)) with NO input norms); 'parallel' = Cohere's single
+    # input norm feeding attention AND mlp, summed into one residual add
+    norm_scheme: Literal["pre", "post", "parallel"] = "pre"
+    # Starcoder2: biased LayerNorm instead of RMSNorm (rms_norm_eps doubles
+    # as its epsilon), and a non-gated c_fc -> gelu_tanh -> c_proj MLP.
+    # 'layernorm_nobias' is Cohere's mean-centered weight-only norm.
+    norm_type: Literal["rmsnorm", "layernorm", "layernorm_nobias"] = "rmsnorm"
+    mlp_type: Literal["swiglu", "gelu"] = "swiglu"
+    # Cohere: interleaved (GPT-J) rope pairing + a multiplicative logit scale
+    rope_interleaved: bool = False
+    logit_scale: float | None = None
     # Granite (IBM) scalar multipliers; the defaults are the Llama identity
     # values. attention_multiplier None = the standard 1/sqrt(head_dim).
     embedding_multiplier: float = 1.0
@@ -99,6 +108,8 @@ class LlamaConfig(BaseModelConfig):
             # user (or an HF config) asked for
             raise ValueError("attention_dropout is not supported; set it to 0.0")
         if self.num_experts is not None:
+            if self.mlp_type != "swiglu":
+                raise ValueError("MoE layers only support the swiglu mlp_type")
             if self.moe_intermediate_size is None:
                 raise ValueError("num_experts requires moe_intermediate_size")
             if not 0 < self.num_experts_per_tok <= self.num_experts:
@@ -115,17 +126,9 @@ class LlamaConfig(BaseModelConfig):
 
     @property
     def rope_config(self) -> RoPEConfig:
-        scaling = dict(self.rope_scaling) if self.rope_scaling else None
-        rope_type = "default"
-        if scaling:
-            # accept both HF spellings ('rope_type' new, 'type' legacy)
-            for key in ("rope_type", "type"):
-                if key in scaling:
-                    rope_type = scaling.pop(key)
-        return RoPEConfig(
-            type=rope_type,
-            base=self.rope_theta,
-            dim=self.resolved_head_dim,
-            max_position_embeddings=self.max_position_embeddings,
-            scaling=scaling or None,
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.resolved_head_dim,
+            self.max_position_embeddings,
         )

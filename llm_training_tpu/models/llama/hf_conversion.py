@@ -33,6 +33,11 @@ _PRE_NORM_PARAMS = [
     (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
 ]
 
+# Cohere parallel scheme: ONE shared input norm per layer
+_PARALLEL_NORM_PARAMS = [
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+]
+
 # OLMo-2 post-norm scheme: no input norms, block outputs normed instead
 _POST_NORM_PARAMS = [
     (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
@@ -60,6 +65,17 @@ _LAYER_QK_NORM_PARAMS = [
 ]
 
 
+_GELU_MLP_PARAMS = [
+    (("mlp", "c_fc", "kernel"), "mlp.c_fc.weight", True),
+    (("mlp", "c_proj", "kernel"), "mlp.c_proj.weight", True),
+]
+
+_GELU_MLP_BIAS_PARAMS = [
+    (("mlp", "c_fc", "bias"), "mlp.c_fc.bias", False),
+    (("mlp", "c_proj", "bias"), "mlp.c_proj.bias", False),
+]
+
+
 def _bias_params(config: LlamaConfig) -> list:
     extra = []
     if config.attention_bias:
@@ -77,8 +93,23 @@ def _layer_params(config: LlamaConfig) -> list:
         # MoE layers have no dense MLP; expert stacks are converted by
         # _moe_layer_parts / _moe_layer_out
         matmuls = [p for p in matmuls if p[0][0] != "mlp"]
-    norms = _POST_NORM_PARAMS if config.norm_scheme == "post" else _PRE_NORM_PARAMS
-    return matmuls + norms + _bias_params(config)
+    elif config.mlp_type == "gelu":
+        matmuls = [p for p in matmuls if p[0][0] != "mlp"] + _GELU_MLP_PARAMS
+    norms = {
+        "post": _POST_NORM_PARAMS,
+        "parallel": _PARALLEL_NORM_PARAMS,
+        "pre": _PRE_NORM_PARAMS,
+    }[config.norm_scheme]
+    if config.norm_type == "layernorm":
+        # biased LayerNorm blocks (Starcoder2): each norm adds a bias key
+        norms = norms + [
+            (path[:-1] + ("bias",), hf.replace(".weight", ".bias"), False)
+            for path, hf, _ in norms
+        ]
+    extra = _bias_params(config)
+    if config.mlp_type == "gelu" and config.mlp_bias:
+        extra = extra + _GELU_MLP_BIAS_PARAMS
+    return matmuls + norms + extra
 
 
 # our MoE projection name -> HF per-expert module name, per naming style
@@ -174,6 +205,8 @@ def params_from_hf(
 
     put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
     put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if config.norm_type == "layernorm":
+        put(("norm", "bias"), _to_numpy(sd["norm.bias"]))
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
@@ -216,6 +249,8 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     out: dict[str, np.ndarray] = {}
     out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
     out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if config.norm_type == "layernorm":
+        out["model.norm.bias"] = np.asarray(_get_path(p, ("norm", "bias")))
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
@@ -251,9 +286,38 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     return out
 
 
+def _check_exportable(config: LlamaConfig) -> None:
+    """Refuse feature combinations no HF architecture represents — a silent
+    plain-llama fallthrough would reload with random-initialized modules."""
+    is_starcoder2 = config.norm_type == "layernorm" and config.mlp_type == "gelu"
+    if (config.mlp_type == "gelu") != is_starcoder2 or (
+        config.norm_type == "layernorm"
+    ) != is_starcoder2:
+        raise ValueError(
+            "mlp_type='gelu' and norm_type='layernorm' only exist together "
+            "(as Starcoder2) in HF; this combination cannot be exported"
+        )
+    if is_starcoder2 and not (
+        config.attention_bias == config.attention_out_bias == config.mlp_bias
+    ):
+        raise ValueError(
+            "Starcoder2 has ONE use_bias flag covering q/k/v/o and the MLP; "
+            "mismatched attention_bias/attention_out_bias/mlp_bias cannot be "
+            "exported"
+        )
+    if config.clip_qkv is not None and not (
+        config.num_experts and config.qk_norm and config.qk_norm_scope == "full"
+    ):
+        raise ValueError(
+            "clip_qkv only exists in HF on OLMoE (full qk-norm + MoE); it "
+            "would be silently dropped by any other export"
+        )
+
+
 def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
     """Our LlamaConfig -> HF `config.json` dict (reference `get_hf_model`,
     `hf_compat_model.py:113-119`, exports an HF config alongside weights)."""
+    _check_exportable(config)
     return {
         "architectures": ["LlamaForCausalLM"],
         "model_type": "llama",
@@ -307,6 +371,32 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
         **(
             {"model_type": "olmo2", "architectures": ["Olmo2ForCausalLM"]}
             if config.norm_scheme == "post"
+            else {}
+        ),
+        # parallel blocks + interleaved rope + logit_scale only exist as
+        # Cohere in HF (always-tied embeddings, weight-only LayerNorm whose
+        # eps is layer_norm_eps)
+        **(
+            {"model_type": "cohere", "architectures": ["CohereForCausalLM"],
+             "logit_scale": config.logit_scale,
+             "layer_norm_eps": config.rms_norm_eps,
+             "use_qk_norm": config.qk_norm,
+             # honest tie flag: forcing True would re-tie an untied lm_head
+             # on reload and silently discard its trained weights
+             "tie_word_embeddings": config.tie_word_embeddings}
+            if config.norm_scheme == "parallel"
+            else {}
+        ),
+        # biased-LayerNorm + non-gated gelu MLP only exist as Starcoder2 in
+        # HF (its use_bias covers attention and MLP together; norm_epsilon is
+        # its LayerNorm eps)
+        **(
+            {"model_type": "starcoder2", "architectures": ["Starcoder2ForCausalLM"],
+             "use_bias": config.attention_bias,
+             "norm_epsilon": config.rms_norm_eps,
+             "sliding_window": config.sliding_window,
+             "hidden_act": "gelu_pytorch_tanh"}
+            if config.norm_type == "layernorm" and config.mlp_type == "gelu"
             else {}
         ),
         # any non-identity multiplier only exists as Granite in HF; our None
@@ -438,7 +528,11 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         head_dim=get("head_dim"),
         max_position_embeddings=get("max_position_embeddings"),
         initializer_range=get("initializer_range", 0.02),
-        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        rms_norm_eps=(
+            get("norm_epsilon", 1e-5) if model_type == "starcoder2"
+            else get("layer_norm_eps", 1e-5) if model_type == "cohere"
+            else get("rms_norm_eps", 1e-6)
+        ),
         pad_token_id=get("pad_token_id"),
         bos_token_id=get("bos_token_id", 1),
         eos_token_id=get("eos_token_id", 2),
@@ -448,17 +542,22 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # config field in their HF configs); explicit attention_bias wins.
         # Present-but-None (our own qwen2-style exports) counts as absent.
         attention_bias=(
-            get("attention_bias")
+            get("use_bias", True) if model_type == "starcoder2"
+            else get("attention_bias")
             if get("attention_bias") is not None
             else model_type in ("qwen2", "qwen2_moe")
         ),
         attention_out_bias=(
-            False
+            get("use_bias", True) if model_type == "starcoder2"
+            else False
             if model_type in ("qwen2", "qwen2_moe") and get("attention_bias") is None
             else (get("attention_bias") or False)
         ),
         attention_dropout=get("attention_dropout", 0.0),
-        mlp_bias=get("mlp_bias", False),
+        mlp_bias=(
+            get("use_bias", True) if model_type == "starcoder2"
+            else get("mlp_bias", False)
+        ),
         rope_scaling=get("rope_scaling"),
         # Mistral sets sliding_window unconditionally; the Qwen families gate
         # it behind use_sliding_window (default False)
@@ -468,10 +567,30 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                    model_type not in ("qwen2", "qwen3", "qwen2_moe", "qwen3_moe"))
             else None
         ),
-        qk_norm=model_type in ("qwen3", "olmo2", "qwen3_moe", "olmoe"),
+        qk_norm=(
+            get("use_qk_norm", False) if model_type == "cohere"
+            else model_type in ("qwen3", "olmo2", "qwen3_moe", "olmoe")
+        ),
         qk_norm_scope="full" if model_type in ("olmo2", "olmoe") else "head",
-        norm_scheme="post" if model_type == "olmo2" else "pre",
+        norm_scheme=(
+            "post" if model_type == "olmo2"
+            else "parallel" if model_type == "cohere"
+            else "pre"
+        ),
         clip_qkv=get("clip_qkv"),
+        # Starcoder2: biased LayerNorm + non-gated gelu MLP; use_bias covers
+        # q/k/v/o AND the MLP projections. Cohere: weight-only mean-centered
+        # norm, parallel blocks, interleaved rope, multiplicative logit scale.
+        norm_type=(
+            "layernorm" if model_type == "starcoder2"
+            else "layernorm_nobias" if model_type == "cohere"
+            else "rmsnorm"
+        ),
+        mlp_type="gelu" if model_type == "starcoder2" else "swiglu",
+        rope_interleaved=(model_type == "cohere"),
+        logit_scale=(
+            get("logit_scale", 0.0625) if model_type == "cohere" else None
+        ),
         # Granite scalar multipliers (absent on every other family -> the
         # identity defaults). attention_multiplier stays None for non-Granite
         # so the standard 1/sqrt(head_dim) applies.

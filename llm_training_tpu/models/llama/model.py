@@ -21,6 +21,8 @@ sharding plans (`:197-268`) — re-designed as a single flax.linen module tree:
 
 from __future__ import annotations
 
+from functools import partial as _partial
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -46,6 +48,56 @@ class RMSNorm(nn.Module):
             self.param_dtype,
         )
         return rms_norm(x, weight.astype(x.dtype), self.eps)
+
+
+class LayerNorm(nn.Module):
+    """Mean-centered LayerNorm with fp32 stats over the LAST dim.
+
+    use_bias=True is the Starcoder2 block norm (HF param names weight/bias);
+    use_bias=False is Cohere's weight-only CohereLayerNorm, whose weight may
+    be multi-dim ([heads, head_dim] for the per-head qk-norm) spanning the
+    trailing dims of x."""
+
+    eps: float
+    param_dtype: jnp.dtype
+    use_bias: bool = True
+    weight_shape: tuple[int, ...] | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        shape = self.weight_shape or (x.shape[-1],)
+        axes = (None,) * (len(shape) - 1) + ("norm",)
+        weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.ones, axes),
+            shape,
+            self.param_dtype,
+        )
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        normed = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        out = normed * weight.astype(jnp.float32)
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), axes),
+                shape,
+                self.param_dtype,
+            )
+            out = out + bias.astype(jnp.float32)
+        return out.astype(x.dtype)
+
+
+_NORM_CLASSES = {
+    "rmsnorm": RMSNorm,
+    "layernorm": LayerNorm,
+    "layernorm_nobias": _partial(LayerNorm, use_bias=False),
+}
+
+
+def _norm_cls(config):
+    return _NORM_CLASSES[getattr(config, "norm_type", "rmsnorm")]
 
 
 def _dense(config: LlamaConfig, features: int, logical_axes: tuple[str, str], name: str,
@@ -114,12 +166,25 @@ class LlamaAttention(nn.Module):
         v = v.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
 
         if cfg.qk_norm and cfg.qk_norm_scope == "head":
-            # Qwen3: per-head RMSNorm over head_dim, before RoPE (HF
-            # Qwen3Attention applies q_norm/k_norm on the reshaped heads)
-            q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
-            k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+            if getattr(cfg, "norm_type", "rmsnorm") == "layernorm_nobias":
+                # Cohere: per-HEAD weights [heads, head_dim], mean-centered
+                q = LayerNorm(
+                    cfg.rms_norm_eps, cfg.param_jnp_dtype, use_bias=False,
+                    weight_shape=(cfg.num_attention_heads, head_dim), name="q_norm",
+                )(q)
+                k = LayerNorm(
+                    cfg.rms_norm_eps, cfg.param_jnp_dtype, use_bias=False,
+                    weight_shape=(cfg.num_key_value_heads, head_dim), name="k_norm",
+                )(k)
+            else:
+                # Qwen3: per-head RMSNorm over head_dim, shared weight, before
+                # RoPE (HF Qwen3Attention applies q/k norms on reshaped heads)
+                q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
+                k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
 
-        q, k = apply_rope(q, k, cos, sin)
+        q, k = apply_rope(
+            q, k, cos, sin, interleaved=getattr(cfg, "rope_interleaved", False)
+        )
 
         attention_dtype = getattr(cfg, "attention_compute_dtype", None)
         if attention_dtype is not None:
@@ -198,13 +263,19 @@ class LlamaAttention(nn.Module):
 
 class LlamaMLP(nn.Module):
     """SwiGLU MLP (reference `llama_model.py:415-427`): gate/up colwise
-    ('mlp' → tensor), down rowwise."""
+    ('mlp' → tensor), down rowwise. mlp_type='gelu' is the Starcoder2
+    non-gated variant (c_fc → gelu_tanh → c_proj, HF param names)."""
 
     config: LlamaConfig
 
     @nn.compact
     def __call__(self, hidden: jnp.ndarray) -> jnp.ndarray:
         cfg = self.config
+        if getattr(cfg, "mlp_type", "swiglu") == "gelu":
+            up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "c_fc", cfg.mlp_bias)(hidden)
+            return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "c_proj", cfg.mlp_bias)(
+                nn.gelu(up, approximate=True)
+            )
         gate = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "gate_proj", cfg.mlp_bias)(hidden)
         up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.mlp_bias)(hidden)
         return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.mlp_bias)(silu_mul(gate, up))
@@ -225,7 +296,7 @@ class LlamaDecoderLayer(nn.Module):
     ) -> jnp.ndarray:
         cfg = self.config
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
-        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        norm = lambda name: _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
 
         def mlp(x):
             """(out, aux): MoE block returns per-layer router stats
@@ -243,6 +314,14 @@ class LlamaDecoderLayer(nn.Module):
         rm = getattr(cfg, "residual_multiplier", 1.0)
         join = (lambda x: x) if rm == 1.0 else (lambda x: x * jnp.asarray(rm, x.dtype))
 
+        if cfg.norm_scheme == "parallel":
+            # Cohere: ONE input norm feeds attention and mlp; both outputs
+            # join the residual in a single add
+            normed = norm("input_layernorm")(hidden)
+            attn = LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+            mlp_out, aux = mlp(normed)
+            hidden = hidden + join(attn) + join(mlp_out)
+            return hidden, aux
         if cfg.norm_scheme == "post":
             # OLMo-2 reordering: no input norms; normalize each block's
             # OUTPUT before it joins the residual stream
@@ -367,9 +446,19 @@ class Llama(nn.Module):
             cfg.rope_config, seq_len=seq
         )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+        if getattr(cfg, "rope_interleaved", False):
+            # repeat_interleave(freqs, 2) layout instead of concat halves
+            half = cos.shape[-1] // 2
+            cos = jnp.repeat(cos[..., :half], 2, axis=-1)
+            sin = jnp.repeat(sin[..., :half], 2, axis=-1)
 
         hidden, aux_loss = self._layers(hidden, segment_ids, cos, sin)
-        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        mult = getattr(cfg, "logit_scale", None)
+        if mult is not None:
+            # Cohere multiplies the logits by logit_scale; folded into the
+            # hidden states for the same fused-CE reason as logits_scaling
+            hidden = hidden * jnp.asarray(mult, hidden.dtype)
         ls = getattr(cfg, "logits_scaling", 1.0)
         if ls != 1.0:
             # Granite divides the logits by logits_scaling; folding the

@@ -15,17 +15,29 @@ def rotate_half(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
+def rotate_interleaved(x: jnp.ndarray) -> jnp.ndarray:
+    """GPT-J/Cohere pairing: rotate within (even, odd) pairs of the last dim
+    — (x0, x1) -> (-x1, x0)."""
+    x2 = x.reshape(*x.shape[:-1], -1, 2)
+    rot = jnp.stack([-x2[..., 1], x2[..., 0]], axis=-1)
+    return rot.reshape(x.shape)
+
+
 def apply_rope(
     q: jnp.ndarray,
     k: jnp.ndarray,
     cos: jnp.ndarray,
     sin: jnp.ndarray,
+    interleaved: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Apply rotary embedding to q and k.
 
     q: [batch, seq, num_heads, head_dim] (head axis broadcast-compatible)
     k: [batch, seq, num_kv_heads, head_dim]
     cos/sin: [batch, seq, head_dim] or [seq, head_dim]
+    interleaved: Cohere/GPT-J pairing — the caller supplies
+    repeat_interleave(freqs, 2) tables and rotation pairs (even, odd) dims
+    instead of (i, i + head_dim/2)
 
     cos/sin are computed in fp32 by the rotary cache (see rope_utils) and cast
     to the activation dtype here, matching the reference's precision choice
@@ -39,6 +51,7 @@ def apply_rope(
     # q and k dtypes differ).
     cos = cos[:, :, None, :]
     sin = sin[:, :, None, :]
-    q_rot = q * cos.astype(q.dtype) + rotate_half(q) * sin.astype(q.dtype)
-    k_rot = k * cos.astype(k.dtype) + rotate_half(k) * sin.astype(k.dtype)
+    rotate = rotate_interleaved if interleaved else rotate_half
+    q_rot = q * cos.astype(q.dtype) + rotate(q) * sin.astype(q.dtype)
+    k_rot = k * cos.astype(k.dtype) + rotate(k) * sin.astype(k.dtype)
     return q_rot, k_rot

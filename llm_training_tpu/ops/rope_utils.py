@@ -55,6 +55,30 @@ class RoPEConfig(BaseModel):
         return self
 
 
+def rope_config_from_hf(
+    rope_scaling: dict | None,
+    base: float,
+    dim: int,
+    max_position_embeddings: int,
+) -> RoPEConfig:
+    """Build a RoPEConfig from HF-style fields: `rope_scaling` may carry the
+    variant under 'rope_type' (new) or 'type' (legacy); the rest of the dict
+    is the variant's knobs."""
+    scaling = dict(rope_scaling) if rope_scaling else None
+    rope_type = "default"
+    if scaling:
+        for key in ("rope_type", "type"):
+            if key in scaling:
+                rope_type = scaling.pop(key)
+    return RoPEConfig(
+        type=rope_type,
+        base=base,
+        dim=dim,
+        max_position_embeddings=max_position_embeddings,
+        scaling=scaling or None,
+    )
+
+
 def _require(config: RoPEConfig, keys: set[str], optional: set[str] = frozenset()) -> None:
     scaling = config.scaling or {}
     received = set(scaling)

@@ -53,7 +53,11 @@ fi
 if [[ -n "${SLURM_JOB_ID:-}" ]]; then
   # under sbatch: launch one task per host; each process finds its rank in
   # SLURM_PROCID and the coordinator via JAX_COORDINATOR_ADDRESS
-  head_node=$(scontrol show hostnames "${SLURM_JOB_NODELIST}" | head -n1)
+  # capture the node list before taking the first line: piping scontrol
+  # straight into `head -n1` dies of SIGPIPE under pipefail whenever head
+  # wins the race (observed flaky under the test's fake scontrol)
+  slurm_nodes=$(scontrol show hostnames "${SLURM_JOB_NODELIST}")
+  head_node=$(printf '%s\n' "${slurm_nodes}" | head -n1)
   export JAX_COORDINATOR_ADDRESS="${JAX_COORDINATOR_ADDRESS:-${head_node}:12345}"
   launch srun --ntasks-per-node=1 python -m llm_training_tpu "${ARGS[@]}"
 fi

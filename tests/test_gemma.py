@@ -251,3 +251,25 @@ def test_logits_parity_with_hf_gemma3():
         hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
     ours = model.apply(params, jnp.asarray(ids)).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma3_export_guards():
+    """gemma3_text exports must carry an explicit layer_types list (HF
+    re-derives a 5:1 sliding pattern from null) and refuse qk-norm-off
+    configs (HF builds the norms unconditionally)."""
+    import pytest as _pytest
+
+    from llm_training_tpu.models.gemma.hf_conversion import config_to_hf
+
+    hf = config_to_hf(GemmaConfig(
+        version=3, vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, query_pre_attn_scalar=24,
+    ))
+    assert hf["layer_types"] == ["full_attention"] * 2
+    with _pytest.raises(ValueError, match="use_qk_norm"):
+        config_to_hf(GemmaConfig(
+            version=3, vocab_size=128, hidden_size=64, intermediate_size=112,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, use_qk_norm=False,
+        ))

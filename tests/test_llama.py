@@ -415,3 +415,124 @@ def test_granite_export_round_trip(tmp_path):
         hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
     ours = model.apply(params, ids).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_starcoder2():
+    """Starcoder2 routes to the Llama module with biased LayerNorm blocks,
+    biased q/k/v/o projections, and a non-gated c_fc -> gelu_tanh -> c_proj
+    MLP; HF's use_bias covers attention and MLP together and norm_epsilon is
+    the LayerNorm eps."""
+    torch = pytest.importorskip("torch")
+    from transformers import Starcoder2Config, Starcoder2ForCausalLM
+
+    hf_config = Starcoder2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_bias=True, norm_epsilon=1e-5,
+        sliding_window=8, tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = Starcoder2ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mlp.c_fc.bias" in sd
+    assert "model.layers.0.input_layernorm.bias" in sd
+    assert "model.norm.bias" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_type == "layernorm" and cfg.mlp_type == "gelu"
+    assert cfg.attention_bias and cfg.attention_out_bias and cfg.mlp_bias
+    assert cfg.sliding_window == 8
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    # 24 > sliding_window so local attention actually truncates
+    ids = np.random.default_rng(15).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_starcoder2_export_round_trip(tmp_path):
+    """A layernorm+gelu config must export as Starcoder2 and reload in
+    transformers with NO missing keys and matching logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **TINY, norm_type="layernorm", mlp_type="gelu",
+        attention_bias=True, mlp_bias=True, tie_word_embeddings=True,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(16).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(4), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "Starcoder2ForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("use_qk_norm", [False, True])
+def test_logits_parity_with_hf_cohere(use_qk_norm):
+    """Cohere (Command R) routes to the Llama module: a single mean-centered
+    weight-only input norm feeding attention AND mlp in parallel, interleaved
+    (GPT-J) rope pairing, always-tied embeddings, a multiplicative
+    logit_scale, and (Command R+) a per-head-weighted qk-norm."""
+    torch = pytest.importorskip("torch")
+    from transformers import CohereConfig, CohereForCausalLM
+
+    hf_config = CohereConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.125,
+        layer_norm_eps=1e-5, use_qk_norm=use_qk_norm,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = CohereForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.post_attention_layernorm.weight" not in sd
+    if use_qk_norm:
+        assert sd["model.layers.0.self_attn.q_norm.weight"].shape == (4, 16)
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == "parallel" and cfg.norm_type == "layernorm_nobias"
+    assert cfg.rope_interleaved and cfg.logit_scale == 0.125
+    assert cfg.qk_norm == use_qk_norm
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(17).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_unexportable_combos_raise():
+    """Feature combinations no HF architecture represents must fail at
+    export instead of silently falling through to a plain-llama config that
+    reloads with random-initialized modules."""
+    import pytest as _pytest
+
+    from llm_training_tpu.models.llama.hf_conversion import config_to_hf
+
+    with _pytest.raises(ValueError, match="Starcoder2"):
+        config_to_hf(LlamaConfig(**TINY, mlp_type="gelu"))  # gelu w/o layernorm
+    with _pytest.raises(ValueError, match="use_bias"):
+        config_to_hf(LlamaConfig(
+            **TINY, norm_type="layernorm", mlp_type="gelu",
+            attention_bias=True, mlp_bias=False,
+        ))
+    with _pytest.raises(ValueError, match="clip_qkv"):
+        config_to_hf(LlamaConfig(**TINY, clip_qkv=3.0))  # dense, no OLMoE home
