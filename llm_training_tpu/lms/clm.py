@@ -49,6 +49,13 @@ def _get_path(tree: Any, path: str) -> jnp.ndarray:
     return node
 
 
+def _get_path_or_none(tree: Any, path: str) -> jnp.ndarray | None:
+    try:
+        return _get_path(tree, path)
+    except KeyError:
+        return None
+
+
 class CLM:
     """The CLM objective as a pure-function bundle.
 
@@ -130,12 +137,20 @@ class CLM:
         head = _get_path(p, head_path)
         if head_path == model.get_input_embeddings_path():
             head = head.T  # tied embeddings: [vocab, embed] -> [embed, vocab]
+            head_bias = None
+        else:
+            # Phi-style heads carry a bias next to the kernel
+            head_bias = _get_path_or_none(p, head_path.rsplit("/", 1)[0] + "/bias")
         total, count = fused_linear_cross_entropy(
             out.last_hidden_states,
             head.astype(out.last_hidden_states.dtype),
             labels,
             ignore_index=cfg.ignore_index,
             chunk_size=cfg.ce_chunk_size,
+            bias=head_bias,
+            # Gemma-2 caps the final logits; the fused path must apply the
+            # same cap or training loss diverges from the compute_logits path
+            logits_soft_cap=getattr(model.config, "final_logit_softcapping", None),
         )
         loss = total / jnp.maximum(count, 1).astype(jnp.float32)
 

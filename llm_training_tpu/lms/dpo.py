@@ -131,6 +131,7 @@ class DPO:
             labels,
             ignore_index=self.config.ignore_index,
             chunk_size=self.config.logps_chunk_size,
+            logits_soft_cap=getattr(model.config, "final_logit_softcapping", None),
         )
         return logps, counts
 

@@ -71,6 +71,7 @@ class ORPO:
             labels,
             ignore_index=self.config.ignore_index,
             chunk_size=self.config.logps_chunk_size,
+            logits_soft_cap=getattr(self.model.config, "final_logit_softcapping", None),
         )
         return logps, counts
 

@@ -231,6 +231,7 @@ _ARCH_TO_FAMILY = {
     "granite": "llm_training_tpu.models.Llama",  # + 4 scalar multipliers
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
+    "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

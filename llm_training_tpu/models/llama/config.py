@@ -62,6 +62,11 @@ class LlamaConfig(BaseModelConfig):
     # Cohere: interleaved (GPT-J) rope pairing + a multiplicative logit scale
     rope_interleaved: bool = False
     logit_scale: float | None = None
+    # Phi-1/1.5/2: rotate only the first fraction of each head's dims
+    # (rope tables span int(partial_rotary_factor * head_dim)), and the
+    # untied lm_head carries a bias
+    partial_rotary_factor: float = 1.0
+    lm_head_bias: bool = False
     # Granite (IBM) scalar multipliers; the defaults are the Llama identity
     # values. attention_multiplier None = the standard 1/sqrt(head_dim).
     embedding_multiplier: float = 1.0
@@ -129,6 +134,8 @@ class LlamaConfig(BaseModelConfig):
         from llm_training_tpu.ops.rope_utils import rope_config_from_hf
 
         return rope_config_from_hf(
-            self.rope_scaling, self.rope_theta, self.resolved_head_dim,
+            self.rope_scaling, self.rope_theta,
+            # Phi: tables span only the rotated fraction of each head
+            int(self.resolved_head_dim * self.partial_rotary_factor),
             self.max_position_embeddings,
         )

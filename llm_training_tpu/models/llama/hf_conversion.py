@@ -76,6 +76,44 @@ _GELU_MLP_BIAS_PARAMS = [
 ]
 
 
+def _uses_phi_naming(config: LlamaConfig) -> bool:
+    """Phi-1/1.5/2: the parallel + biased-LayerNorm + gelu graph, whose HF
+    checkpoints name o_proj 'dense', c_fc/c_proj 'fc1'/'fc2', and the final
+    norm 'final_layernorm'."""
+    return (
+        config.norm_scheme == "parallel"
+        and config.norm_type == "layernorm"
+        and config.mlp_type == "gelu"
+    )
+
+
+# in-layer renames are unambiguous substrings; the final norm is anchored
+# (plain .replace would corrupt 'input_layernorm.' via its 'norm.' suffix)
+_PHI_LAYER_RENAMES = [
+    (".self_attn.o_proj.", ".self_attn.dense."),
+    (".mlp.c_fc.", ".mlp.fc1."),
+    (".mlp.c_proj.", ".mlp.fc2."),
+]
+
+
+def _phi_key_to_canonical(key: str) -> str:
+    """stripped-of-'model.' HF key -> our canonical naming."""
+    if key.startswith("final_layernorm."):
+        key = "norm." + key.removeprefix("final_layernorm.")
+    for ours, hf in _PHI_LAYER_RENAMES:
+        key = key.replace(hf, ours)
+    return key
+
+
+def _canonical_key_to_phi(key: str) -> str:
+    """full export key ('model.'-prefixed) -> HF phi naming."""
+    if key.startswith("model.norm."):
+        key = "model.final_layernorm." + key.removeprefix("model.norm.")
+    for ours, hf in _PHI_LAYER_RENAMES:
+        key = key.replace(ours, hf)
+    return key
+
+
 def _bias_params(config: LlamaConfig) -> list:
     extra = []
     if config.attention_bias:
@@ -199,6 +237,8 @@ def params_from_hf(
     drop the host copy before the next one is read."""
     params: dict = {}
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    if _uses_phi_naming(config):
+        sd = {_phi_key_to_canonical(k): v for k, v in sd.items()}
 
     def put(path: tuple[str, ...], value: np.ndarray) -> None:
         _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
@@ -209,6 +249,8 @@ def params_from_hf(
         put(("norm", "bias"), _to_numpy(sd["norm.bias"]))
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+        if config.lm_head_bias:
+            put(("lm_head", "bias"), _to_numpy(sd["lm_head.bias"]))
 
     layer_params = _layer_params(config)
 
@@ -253,6 +295,8 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
         out["model.norm.bias"] = np.asarray(_get_path(p, ("norm", "bias")))
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+        if config.lm_head_bias:
+            out["lm_head.bias"] = np.asarray(_get_path(p, ("lm_head", "bias")))
 
     layer_params = _layer_params(config)
 
@@ -283,20 +327,28 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
             else:
                 get = lambda path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
             _moe_layer_out(get, config, i, out)
+    if _uses_phi_naming(config):
+        out = {_canonical_key_to_phi(k): v for k, v in out.items()}
     return out
 
 
 def _check_exportable(config: LlamaConfig) -> None:
     """Refuse feature combinations no HF architecture represents — a silent
     plain-llama fallthrough would reload with random-initialized modules."""
-    is_starcoder2 = config.norm_type == "layernorm" and config.mlp_type == "gelu"
-    if (config.mlp_type == "gelu") != is_starcoder2 or (
+    ln_gelu = config.norm_type == "layernorm" and config.mlp_type == "gelu"
+    if (config.mlp_type == "gelu") != ln_gelu or (
         config.norm_type == "layernorm"
-    ) != is_starcoder2:
+    ) != ln_gelu:
         raise ValueError(
             "mlp_type='gelu' and norm_type='layernorm' only exist together "
-            "(as Starcoder2) in HF; this combination cannot be exported"
+            "(as Starcoder2 or Phi) in HF; this combination cannot be exported"
         )
+    if ln_gelu and config.norm_scheme == "post":
+        raise ValueError(
+            "post-norm blocks with layernorm+gelu match no HF architecture"
+        )
+    is_phi = _uses_phi_naming(config)
+    is_starcoder2 = ln_gelu and not is_phi
     if is_starcoder2 and not (
         config.attention_bias == config.attention_out_bias == config.mlp_bias
     ):
@@ -304,6 +356,45 @@ def _check_exportable(config: LlamaConfig) -> None:
             "Starcoder2 has ONE use_bias flag covering q/k/v/o and the MLP; "
             "mismatched attention_bias/attention_out_bias/mlp_bias cannot be "
             "exported"
+        )
+    if is_phi and not (
+        config.attention_bias and config.attention_out_bias
+        and config.mlp_bias and config.lm_head_bias
+        and not config.tie_word_embeddings
+    ):
+        raise ValueError(
+            "HF Phi always biases q/k/v/dense/fc1/fc2 and the untied "
+            "lm_head; this config cannot be exported as phi"
+        )
+    is_cohere = (
+        config.norm_scheme == "parallel"
+        and config.norm_type == "layernorm_nobias"
+    )
+    if config.norm_scheme == "parallel" and not (is_phi or is_cohere):
+        raise ValueError(
+            "norm_scheme='parallel' only exists in HF as Cohere "
+            "(layernorm_nobias + swiglu) or Phi (layernorm + gelu); this "
+            "combination cannot be exported"
+        )
+    if config.rope_interleaved and not is_cohere:
+        raise ValueError(
+            "rope_interleaved only exists in HF on Cohere; a non-Cohere "
+            "export would reload with half-rotation pairing and wrong logits"
+        )
+    if config.logit_scale is not None and not is_cohere:
+        raise ValueError(
+            "logit_scale only exists in HF on Cohere; it would be silently "
+            "dropped by any other export"
+        )
+    if config.partial_rotary_factor != 1.0 and not is_phi:
+        raise ValueError(
+            "partial_rotary_factor only exists in HF on Phi (parallel + "
+            "layernorm + gelu); it would be silently dropped otherwise"
+        )
+    if config.lm_head_bias and not is_phi:
+        raise ValueError(
+            "lm_head_bias only exists in HF on Phi; it would be silently "
+            "dropped by any other export"
         )
     if config.clip_qkv is not None and not (
         config.num_experts and config.qk_norm and config.qk_norm_scope == "full"
@@ -373,9 +464,8 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             if config.norm_scheme == "post"
             else {}
         ),
-        # parallel blocks + interleaved rope + logit_scale only exist as
-        # Cohere in HF (always-tied embeddings, weight-only LayerNorm whose
-        # eps is layer_norm_eps)
+        # parallel blocks + weight-only LayerNorm + interleaved rope +
+        # logit_scale only exist as Cohere in HF
         **(
             {"model_type": "cohere", "architectures": ["CohereForCausalLM"],
              "logit_scale": config.logit_scale,
@@ -385,6 +475,20 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              # on reload and silently discard its trained weights
              "tie_word_embeddings": config.tie_word_embeddings}
             if config.norm_scheme == "parallel"
+            and config.norm_type == "layernorm_nobias"
+            else {}
+        ),
+        # parallel blocks + biased LayerNorm + gelu + partial rotary only
+        # exist as Phi in HF
+        **(
+            {"model_type": "phi", "architectures": ["PhiForCausalLM"],
+             "partial_rotary_factor": config.partial_rotary_factor,
+             "layer_norm_eps": config.rms_norm_eps,
+             "hidden_act": "gelu_new",
+             "qk_layernorm": False,
+             "resid_pdrop": 0.0,
+             "embd_pdrop": 0.0}
+            if _uses_phi_naming(config)
             else {}
         ),
         # biased-LayerNorm + non-gated gelu MLP only exist as Starcoder2 in
@@ -397,6 +501,7 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "sliding_window": config.sliding_window,
              "hidden_act": "gelu_pytorch_tanh"}
             if config.norm_type == "layernorm" and config.mlp_type == "gelu"
+            and config.norm_scheme == "pre"
             else {}
         ),
         # any non-identity multiplier only exists as Granite in HF; our None
@@ -480,6 +585,15 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         lambda k, d=None: getattr(hf_config, k, d)
     )
     model_type = get("model_type")
+    if model_type == "phi":
+        if get("qk_layernorm", False):
+            raise ValueError("phi qk_layernorm=True is not supported")
+        for drop in ("resid_pdrop", "embd_pdrop"):
+            if get(drop, 0.0):
+                raise ValueError(
+                    f"phi {drop}={get(drop)} is not supported: dropout is not "
+                    "implemented — override it to 0.0 to fine-tune without it"
+                )
     moe: dict[str, Any] = {}
     if model_type == "mixtral":
         moe = dict(
@@ -530,7 +644,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         initializer_range=get("initializer_range", 0.02),
         rms_norm_eps=(
             get("norm_epsilon", 1e-5) if model_type == "starcoder2"
-            else get("layer_norm_eps", 1e-5) if model_type == "cohere"
+            else get("layer_norm_eps", 1e-5) if model_type in ("cohere", "phi")
             else get("rms_norm_eps", 1e-6)
         ),
         pad_token_id=get("pad_token_id"),
@@ -543,12 +657,14 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # Present-but-None (our own qwen2-style exports) counts as absent.
         attention_bias=(
             get("use_bias", True) if model_type == "starcoder2"
+            else True if model_type == "phi"
             else get("attention_bias")
             if get("attention_bias") is not None
             else model_type in ("qwen2", "qwen2_moe")
         ),
         attention_out_bias=(
             get("use_bias", True) if model_type == "starcoder2"
+            else True if model_type == "phi"
             else False
             if model_type in ("qwen2", "qwen2_moe") and get("attention_bias") is None
             else (get("attention_bias") or False)
@@ -556,6 +672,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_dropout=get("attention_dropout", 0.0),
         mlp_bias=(
             get("use_bias", True) if model_type == "starcoder2"
+            else True if model_type == "phi"
             else get("mlp_bias", False)
         ),
         rope_scaling=get("rope_scaling"),
@@ -574,7 +691,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         qk_norm_scope="full" if model_type in ("olmo2", "olmoe") else "head",
         norm_scheme=(
             "post" if model_type == "olmo2"
-            else "parallel" if model_type == "cohere"
+            else "parallel" if model_type in ("cohere", "phi")
             else "pre"
         ),
         clip_qkv=get("clip_qkv"),
@@ -582,11 +699,15 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # q/k/v/o AND the MLP projections. Cohere: weight-only mean-centered
         # norm, parallel blocks, interleaved rope, multiplicative logit scale.
         norm_type=(
-            "layernorm" if model_type == "starcoder2"
+            "layernorm" if model_type in ("starcoder2", "phi")
             else "layernorm_nobias" if model_type == "cohere"
             else "rmsnorm"
         ),
-        mlp_type="gelu" if model_type == "starcoder2" else "swiglu",
+        mlp_type="gelu" if model_type in ("starcoder2", "phi") else "swiglu",
+        partial_rotary_factor=(
+            get("partial_rotary_factor", 0.5) if model_type == "phi" else 1.0
+        ),
+        lm_head_bias=(model_type == "phi"),
         rope_interleaved=(model_type == "cohere"),
         logit_scale=(
             get("logit_scale", 0.0625) if model_type == "cohere" else None

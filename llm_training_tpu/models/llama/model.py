@@ -182,9 +182,21 @@ class LlamaAttention(nn.Module):
                 q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
                 k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
 
-        q, k = apply_rope(
-            q, k, cos, sin, interleaved=getattr(cfg, "rope_interleaved", False)
-        )
+        rotary = getattr(cfg, "partial_rotary_factor", 1.0)
+        if rotary != 1.0:
+            # Phi: rotate only the first int(factor * head_dim) dims of each
+            # head; the remainder passes through unrotated
+            rot = int(head_dim * rotary)
+            q_rot, k_rot = apply_rope(
+                q[..., :rot], k[..., :rot], cos, sin,
+                interleaved=getattr(cfg, "rope_interleaved", False),
+            )
+            q = jnp.concatenate([q_rot, q[..., rot:]], axis=-1)
+            k = jnp.concatenate([k_rot, k[..., rot:]], axis=-1)
+        else:
+            q, k = apply_rope(
+                q, k, cos, sin, interleaved=getattr(cfg, "rope_interleaved", False)
+            )
 
         attention_dtype = getattr(cfg, "attention_compute_dtype", None)
         if attention_dtype is not None:
@@ -473,7 +485,10 @@ class Llama(nn.Module):
             if cfg.tie_word_embeddings:
                 logits = embed_tokens.attend(hidden)
             else:
-                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+                logits = _dense(
+                    cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head",
+                    getattr(cfg, "lm_head_bias", False),
+                )(hidden)
             logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
 
         return CausalLMOutput(

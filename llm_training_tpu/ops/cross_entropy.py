@@ -64,11 +64,13 @@ def fused_linear_cross_entropy(
     ignore_index: int = -100,
     chunk_size: int = 1024,
     logits_soft_cap: float | None = None,
+    bias: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """CE of `hidden @ weight` against `labels` without full logits.
+    """CE of `hidden @ weight (+ bias)` against `labels` without full logits.
 
     hidden: [tokens, embed] (any leading shape is flattened)
     weight: [embed, vocab] — the lm_head matrix
+    bias: [vocab] — the lm_head bias (Phi-style heads), added per chunk
     Returns (sum_nll fp32 scalar, num_valid_tokens int32 scalar); callers
     divide to get the mean so distributed reductions stay exact.
     """
@@ -90,6 +92,8 @@ def fused_linear_cross_entropy(
     @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
     def chunk_loss(h: jnp.ndarray, l: jnp.ndarray):
         logits = jnp.dot(h, weight, preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         if logits_soft_cap is not None:
             logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
         nll, valid = _token_nll(logits, l, ignore_index)
@@ -112,6 +116,7 @@ def fused_linear_log_probs(
     labels: jnp.ndarray,
     ignore_index: int = -100,
     chunk_size: int = 1024,
+    logits_soft_cap: float | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-sequence label log-probs of `hidden @ weight` without full logits.
 
@@ -141,6 +146,8 @@ def fused_linear_log_probs(
     @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
     def chunk_logps(h: jnp.ndarray, l: jnp.ndarray):
         logits = jnp.dot(h, weight, preferred_element_type=jnp.float32)
+        if logits_soft_cap is not None:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
         nll, valid = _token_nll(logits, l, ignore_index)
         return -nll.sum(axis=-1), valid.sum(axis=-1)
 

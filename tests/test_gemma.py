@@ -273,3 +273,33 @@ def test_gemma3_export_guards():
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             head_dim=16, use_qk_norm=False,
         ))
+
+
+def test_clm_fused_loss_applies_final_softcap():
+    """The CLM fused-CE path must apply Gemma-2's final_logit_softcapping —
+    the loss computed without logits must equal CE over the (capped)
+    compute_logits output."""
+    from llm_training_tpu.lms import CLM, CLMConfig
+
+    cfg = GemmaConfig(
+        version=2, vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, query_pre_attn_scalar=24,
+        final_logit_softcapping=5.0, compute_dtype="float32",
+    )
+    model = Gemma(cfg)
+    ids = jnp.asarray(np.random.default_rng(21).integers(1, 128, (2, 16)))
+    params = model.init(jax.random.key(6), ids)
+
+    objective = CLM(CLMConfig(), model=model)
+    loss, _ = objective.loss_and_metrics(params, {"input_ids": ids}, train=False)
+
+    logits = model.apply(params, ids).logits  # capped by compute_logits
+    shifted = np.full(ids.shape, -100)
+    shifted[:, :-1] = np.asarray(ids)[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    rows = []
+    for b in range(ids.shape[0]):
+        for t in range(ids.shape[1] - 1):
+            rows.append(-logp[b, t, shifted[b, t]])
+    np.testing.assert_allclose(float(loss), np.mean(rows), rtol=1e-5)

@@ -536,3 +536,69 @@ def test_unexportable_combos_raise():
         ))
     with _pytest.raises(ValueError, match="clip_qkv"):
         config_to_hf(LlamaConfig(**TINY, clip_qkv=3.0))  # dense, no OLMoE home
+
+
+def test_logits_parity_with_hf_phi():
+    """Phi-1/1.5/2 routes to the Llama module: parallel blocks under one
+    biased LayerNorm, partial rotary (tables span factor*head_dim), biased
+    everything including the untied lm_head, and HF's dense/fc1/fc2/
+    final_layernorm key naming."""
+    torch = pytest.importorskip("torch")
+    from transformers import PhiConfig, PhiForCausalLM
+
+    hf_config = PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        resid_pdrop=0.0, embd_pdrop=0.0, attention_dropout=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = PhiForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.dense.bias" in sd
+    assert "model.layers.0.mlp.fc1.weight" in sd
+    assert "model.final_layernorm.bias" in sd
+    assert "lm_head.bias" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == "parallel" and cfg.norm_type == "layernorm"
+    assert cfg.partial_rotary_factor == 0.5 and cfg.lm_head_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(18).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_phi_export_round_trip(tmp_path):
+    """Export a phi-graph config -> transformers reloads it as Phi with NO
+    missing keys (renamed dense/fc1/fc2/final_layernorm + lm_head.bias all
+    present) and matching logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **TINY, norm_scheme="parallel", norm_type="layernorm", mlp_type="gelu",
+        attention_bias=True, mlp_bias=True, lm_head_bias=True,
+        partial_rotary_factor=0.5,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(19).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(5), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "PhiForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
