@@ -50,6 +50,13 @@ def _get_path(tree: Any, path: str) -> jnp.ndarray:
     return node
 
 
+def _get_path_or_none(tree: Any, path: str) -> jnp.ndarray | None:
+    try:
+        return _get_path(tree, path)
+    except KeyError:
+        return None
+
+
 class DPO:
     def __init__(self, config: DPOConfig, model: Any | None = None, ref_model: Any | None = None):
         self.config = config
@@ -125,6 +132,10 @@ class DPO:
         head = _get_path(p, head_path)
         if head_path == model.get_input_embeddings_path():
             head = head.T
+            head_bias = None
+        else:
+            # Phi-style heads carry a bias next to the kernel
+            head_bias = _get_path_or_none(p, head_path.rsplit("/", 1)[0] + "/bias")
         logps, counts = fused_linear_log_probs(
             out.last_hidden_states,
             head.astype(out.last_hidden_states.dtype),
@@ -132,6 +143,7 @@ class DPO:
             ignore_index=self.config.ignore_index,
             chunk_size=self.config.logps_chunk_size,
             logits_soft_cap=getattr(model.config, "final_logit_softcapping", None),
+            bias=head_bias,
         )
         return logps, counts
 

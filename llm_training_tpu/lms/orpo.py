@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from pydantic import ConfigDict
 
 from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
-from llm_training_tpu.lms.dpo import _get_path
+from llm_training_tpu.lms.dpo import _get_path, _get_path_or_none
 from llm_training_tpu.ops import shift_labels
 from llm_training_tpu.ops.cross_entropy import fused_linear_log_probs
 
@@ -65,6 +65,10 @@ class ORPO:
         head = _get_path(p, head_path)
         if head_path == self.model.get_input_embeddings_path():
             head = head.T
+            head_bias = None
+        else:
+            # Phi-style heads carry a bias next to the kernel
+            head_bias = _get_path_or_none(p, head_path.rsplit("/", 1)[0] + "/bias")
         logps, counts = fused_linear_log_probs(
             out.last_hidden_states,
             head.astype(out.last_hidden_states.dtype),
@@ -72,6 +76,7 @@ class ORPO:
             ignore_index=self.config.ignore_index,
             chunk_size=self.config.logps_chunk_size,
             logits_soft_cap=getattr(self.model.config, "final_logit_softcapping", None),
+            bias=head_bias,
         )
         return logps, counts
 

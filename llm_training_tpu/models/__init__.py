@@ -10,6 +10,7 @@ families. Here, models are flax.linen Modules whose parameters carry
 """
 
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
+from llm_training_tpu.models.deepseek import Deepseek, DeepseekConfig
 from llm_training_tpu.models.gemma import Gemma, GemmaConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
@@ -18,6 +19,8 @@ from llm_training_tpu.models.phi3 import Phi3, Phi3Config
 __all__ = [
     "BaseModelConfig",
     "CausalLMOutput",
+    "Deepseek",
+    "DeepseekConfig",
     "Gemma",
     "GemmaConfig",
     "HFCausalLM",

@@ -1,0 +1,4 @@
+from llm_training_tpu.models.deepseek.config import DeepseekConfig
+from llm_training_tpu.models.deepseek.model import Deepseek
+
+__all__ = ["Deepseek", "DeepseekConfig"]

@@ -1,0 +1,127 @@
+"""DeepSeek V2/V3 model config.
+
+Family member beyond the reference's named models (the reference reaches
+DeepSeek only through `HFCausalLM`'s torch wrapping,
+`src/llm_training/models/hf_causal_lm/hf_causal_lm.py:22`); here the MLA +
+grouped-MoE computation graph is native. `version=2` mirrors HF
+`DeepseekV2Config` (softmax routing, greedy / group-limited-greedy top-k);
+`version=3` mirrors `DeepseekV3Config` (sigmoid routing with the noaux
+e_score_correction_bias and top-2-sum group selection).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class DeepseekConfig(BaseModelConfig):
+    version: Literal[2, 3] = 3
+
+    vocab_size: int = 129280
+    hidden_size: int = 7168
+    intermediate_size: int = 18432  # dense layers (and the MoE-free prefix)
+    num_hidden_layers: int = 61
+    num_attention_heads: int = 128
+    max_position_embeddings: int = 4096
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-6
+    pad_token_id: int | None = None
+    bos_token_id: int | None = 0
+    eos_token_id: int | None = 1
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    # HF checkpoints store rope weights interleaved (complex-pair layout);
+    # version=2 always rotates this way, version=3 carries the flag
+    rope_interleave: bool = True
+    attention_bias: bool = False
+    attention_dropout: float = 0.0
+
+    # --- MLA (multi-head latent attention) dims
+    q_lora_rank: int | None = None  # None = full-rank q_proj (V2-Lite)
+    kv_lora_rank: int = 512
+    qk_rope_head_dim: int = 64
+    qk_nope_head_dim: int = 128
+    v_head_dim: int = 128
+
+    # --- MoE; n_routed_experts None = every layer dense
+    n_routed_experts: int | None = None
+    n_shared_experts: int = 1
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int | None = None
+    first_k_dense_replace: int = 0  # layers [0, k) use the dense MLP
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    n_group: int | None = None
+    topk_group: int | None = None
+    # version=2 selection: 'greedy' (V2-Lite) or 'group_limited_greedy';
+    # version=3 always uses the noaux top-2-sum group selection
+    topk_method: Literal["greedy", "group_limited_greedy"] = "greedy"
+    # 'ragged' = dropless grouped matmul; 'dense' = exact every-expert path
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    # dense-prefix + MoE layer mix is non-uniform, so layers are looped
+    # (constant-compile scan would need a uniform body); kept as a field for
+    # config-surface compatibility but always False
+    scan_layers: bool = False
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "DeepseekConfig":
+        if self.attention_dropout != 0.0:
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.scan_layers:
+            raise ValueError(
+                "deepseek layers are looped (dense prefix + MoE mix is "
+                "non-uniform); set scan_layers=False"
+            )
+        if self.n_routed_experts is not None:
+            if self.moe_intermediate_size is None:
+                raise ValueError("n_routed_experts requires moe_intermediate_size")
+            if self.n_group is not None:
+                if self.n_routed_experts % self.n_group:
+                    raise ValueError("n_routed_experts must divide into n_group groups")
+                if self.topk_group is None:
+                    raise ValueError("n_group requires topk_group")
+        self.rope_config  # trigger validation
+        return self
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.qk_rope_head_dim,
+            self.max_position_embeddings,
+        )
+
+    @property
+    def attention_scale(self) -> float:
+        """1/sqrt(qk_head_dim), squared-mscale-corrected under DeepSeek yarn
+        (HF DeepseekV2/V3Attention.__init__)."""
+        import math
+
+        scale = self.qk_head_dim ** -0.5
+        if self.rope_scaling:
+            mscale_all_dim = self.rope_scaling.get("mscale_all_dim", 0)
+            factor = self.rope_scaling.get("factor")
+            if mscale_all_dim and factor and factor > 1:
+                mscale = 0.1 * mscale_all_dim * math.log(factor) + 1.0
+                scale = scale * mscale * mscale
+        return scale
+
+    def layer_is_moe(self, layer_idx: int) -> bool:
+        return (
+            self.n_routed_experts is not None
+            and layer_idx >= self.first_k_dense_replace
+        )

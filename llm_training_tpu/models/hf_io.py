@@ -33,6 +33,7 @@ _FAMILIES: dict[str, str] = {
     "LlamaConfig": "llm_training_tpu.models.llama.hf_conversion",
     "Phi3Config": "llm_training_tpu.models.phi3.hf_conversion",
     "GemmaConfig": "llm_training_tpu.models.gemma.hf_conversion",
+    "DeepseekConfig": "llm_training_tpu.models.deepseek.hf_conversion",
 }
 
 
@@ -232,6 +233,8 @@ _ARCH_TO_FAMILY = {
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
     "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
+    "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
+    "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

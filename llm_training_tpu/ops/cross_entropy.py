@@ -117,6 +117,7 @@ def fused_linear_log_probs(
     ignore_index: int = -100,
     chunk_size: int = 1024,
     logits_soft_cap: float | None = None,
+    bias: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-sequence label log-probs of `hidden @ weight` without full logits.
 
@@ -146,6 +147,8 @@ def fused_linear_log_probs(
     @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
     def chunk_logps(h: jnp.ndarray, l: jnp.ndarray):
         logits = jnp.dot(h, weight, preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         if logits_soft_cap is not None:
             logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
         nll, valid = _token_nll(logits, l, ignore_index)

@@ -102,7 +102,12 @@ def _validate_factor(config: RoPEConfig) -> None:
 
 
 def _validate_yarn(config: RoPEConfig) -> None:
-    _require(config, {"factor"}, {"attention_factor", "beta_fast", "beta_slow"})
+    _require(config, {"factor"}, {
+        "attention_factor", "beta_fast", "beta_slow",
+        # DeepSeek-style yarn extensions (HF _compute_yarn_parameters)
+        "mscale", "mscale_all_dim", "original_max_position_embeddings",
+        "truncate",
+    })
 
 
 def _validate_longrope(config: RoPEConfig) -> None:
@@ -163,9 +168,27 @@ def _yarn_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, flo
     scaling = config.scaling
     factor = scaling["factor"]
 
+    # DeepSeek-style yarn (HF _compute_yarn_parameters): the pre-extension
+    # context length anchors the correction range ONLY — the interpolation
+    # factor stays rope_scaling['factor']; mscale/mscale_all_dim shape the
+    # attention factor
+    max_pos = scaling.get("original_max_position_embeddings") or max_pos
+
+    def get_mscale(scale: float, mscale: float = 1.0) -> float:
+        if scale <= 1.0:
+            return 1.0
+        return 0.1 * mscale * math.log(scale) + 1.0
+
     attention_factor = scaling.get("attention_factor")
     if attention_factor is None:
-        attention_factor = 0.1 * math.log(factor) + 1.0
+        mscale = scaling.get("mscale")
+        mscale_all_dim = scaling.get("mscale_all_dim")
+        if mscale and mscale_all_dim:
+            attention_factor = get_mscale(factor, mscale) / get_mscale(
+                factor, mscale_all_dim
+            )
+        else:
+            attention_factor = get_mscale(factor)
     beta_fast = scaling.get("beta_fast") or 32
     beta_slow = scaling.get("beta_slow") or 1
 
@@ -173,8 +196,10 @@ def _yarn_rope(config: RoPEConfig, seq_len: int | None) -> tuple[np.ndarray, flo
         # Dimension whose wavelength completes `num_rotations` over the context.
         return dim * math.log(max_pos / (num_rotations * 2 * math.pi)) / (2 * math.log(base))
 
-    low = max(math.floor(correction_dim(beta_fast)), 0)
-    high = min(math.ceil(correction_dim(beta_slow)), dim - 1)
+    low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+    if scaling.get("truncate", True):  # HF default: integer range bounds
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
     if low == high:
         high += 0.001  # avoid a 0-width ramp
 

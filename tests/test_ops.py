@@ -367,3 +367,41 @@ def test_fully_masked_rows_emit_zero_xla():
     seg = jnp.asarray([[1, 1, 0, 0]])
     out = dot_product_attention(q, q, q, segment_ids=seg, impl="xla")
     np.testing.assert_array_equal(np.asarray(out[:, 2:]), 0.0)
+
+
+def test_yarn_matches_hf_deepseek_style():
+    """DeepSeek-style yarn dicts (mscale, mscale_all_dim,
+    original_max_position_embeddings, truncate) must produce the exact
+    inv_freq + attention factor transformers computes."""
+    pytest.importorskip("torch")
+    from transformers import PretrainedConfig
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from llm_training_tpu.ops.rope_utils import RoPEConfig, compute_rope_frequencies
+
+    for scaling in [
+        {"factor": 40.0, "mscale": 1.0, "mscale_all_dim": 1.0,
+         "original_max_position_embeddings": 512, "beta_fast": 32,
+         "beta_slow": 1},
+        {"factor": 8.0, "original_max_position_embeddings": 1024,
+         "truncate": False},
+        {"factor": 4.0},
+    ]:
+        hf_config = PretrainedConfig()
+        hf_config.rope_theta = 10000.0
+        hf_config.hidden_size = 64
+        hf_config.num_attention_heads = 1
+        hf_config.head_dim = 64
+        hf_config.max_position_embeddings = 4096
+        hf_config.rope_scaling = dict(scaling, rope_type="yarn")
+        hf_inv, hf_factor = ROPE_INIT_FUNCTIONS["yarn"](hf_config, device="cpu")
+
+        ours_inv, ours_factor = compute_rope_frequencies(
+            RoPEConfig(type="yarn", base=10000.0, dim=64,
+                       max_position_embeddings=4096, scaling=scaling),
+            seq_len=4096,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ours_inv), hf_inv.numpy(), rtol=1e-6, err_msg=str(scaling)
+        )
+        assert abs(ours_factor - hf_factor) < 1e-6, scaling
