@@ -24,6 +24,7 @@ import numpy as np
 from pydantic import ConfigDict
 
 from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
+from llm_training_tpu.lms.clm import _get_path_or_none
 from llm_training_tpu.ops import shift_labels
 from llm_training_tpu.ops.cross_entropy import fused_linear_log_probs
 
@@ -48,13 +49,6 @@ def _get_path(tree: Any, path: str) -> jnp.ndarray:
     if isinstance(node, nn.Partitioned):
         node = node.value
     return node
-
-
-def _get_path_or_none(tree: Any, path: str) -> jnp.ndarray | None:
-    try:
-        return _get_path(tree, path)
-    except KeyError:
-        return None
 
 
 class DPO:

@@ -233,6 +233,8 @@ _ARCH_TO_FAMILY = {
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
     "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
+    "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
+    "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)

@@ -29,7 +29,8 @@ class LlamaConfig(BaseModelConfig):
     rms_norm_eps: float = 1e-6
     pad_token_id: int | None = None
     bos_token_id: int | None = 1
-    eos_token_id: int | None = 2
+    # a list on several HF families (Llama-3.x instruct, GLM)
+    eos_token_id: int | list[int] | None = 2
     tie_word_embeddings: bool = False
     rope_theta: float = 10000.0
     attention_bias: bool = False
@@ -52,8 +53,10 @@ class LlamaConfig(BaseModelConfig):
     clip_qkv: float | None = None
     # 'pre' = Llama pre-norm blocks; 'post' = OLMo-2 reordering
     # (x + norm(block(x)) with NO input norms); 'parallel' = Cohere's single
-    # input norm feeding attention AND mlp, summed into one residual add
-    norm_scheme: Literal["pre", "post", "parallel"] = "pre"
+    # input norm feeding attention AND mlp, summed into one residual add;
+    # 'sandwich' = GLM-4's four norms (input norm AND output norm around
+    # both the attention and the mlp)
+    norm_scheme: Literal["pre", "post", "parallel", "sandwich"] = "pre"
     # Starcoder2: biased LayerNorm instead of RMSNorm (rms_norm_eps doubles
     # as its epsilon), and a non-gated c_fc -> gelu_tanh -> c_proj MLP.
     # 'layernorm_nobias' is Cohere's mean-centered weight-only norm.

@@ -38,6 +38,37 @@ _PARALLEL_NORM_PARAMS = [
     (("input_layernorm", "weight"), "input_layernorm.weight", False),
 ]
 
+# GLM-4 sandwich scheme: input + output norms around both blocks
+_SANDWICH_NORM_PARAMS = [
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_self_attn_layernorm", "weight"), "post_self_attn_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+    (("post_mlp_layernorm", "weight"), "post_mlp_layernorm.weight", False),
+]
+
+
+def _uses_fused_gate_up(config: LlamaConfig) -> bool:
+    """GLM/GLM-4 store gate and up as ONE fused gate_up_proj tensor (gate
+    rows first); our module keeps them separate, so the conversion splits on
+    import and re-concatenates on export. Identified by the interleaved-rope
+    + swiglu graph under pre/sandwich norms (GLM is its only HF
+    inhabitant; Cohere shares the interleave but uses parallel blocks)."""
+    return (
+        config.rope_interleaved
+        and config.mlp_type == "swiglu"
+        and config.norm_scheme in ("pre", "sandwich")
+    )
+
+
+def _fused_mlp_parts(sd: Mapping, i: int) -> dict:
+    """layers.{i}.mlp.gate_up_proj.weight [2I, H] -> separate kernels."""
+    fused = _to_numpy(sd[f"layers.{i}.mlp.gate_up_proj.weight"])
+    inter = fused.shape[0] // 2
+    return {
+        ("mlp", "gate_proj", "kernel"): fused[:inter].T,
+        ("mlp", "up_proj", "kernel"): fused[inter:].T,
+    }
+
 # OLMo-2 post-norm scheme: no input norms, block outputs normed instead
 _POST_NORM_PARAMS = [
     (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
@@ -127,6 +158,8 @@ def _bias_params(config: LlamaConfig) -> list:
 
 def _layer_params(config: LlamaConfig) -> list:
     matmuls = _LAYER_MATMUL_PARAMS
+    if _uses_fused_gate_up(config):
+        matmuls = [p for p in matmuls if p[0][-2] not in ("gate_proj", "up_proj")]
     if config.num_experts:
         # MoE layers have no dense MLP; expert stacks are converted by
         # _moe_layer_parts / _moe_layer_out
@@ -136,6 +169,7 @@ def _layer_params(config: LlamaConfig) -> list:
     norms = {
         "post": _POST_NORM_PARAMS,
         "parallel": _PARALLEL_NORM_PARAMS,
+        "sandwich": _SANDWICH_NORM_PARAMS,
         "pre": _PRE_NORM_PARAMS,
     }[config.norm_scheme]
     if config.norm_type == "layernorm":
@@ -272,12 +306,22 @@ def params_from_hf(
             for path in moe_layers[0]:
                 put(("layers", "layer") + path,
                     np.stack([layer[path] for layer in moe_layers]))
+        if _uses_fused_gate_up(config):
+            fused_layers = [
+                _fused_mlp_parts(sd, i) for i in range(config.num_hidden_layers)
+            ]
+            for path in fused_layers[0]:
+                put(("layers", "layer") + path,
+                    np.stack([layer[path] for layer in fused_layers]))
     else:
         for i in range(config.num_hidden_layers):
             for path, hf_name, transpose in layer_params:
                 put((f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
             if config.num_experts:
                 for path, value in _moe_layer_parts(sd, config, i).items():
+                    put((f"layers_{i}",) + path, value)
+            if _uses_fused_gate_up(config):
+                for path, value in _fused_mlp_parts(sd, i).items():
                     put((f"layers_{i}",) + path, value)
     return {"params": params}
 
@@ -327,6 +371,17 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
             else:
                 get = lambda path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
             _moe_layer_out(get, config, i, out)
+    if _uses_fused_gate_up(config):
+        for i in range(config.num_hidden_layers):
+            if config.scan_layers:
+                gate = np.asarray(_get_path(p, ("layers", "layer", "mlp", "gate_proj", "kernel")))[i]
+                up = np.asarray(_get_path(p, ("layers", "layer", "mlp", "up_proj", "kernel")))[i]
+            else:
+                gate = np.asarray(_get_path(p, (f"layers_{i}", "mlp", "gate_proj", "kernel")))
+                up = np.asarray(_get_path(p, (f"layers_{i}", "mlp", "up_proj", "kernel")))
+            out[f"model.layers.{i}.mlp.gate_up_proj.weight"] = np.concatenate(
+                [gate.T, up.T], axis=0
+            )
     if _uses_phi_naming(config):
         out = {_canonical_key_to_phi(k): v for k, v in out.items()}
     return out
@@ -376,20 +431,32 @@ def _check_exportable(config: LlamaConfig) -> None:
             "(layernorm_nobias + swiglu) or Phi (layernorm + gelu); this "
             "combination cannot be exported"
         )
-    if config.rope_interleaved and not is_cohere:
+    is_glm = (
+        config.rope_interleaved
+        and config.mlp_type == "swiglu"
+        and config.norm_type == "rmsnorm"
+        and config.norm_scheme in ("pre", "sandwich")
+    )
+    if config.rope_interleaved and not (is_cohere or is_glm):
         raise ValueError(
-            "rope_interleaved only exists in HF on Cohere; a non-Cohere "
-            "export would reload with half-rotation pairing and wrong logits"
+            "rope_interleaved only exists in HF on Cohere and GLM/GLM-4; "
+            "any other export would reload with half-rotation pairing and "
+            "wrong logits"
+        )
+    if config.norm_scheme == "sandwich" and not is_glm:
+        raise ValueError(
+            "sandwich norms only exist in HF as GLM-4 (interleaved rope + "
+            "swiglu + rmsnorm); this combination cannot be exported"
         )
     if config.logit_scale is not None and not is_cohere:
         raise ValueError(
             "logit_scale only exists in HF on Cohere; it would be silently "
             "dropped by any other export"
         )
-    if config.partial_rotary_factor != 1.0 and not is_phi:
+    if config.partial_rotary_factor != 1.0 and not (is_phi or is_glm):
         raise ValueError(
-            "partial_rotary_factor only exists in HF on Phi (parallel + "
-            "layernorm + gelu); it would be silently dropped otherwise"
+            "partial_rotary_factor only exists in HF on Phi and GLM/GLM-4; "
+            "it would be silently dropped otherwise"
         )
     if config.lm_head_bias and not is_phi:
         raise ValueError(
@@ -462,6 +529,23 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
         **(
             {"model_type": "olmo2", "architectures": ["Olmo2ForCausalLM"]}
             if config.norm_scheme == "post"
+            else {}
+        ),
+        # interleaved rope + fused gate_up under pre/sandwich norms only
+        # exist as GLM / GLM-4 in HF (sandwich adds the two output norms)
+        **(
+            {"model_type": "glm4" if config.norm_scheme == "sandwich" else "glm",
+             "architectures": [
+                 "Glm4ForCausalLM" if config.norm_scheme == "sandwich"
+                 else "GlmForCausalLM"
+             ],
+             "partial_rotary_factor": config.partial_rotary_factor,
+             "head_dim": config.resolved_head_dim,
+             # restore the real flag: GLM's q/k/v-but-not-o bias pattern
+             # trips the earlier qwen2 overlay, which nulls attention_bias
+             # (GLM hardcodes no o bias, so the flag is unambiguous here)
+             "attention_bias": config.attention_bias}
+            if config.rope_interleaved and config.norm_scheme in ("pre", "sandwich")
             else {}
         ),
         # parallel blocks + weight-only LayerNorm + interleaved rope +
@@ -665,6 +749,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_out_bias=(
             get("use_bias", True) if model_type == "starcoder2"
             else True if model_type == "phi"
+            # GLM biases q/k/v but never o_proj
+            else False if model_type in ("glm", "glm4")
             else False
             if model_type in ("qwen2", "qwen2_moe") and get("attention_bias") is None
             else (get("attention_bias") or False)
@@ -692,6 +778,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         norm_scheme=(
             "post" if model_type == "olmo2"
             else "parallel" if model_type in ("cohere", "phi")
+            else "sandwich" if model_type == "glm4"
             else "pre"
         ),
         clip_qkv=get("clip_qkv"),
@@ -705,10 +792,12 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         ),
         mlp_type="gelu" if model_type in ("starcoder2", "phi") else "swiglu",
         partial_rotary_factor=(
-            get("partial_rotary_factor", 0.5) if model_type == "phi" else 1.0
+            get("partial_rotary_factor", 0.5)
+            if model_type in ("phi", "glm", "glm4")
+            else 1.0
         ),
         lm_head_bias=(model_type == "phi"),
-        rope_interleaved=(model_type == "cohere"),
+        rope_interleaved=model_type in ("cohere", "glm", "glm4"),
         logit_scale=(
             get("logit_scale", 0.0625) if model_type == "cohere" else None
         ),

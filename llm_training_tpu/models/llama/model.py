@@ -334,6 +334,15 @@ class LlamaDecoderLayer(nn.Module):
             mlp_out, aux = mlp(normed)
             hidden = hidden + join(attn) + join(mlp_out)
             return hidden, aux
+        if cfg.norm_scheme == "sandwich":
+            # GLM-4: pre-norm AND output-norm around both blocks
+            normed = norm("input_layernorm")(hidden)
+            attn = LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+            hidden = hidden + join(norm("post_self_attn_layernorm")(attn))
+            normed = norm("post_attention_layernorm")(hidden)
+            mlp_out, aux = mlp(normed)
+            hidden = hidden + join(norm("post_mlp_layernorm")(mlp_out))
+            return hidden, aux
         if cfg.norm_scheme == "post":
             # OLMo-2 reordering: no input norms; normalize each block's
             # OUTPUT before it joins the residual stream
