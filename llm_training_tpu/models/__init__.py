@@ -12,6 +12,7 @@ families. Here, models are flax.linen Modules whose parameters carry
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
 from llm_training_tpu.models.deepseek import Deepseek, DeepseekConfig
 from llm_training_tpu.models.gemma import Gemma, GemmaConfig
+from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
 from llm_training_tpu.models.phi3 import Phi3, Phi3Config
@@ -23,6 +24,8 @@ __all__ = [
     "DeepseekConfig",
     "Gemma",
     "GemmaConfig",
+    "GptOss",
+    "GptOssConfig",
     "HFCausalLM",
     "HFCausalLMConfig",
     "Llama",

@@ -204,30 +204,22 @@ class DeepseekMoE(nn.Module):
         if impl == "auto":
             impl = "ragged" if jax.default_backend() == "tpu" else "dense"
 
-        xc = x.astype(compute_dtype)
-        if impl == "dense":
+        def dense_fn(xc):
             gate = jnp.einsum("th,ehi->tei", xc, w_gate)
             up = jnp.einsum("th,ehi->tei", xc, w_up)
-            y = jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
-            combine = jnp.zeros((n_tokens, num_experts), compute_dtype)
-            combine = combine.at[
-                jnp.arange(n_tokens)[:, None], topk_idx
-            ].set(topk_weights)
-            out = jnp.einsum("teh,te->th", y, combine)
-        else:
-            flat_expert = topk_idx.reshape(-1)
-            flat_weight = topk_weights.reshape(-1)
-            flat_token = jnp.arange(n_tokens * top_k) // top_k
-            order = jnp.argsort(flat_expert)
-            token_order = flat_token[order]
-            xs = xc[token_order]
-            group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
+            return jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
+
+        def ragged_fn(xs, group_sizes, expert_order):
             gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
             up = jax.lax.ragged_dot(xs, w_up, group_sizes)
-            ys = jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
-            ys = ys * flat_weight[order][:, None]
-            out = jnp.zeros((n_tokens, embed), compute_dtype).at[token_order].add(ys)
+            return jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
 
+        from llm_training_tpu.models.moe import dropless_moe_apply
+
+        out = dropless_moe_apply(
+            x.astype(compute_dtype), topk_idx, topk_weights, num_experts, impl,
+            dense_fn, ragged_fn,
+        )
         out = out.reshape(batch, seq, embed).astype(hidden.dtype)
         shared = DeepseekMLP(
             cfg, cfg.moe_intermediate_size * cfg.n_shared_experts,

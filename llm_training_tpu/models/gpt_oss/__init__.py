@@ -1,0 +1,4 @@
+from llm_training_tpu.models.gpt_oss.config import GptOssConfig
+from llm_training_tpu.models.gpt_oss.model import GptOss
+
+__all__ = ["GptOss", "GptOssConfig"]

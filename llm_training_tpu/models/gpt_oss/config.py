@@ -1,0 +1,96 @@
+"""gpt-oss (OpenAI open-weight MoE) model config.
+
+Family member beyond the reference's named models (the reference reaches
+gpt-oss only through `HFCausalLM`'s torch wrapping,
+`src/llm_training/models/hf_causal_lm/hf_causal_lm.py:22`); here the
+sink-attention + clamped-swiglu-MoE graph is native. Mirrors HF
+`GptOssConfig` (transformers `models/gpt_oss/configuration_gpt_oss.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class GptOssConfig(BaseModelConfig):
+    vocab_size: int = 201088
+    hidden_size: int = 2880
+    intermediate_size: int = 2880  # per-expert width
+    num_hidden_layers: int = 36
+    num_attention_heads: int = 64
+    num_key_value_heads: int = 8
+    head_dim: int = 64
+    max_position_embeddings: int = 131072
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-5
+    pad_token_id: int | None = None
+    bos_token_id: int | None = None
+    eos_token_id: int | list[int] | None = None
+    tie_word_embeddings: bool = False
+    rope_theta: float = 150000.0
+    rope_scaling: dict[str, Any] | None = None
+    attention_bias: bool = True
+    attention_dropout: float = 0.0
+    sliding_window: int | None = 128
+    # per-layer 'sliding_attention' / 'full_attention'; None = the HF
+    # default alternation (sliding on even indices)
+    layer_types: list[str] | None = None
+
+    # --- MoE (every layer is sparse)
+    num_local_experts: int = 128
+    num_experts_per_tok: int = 4
+    router_aux_loss_coef: float = 0.9
+    # 'ragged' = dropless grouped matmul; 'dense' = exact every-expert path
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    # sliding/full alternation makes the layer body non-uniform; looped
+    scan_layers: bool = False
+    # sinks require the einsum attention path (the flash kernel has no sink
+    # support); 'auto' resolves to xla in the attention op when sinks are set
+    attention_impl: Literal["auto", "xla"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "GptOssConfig":
+        if self.attention_dropout != 0.0:
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.scan_layers:
+            raise ValueError("gpt-oss layers are looped; set scan_layers=False")
+        if self.layer_types is not None and len(self.layer_types) != self.num_hidden_layers:
+            raise ValueError(
+                f"layer_types has {len(self.layer_types)} entries for "
+                f"{self.num_hidden_layers} layers"
+            )
+        if self.num_experts_per_tok > self.num_local_experts:
+            raise ValueError("num_experts_per_tok exceeds num_local_experts")
+        if self.tie_word_embeddings:
+            # no gpt-oss checkpoint ties, and the model always builds an
+            # untied lm_head — accepting True would silently train untied
+            raise ValueError("gpt-oss does not tie word embeddings")
+        self.rope_config
+        return self
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.head_dim,
+            self.max_position_embeddings,
+        )
+
+    def layer_sliding_window(self, layer_idx: int) -> int | None:
+        if not self.sliding_window:
+            return None
+        kind = (
+            self.layer_types[layer_idx]
+            if self.layer_types is not None
+            # HF GptOssConfig default: sliding on even indices
+            else ("sliding_attention" if layer_idx % 2 == 0 else "full_attention")
+        )
+        return self.sliding_window if kind == "sliding_attention" else None

@@ -1,0 +1,149 @@
+"""gpt-oss <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to gpt-oss
+(reached by the reference only through torch wrapping, `hf_causal_lm.py:22`).
+The expert tensors are ALREADY stacked [E, in, out] in HF (no transpose, no
+per-expert stacking); only the torch-Linear projections transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.gpt_oss.config import GptOssConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+
+_LAYER_PARAMS = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "q_proj", "bias"), "self_attn.q_proj.bias", False),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "k_proj", "bias"), "self_attn.k_proj.bias", False),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "v_proj", "bias"), "self_attn.v_proj.bias", False),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+    (("self_attn", "o_proj", "bias"), "self_attn.o_proj.bias", False),
+    (("self_attn", "sinks"), "self_attn.sinks", False),
+    (("mlp", "router", "kernel"), "mlp.router.weight", True),
+    (("mlp", "router", "bias"), "mlp.router.bias", False),
+    # expert stacks: HF already stores [E, in, out] / [E, out]
+    (("mlp", "experts_gate_up_proj"), "mlp.experts.gate_up_proj", False),
+    (("mlp", "experts_gate_up_proj_bias"), "mlp.experts.gate_up_proj_bias", False),
+    (("mlp", "experts_down_proj"), "mlp.experts.down_proj", False),
+    (("mlp", "experts_down_proj_bias"), "mlp.experts.down_proj_bias", False),
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: GptOssConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path: tuple[str, ...], value: np.ndarray) -> None:
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _LAYER_PARAMS:
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            put((f"layers_{i}",) + path, value.T if transpose else value)
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: GptOssConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _LAYER_PARAMS:
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    return out
+
+
+def config_to_hf(config: GptOssConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["GptOssForCausalLM"],
+        "model_type": "gpt_oss",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.head_dim,
+        "num_local_experts": config.num_local_experts,
+        "num_experts_per_tok": config.num_experts_per_tok,
+        "router_aux_loss_coef": config.router_aux_loss_coef,
+        "output_router_logits": False,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": config.attention_dropout,
+        "sliding_window": config.sliding_window,
+        "layer_types": (
+            config.layer_types
+            or [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(config.num_hidden_layers)
+            ]
+        ),
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> GptOssConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return GptOssConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim", 64),
+        max_position_embeddings=get("max_position_embeddings", 131072),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id"),
+        eos_token_id=get("eos_token_id"),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 150000.0),
+        rope_scaling=get("rope_scaling"),
+        attention_bias=get("attention_bias", True),
+        attention_dropout=get("attention_dropout", 0.0),
+        sliding_window=get("sliding_window", 128),
+        layer_types=list(get("layer_types") or []) or None,
+        num_local_experts=get("num_local_experts", 128),
+        num_experts_per_tok=get("num_experts_per_tok", 4),
+        router_aux_loss_coef=get("router_aux_loss_coef", 0.9),
+    ), **overrides})

@@ -1,0 +1,284 @@
+"""gpt-oss decoder, TPU-native.
+
+Graph verified against HF `modeling_gpt_oss.py`:
+
+- attention: GQA with biases on q/k/v/o, per-head learned SINK logits that
+  join every softmax denominator with zero value (ops.dot_product_attention
+  `sinks` — einsum path), sliding window on alternating layers
+  (config.layer_types), yarn rope with truncate=False.
+- MoE on EVERY layer: router = biased linear, top-k, softmax over the
+  top-k logits only; experts hold fused gate_up tensors whose gate/up
+  COLUMNS INTERLEAVE ([..., ::2] / [..., 1::2]) plus per-expert biases;
+  activation clamps gate at +limit and up at ±limit, then
+  (up + 1) * gate * sigmoid(alpha * gate) with alpha=1.702, limit=7.0
+  (HF hardcodes both). Dropless ragged_dot path for training, exact dense
+  path for parity.
+- aux loss: per-layer (sel_frac, mean_prob) stats pooled across depth, the
+  same HF `load_balancing_loss_func` scale the other MoE families use; the
+  CLM objective applies config.router_aux_loss_coef.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.gpt_oss.config import GptOssConfig
+from llm_training_tpu.models.llama.model import RMSNorm, _dense
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+_ALPHA = 1.702
+_LIMIT = 7.0
+
+
+class GptOssAttention(nn.Module):
+    config: GptOssConfig
+    sliding_window: int | None
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        q = _dense(cfg, cfg.num_attention_heads * cfg.head_dim, ("embed", "heads"),
+                   "q_proj", cfg.attention_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * cfg.head_dim, ("embed", "kv_heads"),
+                   "k_proj", cfg.attention_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * cfg.head_dim, ("embed", "kv_heads"),
+                   "v_proj", cfg.attention_bias)(hidden)
+        q = q.reshape(batch, seq, cfg.num_attention_heads, cfg.head_dim)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, cfg.head_dim)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, cfg.head_dim)
+        q, k = apply_rope(q, k, cos, sin)
+
+        sinks = self.param(
+            "sinks",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("heads",)
+            ),
+            (cfg.num_attention_heads,),
+            cfg.param_jnp_dtype,
+        )
+        out = dot_product_attention(
+            q, k, v,
+            segment_ids=segment_ids,
+            causal=True,
+            sliding_window=self.sliding_window,
+            sinks=sinks.astype(jnp.float32),
+            impl="xla" if cfg.attention_impl == "auto" else cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(
+            batch, seq, cfg.num_attention_heads * cfg.head_dim
+        )
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      cfg.attention_bias)(out)
+
+
+def _expert_act(gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    gate = jnp.clip(gate, max=_LIMIT)
+    up = jnp.clip(up, -_LIMIT, _LIMIT)
+    return (up + 1.0) * (gate * jax.nn.sigmoid(_ALPHA * gate))
+
+
+class GptOssMoE(nn.Module):
+    """Router + fused clamped-swiglu experts with per-expert biases."""
+
+    config: GptOssConfig
+
+    @nn.compact
+    def __call__(self, hidden, pad_mask=None):
+        cfg = self.config
+        num_experts = cfg.num_local_experts
+        top_k = cfg.num_experts_per_tok
+        inter = cfg.intermediate_size
+        compute_dtype = cfg.compute_jnp_dtype
+        param_dtype = cfg.param_jnp_dtype
+        batch, seq, embed = hidden.shape
+        x = hidden.reshape(-1, embed)
+        n_tokens = x.shape[0]
+
+        router = nn.Dense(
+            num_experts,
+            use_bias=True,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+            kernel_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("embed", "expert")
+            ),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("expert",)
+            ),
+            name="router",
+        )
+        logits = router(x).astype(jnp.float32)  # [T, E]
+        topk_logits, topk_idx = jax.lax.top_k(logits, top_k)
+        # HF softmaxes ONLY the k selected logits against each other
+        topk_weights = jax.nn.softmax(topk_logits, axis=-1).astype(compute_dtype)
+
+        def expert_param(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(cfg.initializer_range), axes
+                ),
+                shape,
+                param_dtype,
+            ).astype(compute_dtype)
+
+        # HF stores [E, H, 2I] with gate/up columns interleaved, plus biases
+        w_gate_up = expert_param(
+            "experts_gate_up_proj", (num_experts, embed, 2 * inter),
+            ("expert", "embed", "mlp"),
+        )
+        b_gate_up = expert_param(
+            "experts_gate_up_proj_bias", (num_experts, 2 * inter), ("expert", "mlp")
+        )
+        w_down = expert_param(
+            "experts_down_proj", (num_experts, inter, embed), ("expert", "mlp", "embed")
+        )
+        b_down = expert_param(
+            "experts_down_proj_bias", (num_experts, embed), ("expert", "embed")
+        )
+
+        impl = cfg.moe_impl
+        if impl == "auto":
+            impl = "ragged" if jax.default_backend() == "tpu" else "dense"
+
+        def dense_fn(xc):
+            fused = jnp.einsum("th,ehi->tei", xc, w_gate_up) + b_gate_up[None]
+            return jnp.einsum(
+                "tei,eih->teh", _expert_act(fused[..., ::2], fused[..., 1::2]), w_down
+            ) + b_down[None]
+
+        def ragged_fn(xs, group_sizes, expert_order):
+            fused = jax.lax.ragged_dot(xs, w_gate_up, group_sizes)
+            fused = fused + b_gate_up[expert_order]
+            ys = jax.lax.ragged_dot(
+                _expert_act(fused[..., ::2], fused[..., 1::2]), w_down, group_sizes
+            )
+            return ys + b_down[expert_order]
+
+        from llm_training_tpu.models.moe import dropless_moe_apply
+
+        out = dropless_moe_apply(
+            x.astype(compute_dtype), topk_idx, topk_weights, num_experts, impl,
+            dense_fn, ragged_fn,
+        )
+
+        # router statistics for the aux loss (HF load_balancing_loss_func
+        # scale: each of the K selections counts; balanced value = top_k),
+        # excluding padding tokens like the other MoE families
+        if pad_mask is None:
+            valid = jnp.ones((n_tokens,), jnp.float32)
+        else:
+            valid = pad_mask.reshape(-1).astype(jnp.float32)
+        n_valid = jnp.maximum(valid.sum(), 1.0)
+        sel_frac = (
+            jnp.zeros((num_experts,), jnp.float32)
+            .at[topk_idx.reshape(-1)]
+            .add(jnp.repeat(valid, top_k))
+            / n_valid
+        )
+        mean_prob = (
+            jax.nn.softmax(logits, axis=-1) * valid[:, None]
+        ).sum(axis=0) / n_valid
+        return out.reshape(batch, seq, embed).astype(hidden.dtype), (sel_frac, mean_prob)
+
+
+class GptOssDecoderLayer(nn.Module):
+    config: GptOssConfig
+    sliding_window: int | None
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        normed = norm("input_layernorm")(hidden)
+        hidden = hidden + GptOssAttention(cfg, self.sliding_window, name="self_attn")(
+            normed, segment_ids, cos, sin
+        )
+        normed = norm("post_attention_layernorm")(hidden)
+        pad_mask = None if segment_ids is None else segment_ids > 0
+        mlp_out, stats = GptOssMoE(cfg, name="mlp")(normed, pad_mask)
+        return hidden + mlp_out, stats
+
+
+class GptOss(nn.Module):
+    """gpt-oss causal LM with the `CausalLMProto` surface."""
+
+    config: GptOssConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        policy = _remat_policy(cfg)
+        stats = []
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = GptOssDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(GptOssDecoderLayer, policy=policy)
+            hidden, layer_stats = layer_cls(
+                cfg, cfg.layer_sliding_window(i), name=f"layers_{i}"
+            )(hidden, segment_ids, cos, sin)
+            stats.append(layer_stats)
+
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+        aux_loss = cfg.num_local_experts * jnp.sum(
+            sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
+        )
+
+        logits = None
+        if compute_logits:
+            logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+            aux_loss=aux_loss,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        return "lm_head/kernel"

@@ -34,6 +34,7 @@ _FAMILIES: dict[str, str] = {
     "Phi3Config": "llm_training_tpu.models.phi3.hf_conversion",
     "GemmaConfig": "llm_training_tpu.models.gemma.hf_conversion",
     "DeepseekConfig": "llm_training_tpu.models.deepseek.hf_conversion",
+    "GptOssConfig": "llm_training_tpu.models.gpt_oss.hf_conversion",
 }
 
 
@@ -237,6 +238,7 @@ _ARCH_TO_FAMILY = {
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
+    "gpt_oss": "llm_training_tpu.models.GptOss",  # sink attention + clamped-swiglu MoE
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

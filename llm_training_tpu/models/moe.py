@@ -32,6 +32,42 @@ import jax
 import jax.numpy as jnp
 
 
+def dropless_moe_apply(
+    x: jnp.ndarray,
+    topk_idx: jnp.ndarray,
+    topk_weights: jnp.ndarray,
+    num_experts: int,
+    impl: str,
+    dense_fn,
+    ragged_fn,
+) -> jnp.ndarray:
+    """Shared dropless dispatch/combine for every MoE family.
+
+    x: [T, H] compute-dtype tokens; topk_idx/topk_weights: [T, K].
+    dense_fn(x) -> [T, E, H] (every expert on every token — exact path);
+    ragged_fn(xs, group_sizes, expert_order) -> [T*K, H] where xs are the
+    (token, slot) rows sorted by expert and expert_order the matching
+    expert id per row (for per-expert bias lookups).
+    """
+    n_tokens, top_k = topk_idx.shape
+    if impl == "dense":
+        y = dense_fn(x)
+        combine = jnp.zeros((n_tokens, num_experts), x.dtype)
+        combine = combine.at[
+            jnp.arange(n_tokens)[:, None], topk_idx
+        ].set(topk_weights)
+        return jnp.einsum("teh,te->th", y, combine)
+    flat_expert = topk_idx.reshape(-1)
+    flat_weight = topk_weights.reshape(-1)
+    flat_token = jnp.arange(n_tokens * top_k) // top_k
+    order = jnp.argsort(flat_expert)  # stable
+    token_order = flat_token[order]
+    group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
+    ys = ragged_fn(x[token_order], group_sizes, flat_expert[order])
+    ys = ys * flat_weight[order][:, None]
+    return jnp.zeros((n_tokens, x.shape[-1]), x.dtype).at[token_order].add(ys)
+
+
 class MoEMLP(nn.Module):
     """Sparse MoE block with the (config-driven) surface of LlamaMLP.
 
@@ -106,33 +142,21 @@ class MoEMLP(nn.Module):
         if impl == "auto":
             impl = "ragged" if jax.default_backend() == "tpu" else "dense"
 
-        xc = x.astype(compute_dtype)
-        if impl == "dense":
-            # every expert on every token; combine with scattered weights
+        def dense_fn(xc):
             gate = jnp.einsum("th,ehi->tei", xc, w_gate)
             up = jnp.einsum("th,ehi->tei", xc, w_up)
-            y = jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
-            combine = jnp.zeros((n_tokens, num_experts), compute_dtype)
-            combine = combine.at[
-                jnp.arange(n_tokens)[:, None], topk_idx
-            ].set(topk_probs)
-            out = jnp.einsum("teh,te->th", y, combine)
-        else:
-            # dropless grouped matmul over sorted (token, slot) assignments
-            flat_expert = topk_idx.reshape(-1)  # [T*K]
-            flat_weight = topk_probs.reshape(-1)
-            flat_token = jnp.arange(n_tokens * top_k) // top_k
-            order = jnp.argsort(flat_expert)  # stable
-            token_order = flat_token[order]
-            xs = xc[token_order]  # [T*K, H] sorted by expert
-            group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(
-                jnp.int32
-            )
+            return jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
+
+        def ragged_fn(xs, group_sizes, expert_order):
             gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
             up = jax.lax.ragged_dot(xs, w_up, group_sizes)
-            ys = jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
-            ys = ys * flat_weight[order][:, None]
-            out = jnp.zeros((n_tokens, embed), compute_dtype).at[token_order].add(ys)
+            return jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
+
+        out = dropless_moe_apply(
+            x.astype(compute_dtype), topk_idx, topk_probs, num_experts, impl,
+            dense_fn, ragged_fn,
+        )
+        xc = x.astype(compute_dtype)
 
         # ---- shared expert (Qwen2-MoE): dense SwiGLU + per-token sigmoid gate
         if cfg.shared_expert_intermediate_size:

@@ -68,6 +68,7 @@ def _xla_attention(
     mask: jnp.ndarray | None,
     scale: float,
     logits_soft_cap: float | None,
+    sinks: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Reference einsum attention, fp32 softmax, GQA without repeating kv.
 
@@ -89,7 +90,19 @@ def _xla_attention(
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
     if mask is not None:
         scores = jnp.where(mask[:, :, None], scores, _MASK_VALUE)
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        # gpt-oss attention sinks: one learned logit per query head joins
+        # each row's softmax denominator (with zero value), damping rows
+        # whose real scores are all weak
+        sink = sinks.reshape(num_kv_heads, group)[None, :, :, None]
+        m = jnp.maximum(scores.max(axis=-1), sink)
+        p = jnp.exp(scores - m[..., None])
+        if mask is not None:
+            p = jnp.where(mask[:, :, None], p, 0.0)
+        denom = p.sum(axis=-1) + jnp.exp(sink - m)
+        probs = p / denom[..., None]
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     if mask is not None:
         # fully-masked rows (padding / empty ring chunks) emit exactly 0, not
         # the mean of v that a softmax over all-masked scores would give —
@@ -116,6 +129,7 @@ def dot_product_attention(
     scale: float | None = None,
     q_offset: int = 0,
     impl: str = "auto",
+    sinks: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Multi-head attention over packed sequences.
 
@@ -129,6 +143,9 @@ def dot_product_attention(
         causal masking of cross-length chunks.
     impl: 'auto' (pallas flash kernel on TPU, einsum path elsewhere) |
         'xla' | 'pallas' (forced; interpreted off-TPU).
+    sinks: [num_q_heads] learned per-head sink logits (gpt-oss); joins each
+        softmax denominator with zero value. XLA path only — 'auto' falls
+        back to the einsum path when set.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -140,7 +157,11 @@ def dot_product_attention(
             )
         q_segment_ids = segment_ids
 
-    use_pallas = impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu")
+    if sinks is not None and impl == "pallas":
+        raise NotImplementedError("attention sinks require the xla impl")
+    use_pallas = sinks is None and (
+        impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu")
+    )
     if use_pallas:
         from llm_training_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -161,4 +182,4 @@ def dot_product_attention(
             q_segment_ids, segment_ids, q.shape[1], k.shape[1],
             causal=causal, sliding_window=sliding_window, q_offset=q_offset,
         )
-    return _xla_attention(q, k, v, mask, scale, logits_soft_cap)
+    return _xla_attention(q, k, v, mask, scale, logits_soft_cap, sinks=sinks)

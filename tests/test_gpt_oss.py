@@ -1,0 +1,169 @@
+"""gpt-oss: sink attention, clamped-swiglu MoE, HF parity + round trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
+from llm_training_tpu.models.gpt_oss.hf_conversion import (
+    config_from_hf,
+    config_to_hf,
+    params_from_hf,
+    params_to_hf,
+)
+
+TINY = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=48,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    max_position_embeddings=64,
+    sliding_window=8,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+    compute_dtype="float32",
+)
+
+
+def _hf_tiny(**extra):
+    torch = pytest.importorskip("torch")
+    from transformers import GptOssConfig as HFConfig
+    from transformers import GptOssForCausalLM
+
+    kwargs = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, sliding_window=8,
+        num_local_experts=4, num_experts_per_tok=2,
+        attn_implementation="eager",
+    )
+    kwargs.update(extra)
+    hf_config = HFConfig(**kwargs)
+    torch.manual_seed(0)
+    return GptOssForCausalLM(hf_config).eval(), hf_config
+
+
+def test_logits_parity_with_hf():
+    """Sink softmax + alternating sliding window + interleaved fused
+    gate_up experts with clamped activation, against HF eager."""
+    torch = pytest.importorskip("torch")
+    hf_model, hf_config = _hf_tiny()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.sinks" in sd
+    assert sd["model.layers.0.mlp.experts.gate_up_proj"].shape == (4, 64, 96)
+    assert hf_config.layer_types == ["sliding_attention", "full_attention"]
+    # non-trivial sinks so the denominator term actually matters
+    with torch.no_grad():
+        for i in range(2):
+            sd[f"model.layers.{i}.self_attn.sinks"].copy_(
+                torch.linspace(-1.0, 2.0, 4)
+            )
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32", moe_impl="dense")
+    assert cfg.layer_sliding_window(0) == 8 and cfg.layer_sliding_window(1) is None
+    params = params_from_hf(sd, cfg)
+    model = GptOss(cfg)
+
+    # 24 > sliding_window so the sliding layer actually truncates
+    ids = np.random.default_rng(50).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_hf_round_trip():
+    hf_model, hf_config = _hf_tiny()
+    cfg = config_from_hf(hf_config)
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    back = params_to_hf(params, cfg)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    assert set(back) == set(sd)
+    for key in sd:
+        np.testing.assert_array_equal(back[key], sd[key], err_msg=key)
+
+
+def test_config_round_trip():
+    cfg = GptOssConfig(**TINY)
+    hf = config_to_hf(cfg)
+    assert hf["model_type"] == "gpt_oss"
+    cfg2 = config_from_hf(hf, compute_dtype="float32")
+    # the export materializes the implicit even-index alternation
+    assert cfg2.layer_types == ["sliding_attention", "full_attention"]
+    a, b = cfg.model_dump(), cfg2.model_dump()
+    a.pop("layer_types"), b.pop("layer_types")
+    assert a == b
+    assert [cfg2.layer_sliding_window(i) for i in range(2)] == [
+        cfg.layer_sliding_window(i) for i in range(2)
+    ]
+
+
+@pytest.mark.slow
+def test_ragged_and_dense_impls_agree():
+    cfg_d = GptOssConfig(**TINY, moe_impl="dense")
+    cfg_r = GptOssConfig(**TINY, moe_impl="ragged")
+    model_d, model_r = GptOss(cfg_d), GptOss(cfg_r)
+    ids = jnp.asarray(np.random.default_rng(51).integers(0, 128, (2, 16)))
+    params = model_d.init(jax.random.key(10), ids)
+    out_d = model_d.apply(params, ids).logits
+    out_r = model_r.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(out_d), np.asarray(out_r), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_e2e_fit_decreases_loss():
+    from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.optim import OptimConfig
+    from llm_training_tpu.parallel import MeshConfig
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+    objective = CLM(CLMConfig(
+        model=ModelProvider(
+            model_class="llm_training_tpu.models.GptOss",
+            model_kwargs=dict(TINY, enable_gradient_checkpointing=True,
+                              router_aux_loss_coef=0.01),
+        ),
+        optim=OptimConfig(learning_rate=3e-3, warmup_steps=2),
+    ))
+    data = DummyDataModule(DummyDataModuleConfig(
+        batch_size=8, max_length=32, num_samples=64, vocab_size=128,
+    ))
+    losses = []
+
+    class Track:
+        def on_step_end(self, trainer, step, metrics):
+            losses.append(float(metrics["loss"]))
+
+    Trainer(
+        TrainerConfig(max_steps=20, log_every_n_steps=1, mesh=MeshConfig()),
+        callbacks=[Track()],
+    ).fit(objective, data)
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+@pytest.mark.slow
+def test_export_reloads_in_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = GptOssConfig(**TINY)
+    model = GptOss(cfg)
+    ids = jnp.asarray(np.random.default_rng(52).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(11), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "GptOssForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4)
