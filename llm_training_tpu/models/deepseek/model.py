@@ -200,10 +200,6 @@ class DeepseekMoE(nn.Module):
             "experts_down_proj", (num_experts, inter, embed), ("expert", "mlp", "embed")
         )
 
-        impl = cfg.moe_impl
-        if impl == "auto":
-            impl = "ragged" if jax.default_backend() == "tpu" else "dense"
-
         def dense_fn(xc):
             gate = jnp.einsum("th,ehi->tei", xc, w_gate)
             up = jnp.einsum("th,ehi->tei", xc, w_up)
@@ -217,8 +213,8 @@ class DeepseekMoE(nn.Module):
         from llm_training_tpu.models.moe import dropless_moe_apply
 
         out = dropless_moe_apply(
-            x.astype(compute_dtype), topk_idx, topk_weights, num_experts, impl,
-            dense_fn, ragged_fn,
+            x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
+            cfg.moe_impl, dense_fn, ragged_fn,
         )
         out = out.reshape(batch, seq, embed).astype(hidden.dtype)
         shared = DeepseekMLP(

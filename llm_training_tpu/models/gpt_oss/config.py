@@ -51,9 +51,7 @@ class GptOssConfig(BaseModelConfig):
     recompute_granularity: Literal["full", "selective"] = "full"
     # sliding/full alternation makes the layer body non-uniform; looped
     scan_layers: bool = False
-    # sinks require the einsum attention path (the flash kernel has no sink
-    # support); 'auto' resolves to xla in the attention op when sinks are set
-    attention_impl: Literal["auto", "xla"] = "auto"
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "GptOssConfig":

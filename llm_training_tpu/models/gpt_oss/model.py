@@ -68,7 +68,7 @@ class GptOssAttention(nn.Module):
             causal=True,
             sliding_window=self.sliding_window,
             sinks=sinks.astype(jnp.float32),
-            impl="xla" if cfg.attention_impl == "auto" else cfg.attention_impl,
+            impl=cfg.attention_impl,
         )
         out = out.astype(hidden.dtype).reshape(
             batch, seq, cfg.num_attention_heads * cfg.head_dim
@@ -143,10 +143,6 @@ class GptOssMoE(nn.Module):
             "experts_down_proj_bias", (num_experts, embed), ("expert", "embed")
         )
 
-        impl = cfg.moe_impl
-        if impl == "auto":
-            impl = "ragged" if jax.default_backend() == "tpu" else "dense"
-
         def dense_fn(xc):
             fused = jnp.einsum("th,ehi->tei", xc, w_gate_up) + b_gate_up[None]
             return jnp.einsum(
@@ -164,8 +160,8 @@ class GptOssMoE(nn.Module):
         from llm_training_tpu.models.moe import dropless_moe_apply
 
         out = dropless_moe_apply(
-            x.astype(compute_dtype), topk_idx, topk_weights, num_experts, impl,
-            dense_fn, ragged_fn,
+            x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
+            cfg.moe_impl, dense_fn, ragged_fn,
         )
 
         # router statistics for the aux loss (HF load_balancing_loss_func

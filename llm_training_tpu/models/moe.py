@@ -50,6 +50,8 @@ def dropless_moe_apply(
     expert id per row (for per-expert bias lookups).
     """
     n_tokens, top_k = topk_idx.shape
+    if impl == "auto":
+        impl = "ragged" if jax.default_backend() == "tpu" else "dense"
     if impl == "dense":
         y = dense_fn(x)
         combine = jnp.zeros((n_tokens, num_experts), x.dtype)
@@ -138,10 +140,6 @@ class MoEMLP(nn.Module):
             "experts_down_proj", (num_experts, inter, embed), ("expert", "mlp", "embed")
         )
 
-        impl = cfg.moe_impl
-        if impl == "auto":
-            impl = "ragged" if jax.default_backend() == "tpu" else "dense"
-
         def dense_fn(xc):
             gate = jnp.einsum("th,ehi->tei", xc, w_gate)
             up = jnp.einsum("th,ehi->tei", xc, w_up)
@@ -153,13 +151,13 @@ class MoEMLP(nn.Module):
             return jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
 
         out = dropless_moe_apply(
-            x.astype(compute_dtype), topk_idx, topk_probs, num_experts, impl,
-            dense_fn, ragged_fn,
+            x.astype(compute_dtype), topk_idx, topk_probs, num_experts,
+            cfg.moe_impl, dense_fn, ragged_fn,
         )
-        xc = x.astype(compute_dtype)
 
         # ---- shared expert (Qwen2-MoE): dense SwiGLU + per-token sigmoid gate
         if cfg.shared_expert_intermediate_size:
+            xc = x.astype(compute_dtype)
             si = cfg.shared_expert_intermediate_size
             sw_gate = expert_param("shared_gate_proj", (embed, si), ("embed", "mlp"))
             sw_up = expert_param("shared_up_proj", (embed, si), ("embed", "mlp"))

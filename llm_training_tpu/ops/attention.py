@@ -144,8 +144,8 @@ def dot_product_attention(
     impl: 'auto' (pallas flash kernel on TPU, einsum path elsewhere) |
         'xla' | 'pallas' (forced; interpreted off-TPU).
     sinks: [num_q_heads] learned per-head sink logits (gpt-oss); joins each
-        softmax denominator with zero value. XLA path only — 'auto' falls
-        back to the einsum path when set.
+        softmax denominator with zero value (both impls — the flash kernel
+        seeds its online-softmax denominator with the sink mass).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -157,11 +157,7 @@ def dot_product_attention(
             )
         q_segment_ids = segment_ids
 
-    if sinks is not None and impl == "pallas":
-        raise NotImplementedError("attention sinks require the xla impl")
-    use_pallas = sinks is None and (
-        impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu")
-    )
+    use_pallas = impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu")
     if use_pallas:
         from llm_training_tpu.ops.pallas.flash_attention import flash_attention
 
@@ -174,6 +170,7 @@ def dot_product_attention(
             logits_soft_cap=logits_soft_cap,
             scale=scale,
             q_offset=q_offset,
+            sinks=sinks,
         )
 
     mask = None

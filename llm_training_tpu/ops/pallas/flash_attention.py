@@ -201,12 +201,7 @@ def _fwd_kernel(
     q_ref,
     k_ref,
     v_ref,
-    o_ref,
-    lse_ref,
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *rest,
     scale: float,
     causal: bool,
     sliding_window: int | None,
@@ -214,15 +209,32 @@ def _fwd_kernel(
     q_offset: int,
     block_q: int,
     block_k: int,
+    num_q_heads: int = 0,  # only used when sinks are present
+    has_sinks: bool = False,
 ):
+    if has_sinks:
+        sinks_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        sinks_ref = None
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
-
     @pl.when(j == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _MASK_VALUE)
-        l_scr[:] = jnp.zeros_like(l_scr)
+        if sinks_ref is None:
+            m_scr[:] = jnp.full_like(m_scr, _MASK_VALUE)
+            l_scr[:] = jnp.zeros_like(l_scr)
+        else:
+            # gpt-oss attention sink: the softmax denominator starts life
+            # holding exp(sink - sink) == 1 at running max == sink; the
+            # standard online-softmax rescaling keeps it exact from there.
+            # The sink contributes no value, so acc stays zero-initialized.
+            # (This program's head is selected by the sink BlockSpec index
+            # map — a dynamic lane index would not lower on Mosaic.)
+            sink = sinks_ref[0, 0, 0]
+            m_scr[:] = jnp.full_like(m_scr, sink)
+            l_scr[:] = jnp.ones_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def _visit(with_pos_mask: bool, with_seg_mask: bool):
@@ -444,12 +456,15 @@ def flash_fwd_flat(
     block_q: int = _DEFAULT_BLOCK_Q,
     block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool = False,
+    sinks: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Forward kernel over flat padded inputs: q [B*Hq, Sq, D], k/v
     [B*Hkv, Skv, D], seg_q [B, Sq], seg_kv [B, Skv]. Returns
-    (o [B*Hq, Sq, D], lse [B*Hq, Sq] fp32). Building block for both the
-    public wrapper and ring attention (which re-runs the backward with the
-    globally-combined lse)."""
+    (o [B*Hq, Sq, D], lse [B*Hq, Sq] fp32). `sinks` [num_q_heads] fp32
+    seeds each row's softmax denominator (gpt-oss; lse then includes the
+    sink mass). Building block for both the public wrapper and ring
+    attention (which re-runs the backward with the globally-combined
+    lse)."""
     bh, sq, d = q.shape
     skv = k.shape[1]
     _check_block_divisibility(sq, skv, block_q, block_k)
@@ -458,19 +473,32 @@ def flash_fwd_flat(
         scale=scale, causal=causal, sliding_window=sliding_window,
         logits_soft_cap=logits_soft_cap, q_offset=q_offset,
         block_q=block_q, block_k=block_k,
+        num_q_heads=num_q_heads, has_sinks=sinks is not None,
     )
     kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
+        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+    ]
+    inputs = [seg_q[:, None], seg_kv[:, None], q, k, v]
+    if sinks is not None:
+        # one lane-width row per head; the index map picks this program's
+        # head so the kernel reads a STATIC [0, 0, 0] scalar
+        in_specs.append(
+            pl.BlockSpec((1, 1, _LANES), lambda b, i, j: (b % num_q_heads, 0, 0))
+        )
+        inputs.append(jnp.broadcast_to(
+            sinks.astype(jnp.float32)[:, None, None], (num_q_heads, 1, _LANES)
+        ))
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, **hyper),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -488,7 +516,7 @@ def flash_fwd_flat(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(seg_q[:, None], seg_kv[:, None], q, k, v)
+    )(*inputs)
     # remat tags: under `recompute_granularity='selective'` the model policy
     # saves exactly these two (save_only_these_names), so the backward pass
     # reads O/LSE instead of re-running this kernel — attention is the one
@@ -626,19 +654,36 @@ def _make_attention(
     )
 
     @jax.custom_vjp
-    def attention(q, k, v, seg_q, seg_kv):
-        o, _ = flash_fwd_flat(q, k, v, seg_q, seg_kv, **hyper)
+    def attention(q, k, v, seg_q, seg_kv, sinks):
+        o, _ = flash_fwd_flat(q, k, v, seg_q, seg_kv, sinks=sinks, **hyper)
         return o
 
-    def attention_fwd(q, k, v, seg_q, seg_kv):
-        o, lse = flash_fwd_flat(q, k, v, seg_q, seg_kv, **hyper)
-        return o, (q, k, v, seg_q, seg_kv, o, lse)
+    def attention_fwd(q, k, v, seg_q, seg_kv, sinks):
+        o, lse = flash_fwd_flat(q, k, v, seg_q, seg_kv, sinks=sinks, **hyper)
+        return o, (q, k, v, seg_q, seg_kv, sinks, o, lse)
 
     def attention_bwd(res, do):
-        q, k, v, seg_q, seg_kv, o, lse = res
+        q, k, v, seg_q, seg_kv, sinks, o, lse = res
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+        # the dQ/dK/dV kernels are sink-agnostic: with the sink mass folded
+        # into lse, p = exp(s - lse) already sums to < 1 per row and
+        # delta == sum_k p_k dP_k still holds (the sink's value is zero)
         dq, dk, dv = flash_bwd_flat(q, k, v, seg_q, seg_kv, do, lse, delta, **hyper)
-        return dq, dk, dv, None, None
+        if sinks is None:
+            d_sinks = None
+        else:
+            # d/ds of the sink-softmax: -p_sink * delta per row, summed per
+            # head; p_sink = exp(sink - lse)
+            bh = lse.shape[0]
+            num_q_heads = hyper["num_q_heads"]
+            sinks_bh = jnp.tile(sinks.astype(jnp.float32), bh // num_q_heads)
+            ds_rows = -jnp.exp(sinks_bh[:, None] - lse) * delta  # [B*H, S]
+            d_sinks = (
+                ds_rows.reshape(-1, num_q_heads, lse.shape[-1])
+                .sum(axis=(0, 2))
+                .astype(sinks.dtype)
+            )
+        return dq, dk, dv, None, None, d_sinks
 
     attention.defvjp(attention_fwd, attention_bwd)
     return attention
@@ -658,6 +703,7 @@ def flash_attention(
     block_q: int = _DEFAULT_BLOCK_Q,
     block_k: int = _DEFAULT_BLOCK_K,
     interpret: bool | None = None,
+    sinks: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Flash attention over packed sequences.
 
@@ -734,7 +780,7 @@ def flash_attention(
         block_k=block_k,
         interpret=interpret,
     )
-    of = attention(qf, kf, vf, q_segment_ids, segment_ids)
+    of = attention(qf, kf, vf, q_segment_ids, segment_ids, sinks)
 
     o = of.reshape(batch, num_q_heads, q_len + sq_pad, -1).transpose(0, 2, 1, 3)
     return o[:, :q_len, :, :head_dim].astype(orig_dtype)

@@ -175,3 +175,44 @@ def test_bf16_inputs():
     np.testing.assert_allclose(
         got.astype(np.float32), expected.astype(np.float32), rtol=5e-2, atol=5e-2
     )
+
+
+@pytest.mark.parametrize("sliding_window", [None, 8])
+def test_sinks_match_xla(sliding_window):
+    """gpt-oss sink softmax in the kernel (denominator seeded with the sink
+    mass) must match the einsum reference on outputs AND on every gradient
+    including d_sinks."""
+    from llm_training_tpu.ops.attention import dot_product_attention
+
+    rng = np.random.default_rng(60)
+    b, s, hq, hkv, d = 2, 32, 4, 2, 64
+    q = jnp.asarray(rng.standard_normal((b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, hkv, d)), jnp.float32)
+    sinks = jnp.asarray(rng.standard_normal(hq), jnp.float32)
+    seg = jnp.asarray(
+        np.concatenate([np.ones((b, s - 6)), np.full((b, 4), 2), np.zeros((b, 2))], 1),
+        jnp.int32,
+    )
+
+    def loss(fn_impl):
+        def f(q, k, v, sinks):
+            out = dot_product_attention(
+                q, k, v, segment_ids=seg, causal=True,
+                sliding_window=sliding_window, sinks=sinks, impl=fn_impl,
+            )
+            return (out * jnp.arange(d)).sum(), out
+
+        return jax.value_and_grad(lambda *a: f(*a)[0], argnums=(0, 1, 2, 3)), f
+
+    (gx, fx), (gp, fp) = loss("xla"), loss("pallas")
+    out_x, out_p = fx(q, k, v, sinks)[1], fp(q, k, v, sinks)[1]
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), rtol=2e-5, atol=2e-5)
+
+    (_, grads_x), (_, grads_p) = gx(q, k, v, sinks), gp(q, k, v, sinks)
+    for name, a, b_ in zip(("dq", "dk", "dv", "d_sinks"), grads_x, grads_p):
+        # d_sinks sums hundreds-magnitude row contributions that can cancel
+        # to near zero — tolerate the accumulation-order noise
+        np.testing.assert_allclose(
+            np.asarray(b_), np.asarray(a), rtol=1e-4, atol=1e-3, err_msg=name
+        )
