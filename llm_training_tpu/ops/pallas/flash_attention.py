@@ -209,7 +209,6 @@ def _fwd_kernel(
     q_offset: int,
     block_q: int,
     block_k: int,
-    num_q_heads: int = 0,  # only used when sinks are present
     has_sinks: bool = False,
 ):
     if has_sinks:
@@ -473,7 +472,7 @@ def flash_fwd_flat(
         scale=scale, causal=causal, sliding_window=sliding_window,
         logits_soft_cap=logits_soft_cap, q_offset=q_offset,
         block_q=block_q, block_k=block_k,
-        num_q_heads=num_q_heads, has_sinks=sinks is not None,
+        has_sinks=sinks is not None,
     )
     kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
 

@@ -30,3 +30,35 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+def fit_losses(model_class: str, model_kwargs: dict, mesh=None,
+               max_steps: int = 6, lr: float = 1e-3) -> list[float]:
+    """Run a tiny CLM fit and return the per-step losses (shared harness for
+    the per-family sharded-mesh tests)."""
+    from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.optim import OptimConfig
+    from llm_training_tpu.parallel import MeshConfig
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+    objective = CLM(CLMConfig(
+        model=ModelProvider(model_class=model_class, model_kwargs=model_kwargs),
+        optim=OptimConfig(learning_rate=lr, warmup_steps=2),
+    ))
+    data = DummyDataModule(DummyDataModuleConfig(
+        batch_size=8, max_length=32, num_samples=64,
+        vocab_size=model_kwargs.get("vocab_size", 128),
+    ))
+    losses: list[float] = []
+
+    class Track:
+        def on_step_end(self, trainer, step, metrics):
+            losses.append(float(metrics["loss"]))
+
+    Trainer(
+        TrainerConfig(max_steps=max_steps, log_every_n_steps=1,
+                      mesh=mesh or MeshConfig()),
+        callbacks=[Track()],
+    ).fit(objective, data)
+    return losses

@@ -220,3 +220,19 @@ def test_v2_greedy_ignores_groups():
     )
     cfg, _, _ = _parity(hf_model, hf_config, seed=36)
     assert cfg.topk_method == "greedy" and cfg.n_group == 4
+
+
+@pytest.mark.slow
+def test_sharded_fit_matches_single_device(devices):
+    """The MLA + MoE logical axes must compose with a real fsdp x tensor
+    mesh: losses on the sharded mesh equal the single-device run."""
+    from conftest import fit_losses
+    from llm_training_tpu.parallel import MeshConfig
+
+    kwargs = dict(TINY, n_group=4, topk_group=2, num_attention_heads=4, moe_impl="dense")
+    single = fit_losses("llm_training_tpu.models.Deepseek", kwargs)
+    sharded = fit_losses(
+        "llm_training_tpu.models.Deepseek", kwargs,
+        mesh=MeshConfig(fsdp_size=4, tensor_parallel_size=2),
+    )
+    np.testing.assert_allclose(single, sharded, rtol=2e-4)

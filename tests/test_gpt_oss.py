@@ -167,3 +167,19 @@ def test_export_reloads_in_transformers(tmp_path):
         hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
     ours = model.apply(params, ids).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.slow
+def test_sharded_fit_matches_single_device(devices):
+    """Sink attention + fused biased experts must compose with a real
+    fsdp x tensor mesh: sharded losses equal the single-device run."""
+    from conftest import fit_losses
+    from llm_training_tpu.parallel import MeshConfig
+
+    kwargs = dict(TINY, moe_impl="dense", router_aux_loss_coef=0.01)
+    single = fit_losses("llm_training_tpu.models.GptOss", kwargs)
+    sharded = fit_losses(
+        "llm_training_tpu.models.GptOss", kwargs,
+        mesh=MeshConfig(fsdp_size=4, tensor_parallel_size=2),
+    )
+    np.testing.assert_allclose(single, sharded, rtol=2e-4)
