@@ -234,6 +234,9 @@ _ARCH_TO_FAMILY = {
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
     "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
+    "nemotron": "llm_training_tpu.models.Llama",  # layernorm1p + relu^2 MLP
+    "ernie4_5": "llm_training_tpu.models.Llama",  # interleaved full-dim rope
+    "hunyuan_v1_dense": "llm_training_tpu.models.Llama",  # post-rope qk-norm
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE

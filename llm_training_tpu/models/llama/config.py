@@ -48,6 +48,8 @@ class LlamaConfig(BaseModelConfig):
     # projected width, applied before the head reshape)
     qk_norm: bool = False
     qk_norm_scope: Literal["head", "full"] = "head"
+    # HunYuan applies the per-head norms AFTER rotary; everyone else before
+    qk_norm_position: Literal["pre_rope", "post_rope"] = "pre_rope"
     # OLMo/OLMoE: clamp q/k/v activations to [-clip_qkv, clip_qkv] after the
     # projections (and qk-norm), before the head reshape
     clip_qkv: float | None = None
@@ -59,12 +61,20 @@ class LlamaConfig(BaseModelConfig):
     norm_scheme: Literal["pre", "post", "parallel", "sandwich"] = "pre"
     # Starcoder2: biased LayerNorm instead of RMSNorm (rms_norm_eps doubles
     # as its epsilon), and a non-gated c_fc -> gelu_tanh -> c_proj MLP.
-    # 'layernorm_nobias' is Cohere's mean-centered weight-only norm.
-    norm_type: Literal["rmsnorm", "layernorm", "layernorm_nobias"] = "rmsnorm"
-    mlp_type: Literal["swiglu", "gelu"] = "swiglu"
-    # Cohere: interleaved (GPT-J) rope pairing + a multiplicative logit scale
+    # 'layernorm_nobias' is Cohere's mean-centered weight-only norm;
+    # 'layernorm1p' is Nemotron's zero-centered (1 + w) biased LayerNorm.
+    # 'relu2' is Nemotron's non-gated up_proj -> relu^2 -> down_proj MLP.
+    norm_type: Literal[
+        "rmsnorm", "layernorm", "layernorm_nobias", "layernorm1p"
+    ] = "rmsnorm"
+    mlp_type: Literal["swiglu", "gelu", "relu2"] = "swiglu"
+    # Cohere/GLM/Ernie: interleaved (GPT-J) rope pairing; Cohere also has a
+    # multiplicative logit scale. fused_gate_up marks GLM-style checkpoints
+    # whose HF files store gate|up as ONE fused tensor (split/re-fused at
+    # the conversion boundary; the module always keeps them separate).
     rope_interleaved: bool = False
     logit_scale: float | None = None
+    fused_gate_up: bool = False
     # Phi-1/1.5/2: rotate only the first fraction of each head's dims
     # (rope tables span int(partial_rotary_factor * head_dim)), and the
     # untied lm_head carries a bias

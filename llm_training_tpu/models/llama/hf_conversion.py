@@ -50,14 +50,8 @@ _SANDWICH_NORM_PARAMS = [
 def _uses_fused_gate_up(config: LlamaConfig) -> bool:
     """GLM/GLM-4 store gate and up as ONE fused gate_up_proj tensor (gate
     rows first); our module keeps them separate, so the conversion splits on
-    import and re-concatenates on export. Identified by the interleaved-rope
-    + swiglu graph under pre/sandwich norms (GLM is its only HF
-    inhabitant; Cohere shares the interleave but uses parallel blocks)."""
-    return (
-        config.rope_interleaved
-        and config.mlp_type == "swiglu"
-        and config.norm_scheme in ("pre", "sandwich")
-    )
+    import and re-concatenates on export."""
+    return config.fused_gate_up
 
 
 def _fused_mlp_parts(sd: Mapping, i: int) -> dict:
@@ -93,6 +87,12 @@ _LAYER_O_BIAS_PARAMS = [
 _LAYER_QK_NORM_PARAMS = [
     (("self_attn", "q_norm", "weight"), "self_attn.q_norm.weight", False),
     (("self_attn", "k_norm", "weight"), "self_attn.k_norm.weight", False),
+]
+
+# HunYuan names its (post-rope) head norms differently
+_LAYER_QK_NORM_PARAMS_HUNYUAN = [
+    (("self_attn", "q_norm", "weight"), "self_attn.query_layernorm.weight", False),
+    (("self_attn", "k_norm", "weight"), "self_attn.key_layernorm.weight", False),
 ]
 
 
@@ -152,7 +152,11 @@ def _bias_params(config: LlamaConfig) -> list:
     if config.attention_out_bias:
         extra += _LAYER_O_BIAS_PARAMS
     if config.qk_norm:
-        extra += _LAYER_QK_NORM_PARAMS
+        extra += (
+            _LAYER_QK_NORM_PARAMS_HUNYUAN
+            if config.qk_norm_position == "post_rope"
+            else _LAYER_QK_NORM_PARAMS
+        )
     return extra
 
 
@@ -166,14 +170,17 @@ def _layer_params(config: LlamaConfig) -> list:
         matmuls = [p for p in matmuls if p[0][0] != "mlp"]
     elif config.mlp_type == "gelu":
         matmuls = [p for p in matmuls if p[0][0] != "mlp"] + _GELU_MLP_PARAMS
+    elif config.mlp_type == "relu2":
+        # Nemotron: no gate projection; up/down keep the llama names
+        matmuls = [p for p in matmuls if p[0][-2] != "gate_proj"]
     norms = {
         "post": _POST_NORM_PARAMS,
         "parallel": _PARALLEL_NORM_PARAMS,
         "sandwich": _SANDWICH_NORM_PARAMS,
         "pre": _PRE_NORM_PARAMS,
     }[config.norm_scheme]
-    if config.norm_type == "layernorm":
-        # biased LayerNorm blocks (Starcoder2): each norm adds a bias key
+    if config.norm_type in ("layernorm", "layernorm1p"):
+        # biased LayerNorm blocks (Starcoder2 / Nemotron): a bias key each
         norms = norms + [
             (path[:-1] + ("bias",), hf.replace(".weight", ".bias"), False)
             for path, hf, _ in norms
@@ -279,7 +286,7 @@ def params_from_hf(
 
     put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
     put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
-    if config.norm_type == "layernorm":
+    if config.norm_type in ("layernorm", "layernorm1p"):
         put(("norm", "bias"), _to_numpy(sd["norm.bias"]))
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
@@ -335,7 +342,7 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     out: dict[str, np.ndarray] = {}
     out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
     out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
-    if config.norm_type == "layernorm":
+    if config.norm_type in ("layernorm", "layernorm1p"):
         out["model.norm.bias"] = np.asarray(_get_path(p, ("norm", "bias")))
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
@@ -398,6 +405,17 @@ def _check_exportable(config: LlamaConfig) -> None:
             "mlp_type='gelu' and norm_type='layernorm' only exist together "
             "(as Starcoder2 or Phi) in HF; this combination cannot be exported"
         )
+    is_nemotron = (
+        config.norm_type == "layernorm1p" and config.mlp_type == "relu2"
+        and config.norm_scheme == "pre"
+        and not config.qk_norm  # HF Nemotron has no q/k norms
+    )
+    if (config.mlp_type == "relu2" or config.norm_type == "layernorm1p") and not is_nemotron:
+        raise ValueError(
+            "mlp_type='relu2' and norm_type='layernorm1p' only exist together "
+            "under pre-norm (as Nemotron) in HF; this combination cannot be "
+            "exported"
+        )
     if ln_gelu and config.norm_scheme == "post":
         raise ValueError(
             "post-norm blocks with layernorm+gelu match no HF architecture"
@@ -432,37 +450,72 @@ def _check_exportable(config: LlamaConfig) -> None:
             "combination cannot be exported"
         )
     is_glm = (
-        config.rope_interleaved
+        config.fused_gate_up
+        and config.rope_interleaved
         and config.mlp_type == "swiglu"
         and config.norm_type == "rmsnorm"
         and config.norm_scheme in ("pre", "sandwich")
     )
-    if config.rope_interleaved and not (is_cohere or is_glm):
+    is_ernie = (
+        config.rope_interleaved and not config.fused_gate_up
+        and config.mlp_type == "swiglu" and config.norm_type == "rmsnorm"
+        and config.norm_scheme == "pre"
+        and config.partial_rotary_factor == 1.0
+        and not config.qk_norm  # HF Ernie has no q/k norms
+    )
+    if is_ernie and config.attention_bias != config.attention_out_bias:
         raise ValueError(
-            "rope_interleaved only exists in HF on Cohere and GLM/GLM-4; "
-            "any other export would reload with half-rotation pairing and "
-            "wrong logits"
+            "Ernie 4.5 has ONE use_bias flag covering q/k/v/o; asymmetric "
+            "attention biases cannot be exported"
+        )
+    if config.fused_gate_up and not is_glm:
+        raise ValueError(
+            "fused_gate_up only exists in HF on GLM/GLM-4 (interleaved rope "
+            "+ swiglu + rmsnorm); this combination cannot be exported"
+        )
+    if config.rope_interleaved and not (is_cohere or is_glm or is_ernie):
+        raise ValueError(
+            "rope_interleaved only exists in HF on Cohere, GLM/GLM-4, and "
+            "Ernie 4.5; any other export would reload with half-rotation "
+            "pairing and wrong logits"
         )
     if config.norm_scheme == "sandwich" and not is_glm:
         raise ValueError(
             "sandwich norms only exist in HF as GLM-4 (interleaved rope + "
-            "swiglu + rmsnorm); this combination cannot be exported"
+            "swiglu + rmsnorm + fused gate_up); this combination cannot be "
+            "exported"
         )
     if config.logit_scale is not None and not is_cohere:
         raise ValueError(
             "logit_scale only exists in HF on Cohere; it would be silently "
             "dropped by any other export"
         )
-    if config.partial_rotary_factor != 1.0 and not (is_phi or is_glm):
+    if config.partial_rotary_factor != 1.0 and not (is_phi or is_glm or is_nemotron):
         raise ValueError(
-            "partial_rotary_factor only exists in HF on Phi and GLM/GLM-4; "
-            "it would be silently dropped otherwise"
+            "partial_rotary_factor only exists in HF on Phi, GLM/GLM-4, and "
+            "Nemotron; it would be silently dropped otherwise"
         )
     if config.lm_head_bias and not is_phi:
         raise ValueError(
             "lm_head_bias only exists in HF on Phi; it would be silently "
             "dropped by any other export"
         )
+    if config.qk_norm and config.qk_norm_position == "post_rope":
+        if not (
+            config.qk_norm_scope == "head"
+            and config.norm_type == "rmsnorm" and config.norm_scheme == "pre"
+            and not config.rope_interleaved  # HunYuan rotates half-style
+        ):
+            raise ValueError(
+                "post-rope qk-norm only exists in HF as HunYuan (per-head "
+                "RMS under pre-norm, half-rotation rope); this combination "
+                "cannot be exported"
+            )
+        if config.attention_bias != config.attention_out_bias:
+            raise ValueError(
+                "HunYuan has ONE attention_bias flag covering q/k/v/o; "
+                "asymmetric attention biases cannot be exported"
+            )
     if config.clip_qkv is not None and not (
         config.num_experts and config.qk_norm and config.qk_norm_scope == "full"
     ):
@@ -545,7 +598,19 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              # trips the earlier qwen2 overlay, which nulls attention_bias
              # (GLM hardcodes no o bias, so the flag is unambiguous here)
              "attention_bias": config.attention_bias}
-            if config.rope_interleaved and config.norm_scheme in ("pre", "sandwich")
+            if config.fused_gate_up
+            else {}
+        ),
+        # interleaved rope WITHOUT the fused gate_up tensor (plain llama
+        # weights) only exists as Ernie 4.5 in HF
+        **(
+            {"model_type": "ernie4_5", "architectures": ["Ernie4_5ForCausalLM"],
+             "use_bias": config.attention_bias,
+             "head_dim": config.resolved_head_dim}
+            if config.rope_interleaved and config.norm_scheme == "pre"
+            and not config.fused_gate_up and config.norm_type == "rmsnorm"
+            and config.mlp_type == "swiglu" and config.partial_rotary_factor == 1.0
+            and not config.qk_norm
             else {}
         ),
         # parallel blocks + weight-only LayerNorm + interleaved rope +
@@ -573,6 +638,29 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "resid_pdrop": 0.0,
              "embd_pdrop": 0.0}
             if _uses_phi_naming(config)
+            else {}
+        ),
+        # post-rope per-head qk-norm only exists as HunYuan in HF
+        **(
+            {"model_type": "hunyuan_v1_dense",
+             "architectures": ["HunYuanDenseV1ForCausalLM"],
+             "head_dim": config.resolved_head_dim,
+             # restore the real flag: asymmetric-bias patterns trip the
+             # earlier qwen2 overlay which nulls attention_bias
+             "attention_bias": config.attention_bias}
+            if config.qk_norm and config.qk_norm_position == "post_rope"
+            else {}
+        ),
+        # zero-centered biased LayerNorm + relu^2 MLP only exist as
+        # Nemotron in HF
+        **(
+            {"model_type": "nemotron", "architectures": ["NemotronForCausalLM"],
+             "norm_eps": config.rms_norm_eps,
+             "partial_rotary_factor": config.partial_rotary_factor,
+             "head_dim": config.resolved_head_dim,
+             "hidden_act": "relu2"}
+            if config.norm_type == "layernorm1p" and config.mlp_type == "relu2"
+            and not config.qk_norm
             else {}
         ),
         # biased-LayerNorm + non-gated gelu MLP only exist as Starcoder2 in
@@ -729,6 +817,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         rms_norm_eps=(
             get("norm_epsilon", 1e-5) if model_type == "starcoder2"
             else get("layer_norm_eps", 1e-5) if model_type in ("cohere", "phi")
+            else get("norm_eps", 1e-5) if model_type == "nemotron"
             else get("rms_norm_eps", 1e-6)
         ),
         pad_token_id=get("pad_token_id"),
@@ -742,6 +831,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_bias=(
             get("use_bias", True) if model_type == "starcoder2"
             else True if model_type == "phi"
+            else get("use_bias", False) if model_type == "ernie4_5"
             else get("attention_bias")
             if get("attention_bias") is not None
             else model_type in ("qwen2", "qwen2_moe")
@@ -749,6 +839,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_out_bias=(
             get("use_bias", True) if model_type == "starcoder2"
             else True if model_type == "phi"
+            else get("use_bias", False) if model_type == "ernie4_5"
             # GLM biases q/k/v but never o_proj
             else False if model_type in ("glm", "glm4")
             else False
@@ -772,7 +863,11 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         ),
         qk_norm=(
             get("use_qk_norm", False) if model_type == "cohere"
-            else model_type in ("qwen3", "olmo2", "qwen3_moe", "olmoe")
+            else model_type in ("qwen3", "olmo2", "qwen3_moe", "olmoe",
+                                "hunyuan_v1_dense")
+        ),
+        qk_norm_position=(
+            "post_rope" if model_type == "hunyuan_v1_dense" else "pre_rope"
         ),
         qk_norm_scope="full" if model_type in ("olmo2", "olmoe") else "head",
         norm_scheme=(
@@ -788,16 +883,22 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         norm_type=(
             "layernorm" if model_type in ("starcoder2", "phi")
             else "layernorm_nobias" if model_type == "cohere"
+            else "layernorm1p" if model_type == "nemotron"
             else "rmsnorm"
         ),
-        mlp_type="gelu" if model_type in ("starcoder2", "phi") else "swiglu",
+        mlp_type=(
+            "gelu" if model_type in ("starcoder2", "phi")
+            else "relu2" if model_type == "nemotron"
+            else "swiglu"
+        ),
         partial_rotary_factor=(
             get("partial_rotary_factor", 0.5)
-            if model_type in ("phi", "glm", "glm4")
+            if model_type in ("phi", "glm", "glm4", "nemotron")
             else 1.0
         ),
         lm_head_bias=(model_type == "phi"),
-        rope_interleaved=model_type in ("cohere", "glm", "glm4"),
+        rope_interleaved=model_type in ("cohere", "glm", "glm4", "ernie4_5"),
+        fused_gate_up=model_type in ("glm", "glm4"),
         logit_scale=(
             get("logit_scale", 0.0625) if model_type == "cohere" else None
         ),

@@ -56,11 +56,13 @@ class LayerNorm(nn.Module):
     use_bias=True is the Starcoder2 block norm (HF param names weight/bias);
     use_bias=False is Cohere's weight-only CohereLayerNorm, whose weight may
     be multi-dim ([heads, head_dim] for the per-head qk-norm) spanning the
-    trailing dims of x."""
+    trailing dims of x; zero_centered=True is Nemotron's LayerNorm1P
+    (weight stored zero-centered, applied as 1 + w)."""
 
     eps: float
     param_dtype: jnp.dtype
     use_bias: bool = True
+    zero_centered: bool = False
     weight_shape: tuple[int, ...] | None = None
 
     @nn.compact
@@ -69,10 +71,16 @@ class LayerNorm(nn.Module):
         axes = (None,) * (len(shape) - 1) + ("norm",)
         weight = self.param(
             "weight",
-            nn.with_logical_partitioning(nn.initializers.ones, axes),
+            nn.with_logical_partitioning(
+                nn.initializers.zeros_init() if self.zero_centered
+                else nn.initializers.ones,
+                axes,
+            ),
             shape,
             self.param_dtype,
         )
+        if self.zero_centered:
+            weight = weight + jnp.ones_like(weight)
         x32 = x.astype(jnp.float32)
         mean = x32.mean(axis=-1, keepdims=True)
         var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
@@ -93,6 +101,7 @@ _NORM_CLASSES = {
     "rmsnorm": RMSNorm,
     "layernorm": LayerNorm,
     "layernorm_nobias": _partial(LayerNorm, use_bias=False),
+    "layernorm1p": _partial(LayerNorm, zero_centered=True),
 }
 
 
@@ -165,7 +174,7 @@ class LlamaAttention(nn.Module):
         k = k.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
         v = v.reshape(batch, seq, cfg.num_key_value_heads, head_dim)
 
-        if cfg.qk_norm and cfg.qk_norm_scope == "head":
+        def _head_qk_norm(q, k):
             if getattr(cfg, "norm_type", "rmsnorm") == "layernorm_nobias":
                 # Cohere: per-HEAD weights [heads, head_dim], mean-centered
                 q = LayerNorm(
@@ -177,10 +186,15 @@ class LlamaAttention(nn.Module):
                     weight_shape=(cfg.num_key_value_heads, head_dim), name="k_norm",
                 )(k)
             else:
-                # Qwen3: per-head RMSNorm over head_dim, shared weight, before
-                # RoPE (HF Qwen3Attention applies q/k norms on reshaped heads)
+                # Qwen3/HunYuan: per-head RMSNorm over head_dim, shared weight
+                # (HF applies the q/k norms on the reshaped heads)
                 q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
                 k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+            return q, k
+
+        head_norm = cfg.qk_norm and cfg.qk_norm_scope == "head"
+        if head_norm and getattr(cfg, "qk_norm_position", "pre_rope") == "pre_rope":
+            q, k = _head_qk_norm(q, k)
 
         rotary = getattr(cfg, "partial_rotary_factor", 1.0)
         if rotary != 1.0:
@@ -197,6 +211,9 @@ class LlamaAttention(nn.Module):
             q, k = apply_rope(
                 q, k, cos, sin, interleaved=getattr(cfg, "rope_interleaved", False)
             )
+
+        if head_norm and getattr(cfg, "qk_norm_position", "pre_rope") == "post_rope":
+            q, k = _head_qk_norm(q, k)  # HunYuan: norms AFTER rotary
 
         attention_dtype = getattr(cfg, "attention_compute_dtype", None)
         if attention_dtype is not None:
@@ -287,6 +304,11 @@ class LlamaMLP(nn.Module):
             up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "c_fc", cfg.mlp_bias)(hidden)
             return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "c_proj", cfg.mlp_bias)(
                 nn.gelu(up, approximate=True)
+            )
+        if getattr(cfg, "mlp_type", "swiglu") == "relu2":
+            up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.mlp_bias)(hidden)
+            return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.mlp_bias)(
+                jnp.square(nn.relu(up))
             )
         gate = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "gate_proj", cfg.mlp_bias)(hidden)
         up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.mlp_bias)(hidden)

@@ -656,8 +656,8 @@ def test_glm4_export_round_trip(tmp_path):
 
     cfg = LlamaConfig(
         **TINY, norm_scheme="sandwich", rope_interleaved=True, head_dim=16,
-        partial_rotary_factor=0.5, attention_bias=True, attention_out_bias=False,
-        pad_token_id=0,
+        fused_gate_up=True, partial_rotary_factor=0.5, attention_bias=True,
+        attention_out_bias=False, pad_token_id=0,
     )
     model = Llama(cfg)
     ids = jnp.asarray(np.random.default_rng(41).integers(0, 128, (2, 16)))
@@ -686,4 +686,139 @@ def test_glm4_export_round_trip(tmp_path):
     with torch.no_grad():
         hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
     ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_nemotron():
+    """Nemotron routes to the Llama module: zero-centered (1+w) biased
+    LayerNorm blocks, a non-gated up -> relu^2 -> down MLP, and partial
+    rotary."""
+    torch = pytest.importorskip("torch")
+    from transformers import NemotronConfig, NemotronForCausalLM
+
+    hf_config = NemotronConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.5, norm_eps=1e-5,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = NemotronForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mlp.up_proj.weight" in sd
+    assert "model.layers.0.mlp.gate_proj.weight" not in sd
+    assert "model.layers.0.input_layernorm.bias" in sd
+    # salt the zero-init norm weights so the (1 + w) convention is LIVE:
+    # a plain-LayerNorm misread would pass with w == 0
+    with torch.no_grad():
+        for k, v in sd.items():
+            if "layernorm.weight" in k or k == "model.norm.weight":
+                v.copy_(torch.linspace(-0.2, 0.2, v.numel()))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_type == "layernorm1p" and cfg.mlp_type == "relu2"
+    assert cfg.partial_rotary_factor == 0.5
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(42).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_nemotron_export_round_trip(tmp_path):
+    """A layernorm1p + relu2 config exports as Nemotron and reloads in
+    transformers with NO missing keys and matching logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **TINY, norm_type="layernorm1p", mlp_type="relu2", head_dim=16,
+        partial_rotary_factor=0.5,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(43).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(12), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "NemotronForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_ernie45():
+    """Ernie 4.5 routes to the Llama module: plain llama weights with
+    GLM-style interleaved full-dim rope."""
+    torch = pytest.importorskip("torch")
+    from transformers import Ernie4_5Config, Ernie4_5ForCausalLM
+
+    hf_config = Ernie4_5Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, use_bias=True,
+        tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = Ernie4_5ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mlp.gate_proj.weight" in sd  # NOT fused
+    assert "model.layers.0.self_attn.o_proj.bias" in sd  # use_bias covers o
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.rope_interleaved and not cfg.fused_gate_up
+    assert cfg.attention_bias and cfg.attention_out_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(44).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_hunyuan():
+    """HunYuan dense routes to the Llama module: per-head qk-norm applied
+    AFTER rotary (query_layernorm/key_layernorm HF names)."""
+    torch = pytest.importorskip("torch")
+    from transformers import HunYuanDenseV1Config, HunYuanDenseV1ForCausalLM
+
+    hf_config = HunYuanDenseV1Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = HunYuanDenseV1ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.query_layernorm.weight" in sd
+    # salt the norm weights: pre- vs post-rope ordering only shows when the
+    # norm is NOT a no-op... (ones-init RMS weights still rescale rows, but
+    # make them asymmetric to be safe)
+    with torch.no_grad():
+        for k, v in sd.items():
+            if "layernorm.weight" in k and "self_attn" in k:
+                v.copy_(torch.linspace(0.5, 1.5, v.numel()))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.qk_norm and cfg.qk_norm_position == "post_rope"
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(45).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
