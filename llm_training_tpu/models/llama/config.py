@@ -75,6 +75,8 @@ class LlamaConfig(BaseModelConfig):
     rope_interleaved: bool = False
     logit_scale: float | None = None
     fused_gate_up: bool = False
+    # GPT-2: learned absolute position embeddings (wpe) instead of rotary
+    position_embedding_type: Literal["rope", "learned"] = "rope"
     # Phi-1/1.5/2: rotate only the first fraction of each head's dims
     # (rope tables span int(partial_rotary_factor * head_dim)), and the
     # untied lm_head carries a bias

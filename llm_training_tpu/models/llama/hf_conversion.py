@@ -268,6 +268,100 @@ def _to_numpy(tensor: Any) -> np.ndarray:
     return np.asarray(tensor)
 
 
+def _gpt2_layer_parts(sd: Mapping, config: LlamaConfig, i: int) -> dict:
+    """HF keys for transformer.h.{i} -> {our in-layer path: array}. Conv1D
+    weights are already [in, out] (NO transpose); the fused [in, 3*embed]
+    c_attn splits into q/k/v columns."""
+    embed = config.hidden_size
+    parts: dict = {}
+    c_attn_w = _to_numpy(sd[f"h.{i}.attn.c_attn.weight"])
+    c_attn_b = _to_numpy(sd[f"h.{i}.attn.c_attn.bias"])
+    for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+        parts[("self_attn", proj, "kernel")] = c_attn_w[:, j * embed:(j + 1) * embed]
+        parts[("self_attn", proj, "bias")] = c_attn_b[j * embed:(j + 1) * embed]
+    parts[("self_attn", "o_proj", "kernel")] = _to_numpy(sd[f"h.{i}.attn.c_proj.weight"])
+    parts[("self_attn", "o_proj", "bias")] = _to_numpy(sd[f"h.{i}.attn.c_proj.bias"])
+    for name in ("c_fc", "c_proj"):
+        parts[("mlp", name, "kernel")] = _to_numpy(sd[f"h.{i}.mlp.{name}.weight"])
+        parts[("mlp", name, "bias")] = _to_numpy(sd[f"h.{i}.mlp.{name}.bias"])
+    for ours, hf in (("input_layernorm", "ln_1"), ("post_attention_layernorm", "ln_2")):
+        parts[(ours, "weight")] = _to_numpy(sd[f"h.{i}.{hf}.weight"])
+        parts[(ours, "bias")] = _to_numpy(sd[f"h.{i}.{hf}.bias"])
+    return parts
+
+
+def _gpt2_params_from_hf(
+    state_dict: Mapping[str, Any], config: LlamaConfig, leaf_fn: Any = None
+) -> dict:
+    """GPT-2 layout: `transformer.*` prefix, learned wpe table, fused qkv."""
+    params: dict = {}
+    sd = {k.removeprefix("transformer."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["wte.weight"]))
+    put(("wpe", "embedding"), _to_numpy(sd["wpe.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["ln_f.weight"]))
+    put(("norm", "bias"), _to_numpy(sd["ln_f.bias"]))
+    if config.scan_layers:
+        layers = [
+            _gpt2_layer_parts(sd, config, i)
+            for i in range(config.num_hidden_layers)
+        ]
+        for path in layers[0]:
+            put(("layers", "layer") + path,
+                np.stack([layer[path] for layer in layers]))
+    else:
+        for i in range(config.num_hidden_layers):
+            for path, value in _gpt2_layer_parts(sd, config, i).items():
+                put((f"layers_{i}",) + path, value)
+    return {"params": params}
+
+
+def _gpt2_params_to_hf(params: Mapping, config: LlamaConfig) -> dict:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict = {}
+    out["transformer.wte.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["transformer.wpe.weight"] = np.asarray(_get_path(p, ("wpe", "embedding")))
+    out["transformer.ln_f.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    out["transformer.ln_f.bias"] = np.asarray(_get_path(p, ("norm", "bias")))
+
+    # device->host once per stacked path, then slice per layer (the generic
+    # exporter's O(L^2)-avoidance discipline)
+    cache: dict = {}
+
+    def fetch(path):
+        if path not in cache:
+            cache[path] = np.asarray(_get_path(p, ("layers", "layer") + path))
+        return cache[path]
+
+    for i in range(config.num_hidden_layers):
+        if config.scan_layers:
+            g = lambda *path: fetch(path)[i]
+        else:
+            g = lambda *path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
+        out[f"transformer.h.{i}.attn.c_attn.weight"] = np.concatenate(
+            [g("self_attn", proj, "kernel") for proj in ("q_proj", "k_proj", "v_proj")],
+            axis=1,
+        )
+        out[f"transformer.h.{i}.attn.c_attn.bias"] = np.concatenate(
+            [g("self_attn", proj, "bias") for proj in ("q_proj", "k_proj", "v_proj")]
+        )
+        out[f"transformer.h.{i}.attn.c_proj.weight"] = g("self_attn", "o_proj", "kernel")
+        out[f"transformer.h.{i}.attn.c_proj.bias"] = g("self_attn", "o_proj", "bias")
+        for name in ("c_fc", "c_proj"):
+            out[f"transformer.h.{i}.mlp.{name}.weight"] = g("mlp", name, "kernel")
+            out[f"transformer.h.{i}.mlp.{name}.bias"] = g("mlp", name, "bias")
+        for ours, hf in (("input_layernorm", "ln_1"), ("post_attention_layernorm", "ln_2")):
+            out[f"transformer.h.{i}.{hf}.weight"] = g(ours, "weight")
+            out[f"transformer.h.{i}.{hf}.bias"] = g(ours, "bias")
+    return out
+
+
 def params_from_hf(
     state_dict: Mapping[str, Any], config: LlamaConfig, leaf_fn: Any = None
 ) -> dict:
@@ -276,6 +370,8 @@ def params_from_hf(
     `leaf_fn(path, value)` (if given) transforms each leaf as soon as it is
     built — the streaming hook hf_io uses to `device_put` each tensor and
     drop the host copy before the next one is read."""
+    if config.position_embedding_type == "learned":
+        return _gpt2_params_from_hf(state_dict, config, leaf_fn)
     params: dict = {}
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
     if _uses_phi_naming(config):
@@ -337,6 +433,8 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     """flax param tree -> HF `model.*` state dict (numpy values)."""
     import flax.linen as nn
 
+    if config.position_embedding_type == "learned":
+        return _gpt2_params_to_hf(params, config)
     p = params.get("params", params)
     p = nn.meta.unbox(p)  # strip Partitioned boxes if the tree came from init()
     out: dict[str, np.ndarray] = {}
@@ -397,6 +495,31 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
 def _check_exportable(config: LlamaConfig) -> None:
     """Refuse feature combinations no HF architecture represents — a silent
     plain-llama fallthrough would reload with random-initialized modules."""
+    if config.position_embedding_type == "learned":
+        is_gpt2 = (
+            config.norm_type == "layernorm" and config.mlp_type == "gelu"
+            and config.norm_scheme == "pre" and config.tie_word_embeddings
+            and config.attention_bias and config.attention_out_bias
+            and config.mlp_bias
+            and config.num_key_value_heads == config.num_attention_heads
+            and not config.qk_norm and not config.rope_interleaved
+            # no feature GPT-2 cannot represent may ride along
+            and config.sliding_window is None and config.logit_scale is None
+            and config.clip_qkv is None and not config.fused_gate_up
+            and config.partial_rotary_factor == 1.0
+            and not config.lm_head_bias and config.num_experts is None
+            and config.embedding_multiplier == 1.0
+            and config.attention_multiplier is None
+            and config.residual_multiplier == 1.0
+            and config.logits_scaling == 1.0
+        )
+        if not is_gpt2:
+            raise ValueError(
+                "position_embedding_type='learned' only exists in HF as GPT-2 "
+                "(tied, fully-biased MHA + LayerNorm + gelu under pre-norm); "
+                "this combination cannot be exported"
+            )
+        return  # the gpt2 export path handles everything else
     ln_gelu = config.norm_type == "layernorm" and config.mlp_type == "gelu"
     if (config.mlp_type == "gelu") != ln_gelu or (
         config.norm_type == "layernorm"
@@ -529,6 +652,26 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
     """Our LlamaConfig -> HF `config.json` dict (reference `get_hf_model`,
     `hf_compat_model.py:113-119`, exports an HF config alongside weights)."""
     _check_exportable(config)
+    if config.position_embedding_type == "learned":
+        return {
+            "architectures": ["GPT2LMHeadModel"],
+            "model_type": "gpt2",
+            "vocab_size": config.vocab_size,
+            "n_embd": config.hidden_size,
+            "n_inner": config.intermediate_size,
+            "n_layer": config.num_hidden_layers,
+            "n_head": config.num_attention_heads,
+            "n_positions": config.max_position_embeddings,
+            "n_ctx": config.max_position_embeddings,
+            "activation_function": "gelu_new",
+            "initializer_range": config.initializer_range,
+            "layer_norm_epsilon": config.rms_norm_eps,
+            "bos_token_id": config.bos_token_id,
+            "eos_token_id": config.eos_token_id,
+            "tie_word_embeddings": True,
+            "use_cache": True,
+            "torch_dtype": torch_dtype,
+        }
     return {
         "architectures": ["LlamaForCausalLM"],
         "model_type": "llama",
@@ -757,6 +900,50 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         lambda k, d=None: getattr(hf_config, k, d)
     )
     model_type = get("model_type")
+    if model_type == "gpt2":
+        for drop in ("embd_pdrop", "attn_pdrop", "resid_pdrop"):
+            if get(drop, 0.0):
+                raise ValueError(
+                    f"gpt2 {drop}={get(drop)} is not supported: dropout is "
+                    "not implemented — override it to 0.0"
+                )
+        if get("scale_attn_by_inverse_layer_idx") or get("reorder_and_upcast_attn"):
+            raise ValueError(
+                "gpt2 scale_attn_by_inverse_layer_idx / reorder_and_upcast_attn "
+                "are not supported"
+            )
+        if not get("scale_attn_weights", True):
+            raise ValueError(
+                "gpt2 scale_attn_weights=False is not supported (attention "
+                "always scales by 1/sqrt(head_dim) here)"
+            )
+        if get("activation_function", "gelu_new") not in (
+            "gelu_new", "gelu_pytorch_tanh"
+        ):
+            raise ValueError(
+                f"gpt2 activation_function={get('activation_function')!r} is "
+                "not supported; only the tanh-approximate gelu is implemented"
+            )
+        return LlamaConfig(**{**dict(
+            vocab_size=get("vocab_size"),
+            hidden_size=get("n_embd"),
+            intermediate_size=get("n_inner") or 4 * get("n_embd"),
+            num_hidden_layers=get("n_layer"),
+            num_attention_heads=get("n_head"),
+            num_key_value_heads=get("n_head"),
+            max_position_embeddings=get("n_positions", 1024),
+            initializer_range=get("initializer_range", 0.02),
+            rms_norm_eps=get("layer_norm_epsilon", 1e-5),
+            bos_token_id=get("bos_token_id", 50256),
+            eos_token_id=get("eos_token_id", 50256),
+            tie_word_embeddings=True,
+            position_embedding_type="learned",
+            norm_type="layernorm",
+            mlp_type="gelu",
+            attention_bias=True,
+            attention_out_bias=True,
+            mlp_bias=True,
+        ), **overrides})
     if model_type == "phi":
         if get("qk_layernorm", False):
             raise ValueError("phi qk_layernorm=True is not supported")

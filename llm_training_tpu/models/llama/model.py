@@ -197,7 +197,11 @@ class LlamaAttention(nn.Module):
             q, k = _head_qk_norm(q, k)
 
         rotary = getattr(cfg, "partial_rotary_factor", 1.0)
-        if rotary != 1.0:
+        if getattr(cfg, "position_embedding_type", "rope") == "learned":
+            rotary = None  # GPT-2: positions entered via wpe, no rotation
+        if rotary is None:
+            pass
+        elif rotary != 1.0:
             # Phi: rotate only the first int(factor * head_dim) dims of each
             # head; the remainder passes through unrotated
             rot = int(head_dim * rotary)
@@ -481,15 +485,39 @@ class Llama(nn.Module):
 
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
+        learned = getattr(cfg, "position_embedding_type", "rope") == "learned"
+        if learned:
+            if seq > cfg.max_position_embeddings:
+                raise ValueError(
+                    f"sequence length {seq} exceeds the learned position "
+                    f"table ({cfg.max_position_embeddings}); jnp.take would "
+                    "silently clamp out-of-range positions"
+                )
+            # GPT-2: learned absolute positions into the residual stream
+            wpe = nn.Embed(
+                num_embeddings=cfg.max_position_embeddings,
+                features=cfg.hidden_size,
+                dtype=cfg.compute_jnp_dtype,
+                param_dtype=cfg.param_jnp_dtype,
+                embedding_init=nn.with_logical_partitioning(
+                    nn.initializers.normal(cfg.initializer_range), (None, "embed")
+                ),
+                name="wpe",
+            )
+            hidden = hidden + wpe(position_ids)
         # host-side rotary tables (static config -> numpy); seq is static at
         # trace time, so seq-dependent variants (dynamic NTK, longrope
         # short/long factor selection — HF Phi3RotaryEmbedding semantics)
-        # resolve per compiled shape
-        inv_freq, attention_scaling = compute_rope_frequencies(
-            cfg.rope_config, seq_len=seq
-        )
-        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
-        if getattr(cfg, "rope_interleaved", False):
+        # resolve per compiled shape. Learned-position models carry no
+        # rotation at all.
+        if learned:
+            cos = sin = None
+        else:
+            inv_freq, attention_scaling = compute_rope_frequencies(
+                cfg.rope_config, seq_len=seq
+            )
+            cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+        if cos is not None and getattr(cfg, "rope_interleaved", False):
             # repeat_interleave(freqs, 2) layout instead of concat halves
             half = cos.shape[-1] // 2
             cos = jnp.repeat(cos[..., :half], 2, axis=-1)

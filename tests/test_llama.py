@@ -822,3 +822,67 @@ def test_logits_parity_with_hf_hunyuan():
         hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
     ours = model.apply(params, jnp.asarray(ids)).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_gpt2():
+    """GPT-2 routes to the Llama module: learned wpe positions (no rope),
+    biased LayerNorm + gelu MLP, fused Conv1D c_attn split into q/k/v at
+    the conversion boundary (Conv1D stores [in, out] — no transposes)."""
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    hf_config = GPT2Config(
+        vocab_size=128, n_embd=64, n_inner=112, n_layer=2, n_head=4,
+        n_positions=64, embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = GPT2LMHeadModel(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "transformer.wpe.weight" in sd
+    assert sd["transformer.h.0.attn.c_attn.weight"].shape == (64, 192)
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.position_embedding_type == "learned" and cfg.tie_word_embeddings
+    assert cfg.intermediate_size == 112 and cfg.num_key_value_heads == 4
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(46).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_gpt2_export_round_trip(tmp_path):
+    """A learned-positions config exports as GPT-2 and reloads in
+    transformers with NO missing keys (re-fused c_attn) and matching
+    logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, compute_dtype="float32",
+        position_embedding_type="learned", norm_type="layernorm",
+        mlp_type="gelu", attention_bias=True, mlp_bias=True,
+        tie_word_embeddings=True, scan_layers=False,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(47).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(13), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "GPT2LMHeadModel"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
