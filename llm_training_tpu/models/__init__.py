@@ -16,6 +16,7 @@ from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
 from llm_training_tpu.models.phi3 import Phi3, Phi3Config
+from llm_training_tpu.models.qwen3_next import Qwen3Next, Qwen3NextConfig
 
 __all__ = [
     "BaseModelConfig",
@@ -32,4 +33,6 @@ __all__ = [
     "LlamaConfig",
     "Phi3",
     "Phi3Config",
+    "Qwen3Next",
+    "Qwen3NextConfig",
 ]

@@ -35,6 +35,7 @@ _FAMILIES: dict[str, str] = {
     "GemmaConfig": "llm_training_tpu.models.gemma.hf_conversion",
     "DeepseekConfig": "llm_training_tpu.models.deepseek.hf_conversion",
     "GptOssConfig": "llm_training_tpu.models.gpt_oss.hf_conversion",
+    "Qwen3NextConfig": "llm_training_tpu.models.qwen3_next.hf_conversion",
 }
 
 
@@ -243,6 +244,7 @@ _ARCH_TO_FAMILY = {
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
     "gpt_oss": "llm_training_tpu.models.GptOss",  # sink attention + clamped-swiglu MoE
+    "qwen3_next": "llm_training_tpu.models.Qwen3Next",  # hybrid gated DeltaNet
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

@@ -305,13 +305,14 @@ def _gpt2_params_from_hf(
     put(("norm", "weight"), _to_numpy(sd["ln_f.weight"]))
     put(("norm", "bias"), _to_numpy(sd["ln_f.bias"]))
     if config.scan_layers:
-        layers = [
-            _gpt2_layer_parts(sd, config, i)
-            for i in range(config.num_hidden_layers)
-        ]
-        for path in layers[0]:
-            put(("layers", "layer") + path,
-                np.stack([layer[path] for layer in layers]))
+        # stack ONE path at a time so leaf_fn's device_put-and-drop keeps the
+        # host working set to a single stacked tensor (hf_io streaming)
+        paths = list(_gpt2_layer_parts(sd, config, 0))
+        for path in paths:
+            put(("layers", "layer") + path, np.stack([
+                _gpt2_layer_parts(sd, config, i)[path]
+                for i in range(config.num_hidden_layers)
+            ]))
     else:
         for i in range(config.num_hidden_layers):
             for path, value in _gpt2_layer_parts(sd, config, i).items():
@@ -503,6 +504,10 @@ def _check_exportable(config: LlamaConfig) -> None:
             and config.mlp_bias
             and config.num_key_value_heads == config.num_attention_heads
             and not config.qk_norm and not config.rope_interleaved
+            # GPT-2 derives head_dim as n_embd / n_head; a custom value
+            # would contradict the exported tensor shapes
+            and config.resolved_head_dim
+            == config.hidden_size // config.num_attention_heads
             # no feature GPT-2 cannot represent may ride along
             and config.sliding_window is None and config.logit_scale is None
             and config.clip_qkv is None and not config.fused_gate_up

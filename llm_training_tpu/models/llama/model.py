@@ -198,9 +198,7 @@ class LlamaAttention(nn.Module):
 
         rotary = getattr(cfg, "partial_rotary_factor", 1.0)
         if getattr(cfg, "position_embedding_type", "rope") == "learned":
-            rotary = None  # GPT-2: positions entered via wpe, no rotation
-        if rotary is None:
-            pass
+            pass  # GPT-2: positions entered via wpe, no rotation
         elif rotary != 1.0:
             # Phi: rotate only the first int(factor * head_dim) dims of each
             # head; the remainder passes through unrotated

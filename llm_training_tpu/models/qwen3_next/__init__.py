@@ -1,0 +1,4 @@
+from llm_training_tpu.models.qwen3_next.config import Qwen3NextConfig
+from llm_training_tpu.models.qwen3_next.model import Qwen3Next
+
+__all__ = ["Qwen3Next", "Qwen3NextConfig"]

@@ -1,0 +1,109 @@
+"""Qwen3-Next model config.
+
+Family member beyond the reference's named models (the reference reaches
+Qwen3-Next only through `HFCausalLM`'s torch wrapping,
+`src/llm_training/models/hf_causal_lm/hf_causal_lm.py:22`); here the hybrid
+Gated-DeltaNet + gated-attention graph is native. Mirrors HF
+`Qwen3NextConfig` (transformers `models/qwen3_next/configuration_qwen3_next.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class Qwen3NextConfig(BaseModelConfig):
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_hidden_layers: int = 48
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 2
+    head_dim: int = 256
+    max_position_embeddings: int = 32768
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-6
+    pad_token_id: int | None = None
+    bos_token_id: int | None = None
+    eos_token_id: int | list[int] | None = None
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    partial_rotary_factor: float = 0.25
+    attention_bias: bool = False
+    attention_dropout: float = 0.0
+    mlp_bias: bool = False  # read by the shared MLP/MoE blocks
+
+    # per-layer 'linear_attention' / 'full_attention'; None = the HF default
+    # pattern (full attention on every 4th layer)
+    layer_types: list[str] | None = None
+
+    # --- gated DeltaNet (linear-attention layers)
+    linear_num_key_heads: int = 16
+    linear_num_value_heads: int = 32
+    linear_key_head_dim: int = 128
+    linear_value_head_dim: int = 128
+    linear_conv_kernel_dim: int = 4
+    delta_chunk_size: int = 64  # chunked delta-rule block length
+
+    # --- MoE (qwen-style: softmax top-k + shared expert with sigmoid gate);
+    # field names match what models.moe.MoEMLP reads from its config
+    num_experts: int | None = None
+    num_experts_per_tok: int = 10
+    moe_intermediate_size: int | None = None
+    norm_topk_prob: bool = True
+    shared_expert_intermediate_size: int | None = None
+    router_aux_loss_coef: float = 0.001
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    # linear/full alternation makes the layer body non-uniform; looped
+    scan_layers: bool = False
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "Qwen3NextConfig":
+        if self.attention_dropout != 0.0:
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.scan_layers:
+            raise ValueError("qwen3-next layers are looped; set scan_layers=False")
+        if self.layer_types is not None and len(self.layer_types) != self.num_hidden_layers:
+            raise ValueError(
+                f"layer_types has {len(self.layer_types)} entries for "
+                f"{self.num_hidden_layers} layers"
+            )
+        if self.linear_num_value_heads % self.linear_num_key_heads:
+            raise ValueError(
+                "linear_num_value_heads must be a multiple of linear_num_key_heads"
+            )
+        if self.num_experts is not None and self.moe_intermediate_size is None:
+            raise ValueError("num_experts requires moe_intermediate_size")
+        self.rope_config
+        return self
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta,
+            int(self.head_dim * self.partial_rotary_factor),
+            self.max_position_embeddings,
+        )
+
+    # MoEMLP reads this name on the llama config; keep the same spelling
+    moe_style: str = "qwen"
+
+    def layer_is_linear(self, layer_idx: int) -> bool:
+        kind = (
+            self.layer_types[layer_idx]
+            if self.layer_types is not None
+            # HF default: full attention every 4th layer
+            else ("full_attention" if layer_idx % 4 == 3 else "linear_attention")
+        )
+        return kind == "linear_attention"

@@ -1,0 +1,193 @@
+"""Qwen3-Next <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to
+Qwen3-Next (reached by the reference only through torch wrapping,
+`hf_causal_lm.py:22`). Layers are looped (linear/full mix); MoE expert
+weights stack through the shared llama `_moe_layer_parts` helpers; the
+depthwise conv kernel converts between HF's [C, 1, K] and our [K, C].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _moe_layer_out,
+    _moe_layer_parts,
+    _set_path,
+    _to_numpy,
+)
+from llm_training_tpu.models.qwen3_next.config import Qwen3NextConfig
+
+_FULL_ATTN = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+    (("self_attn", "q_norm", "weight"), "self_attn.q_norm.weight", False),
+    (("self_attn", "k_norm", "weight"), "self_attn.k_norm.weight", False),
+]
+
+_LINEAR_ATTN = [
+    (("linear_attn", "in_proj_qkvz", "kernel"), "linear_attn.in_proj_qkvz.weight", True),
+    (("linear_attn", "in_proj_ba", "kernel"), "linear_attn.in_proj_ba.weight", True),
+    (("linear_attn", "out_proj", "kernel"), "linear_attn.out_proj.weight", True),
+    (("linear_attn", "norm", "weight"), "linear_attn.norm.weight", False),
+    (("linear_attn", "A_log"), "linear_attn.A_log", False),
+    (("linear_attn", "dt_bias"), "linear_attn.dt_bias", False),
+]
+
+_NORMS = [
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+
+def _layer_params(config: Qwen3NextConfig, i: int) -> list:
+    return (_LINEAR_ATTN if config.layer_is_linear(i) else _FULL_ATTN) + _NORMS
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: Qwen3NextConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            put((f"layers_{i}",) + path, value.T if transpose else value)
+        if config.layer_is_linear(i):
+            # HF depthwise conv [C, 1, K] -> our [K, C]
+            conv = _to_numpy(sd[f"layers.{i}.linear_attn.conv1d.weight"])
+            put((f"layers_{i}", "linear_attn", "conv_kernel"), conv[:, 0, :].T)
+        if config.num_experts:
+            for path, value in _moe_layer_parts(sd, config, i).items():
+                put((f"layers_{i}",) + path, value)
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: Qwen3NextConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        if config.layer_is_linear(i):
+            conv = np.asarray(_get_path(p, (f"layers_{i}", "linear_attn", "conv_kernel")))
+            out[f"model.layers.{i}.linear_attn.conv1d.weight"] = conv.T[:, None, :]
+        if config.num_experts:
+            get = lambda path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            _moe_layer_out(get, config, i, out)
+    return out
+
+
+def config_to_hf(config: Qwen3NextConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["Qwen3NextForCausalLM"],
+        "model_type": "qwen3_next",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.head_dim,
+        "partial_rotary_factor": config.partial_rotary_factor,
+        "layer_types": [
+            "linear_attention" if config.layer_is_linear(i) else "full_attention"
+            for i in range(config.num_hidden_layers)
+        ],
+        "linear_num_key_heads": config.linear_num_key_heads,
+        "linear_num_value_heads": config.linear_num_value_heads,
+        "linear_key_head_dim": config.linear_key_head_dim,
+        "linear_value_head_dim": config.linear_value_head_dim,
+        "linear_conv_kernel_dim": config.linear_conv_kernel_dim,
+        "num_experts": config.num_experts,
+        "num_experts_per_tok": config.num_experts_per_tok,
+        "moe_intermediate_size": config.moe_intermediate_size,
+        "norm_topk_prob": config.norm_topk_prob,
+        "shared_expert_intermediate_size": config.shared_expert_intermediate_size,
+        "router_aux_loss_coef": config.router_aux_loss_coef,
+        "decoder_sparse_step": 1,
+        "mlp_only_layers": [],
+        "output_router_logits": False,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": config.attention_dropout,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> Qwen3NextConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers"):
+        raise ValueError(
+            "mixed dense/sparse layer schedules (decoder_sparse_step != 1 or "
+            "mlp_only_layers) are not supported"
+        )
+    return Qwen3NextConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim", 256),
+        max_position_embeddings=get("max_position_embeddings", 32768),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-6),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id"),
+        eos_token_id=get("eos_token_id"),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=get("rope_scaling"),
+        partial_rotary_factor=get("partial_rotary_factor", 0.25),
+        attention_bias=get("attention_bias", False),
+        attention_dropout=get("attention_dropout", 0.0),
+        layer_types=list(get("layer_types") or []) or None,
+        linear_num_key_heads=get("linear_num_key_heads", 16),
+        linear_num_value_heads=get("linear_num_value_heads", 32),
+        linear_key_head_dim=get("linear_key_head_dim", 128),
+        linear_value_head_dim=get("linear_value_head_dim", 128),
+        linear_conv_kernel_dim=get("linear_conv_kernel_dim", 4),
+        num_experts=get("num_experts"),
+        num_experts_per_tok=get("num_experts_per_tok", 10),
+        moe_intermediate_size=get("moe_intermediate_size"),
+        norm_topk_prob=get("norm_topk_prob", True),
+        shared_expert_intermediate_size=get("shared_expert_intermediate_size"),
+        router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+    ), **overrides})

@@ -1,0 +1,431 @@
+"""Qwen3-Next decoder, TPU-native.
+
+Graph verified against HF `modeling_qwen3_next.py`:
+
+- hybrid layer stack: 3-of-4 layers are gated DeltaNet linear attention,
+  every 4th is gated full attention; every layer's MLP is the qwen-style
+  sparse MoE (softmax top-k + shared expert with sigmoid gate — the shared
+  `MoEMLP` block).
+- gated full attention: q_proj emits [q | gate] per head, zero-centered
+  (1+w) per-head qk-norms, PARTIAL rotary (factor 0.25), and the attention
+  output multiplies sigmoid(gate) before o_proj.
+- gated DeltaNet: fused qkvz/ba projections, a depthwise causal conv (silu)
+  over the concatenated q|k|v channels, per-head decay
+  g = -exp(A_log) * softplus(a + dt_bias) and write strength
+  beta = sigmoid(b), then the CHUNKED gated delta rule. The reference's
+  per-row forward-substitution loop is a unit-lower-triangular inverse,
+  computed here as ONE `solve_triangular` per chunk (the TPU-idiomatic
+  form); the cross-chunk recurrence is a `lax.scan` over the running
+  [dk, dv] state. All delta-rule math runs in fp32 like the HF kernel.
+- norms are zero-centered (1+w) RMSNorms; the DeltaNet output norm is the
+  gated variant (norm(x) * w * silu(z)).
+
+Padding semantics mirror HF: padded tokens are zeroed at the layer input,
+but the recurrent state still decays THROUGH padding (and across packed
+documents — the delta rule has no boundary reset; same limitation as HF).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.moe import MoEMLP
+from llm_training_tpu.models.qwen3_next.config import Qwen3NextConfig
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.models.llama.model import _dense
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+class ZeroCenteredRMSNorm(nn.Module):
+    """(1 + w) RMSNorm with fp32 stats, product BEFORE the downcast (HF
+    Qwen3NextRMSNorm)."""
+
+    eps: float
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("norm",)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (normed * (1.0 + weight.astype(jnp.float32))).astype(x.dtype)
+
+
+class GatedRMSNorm(nn.Module):
+    """norm(x) * w * silu(z) (HF Qwen3NextRMSNormGated; NON-zero-centered
+    weight, gate applied after the weighted norm in fp32)."""
+
+    eps: float
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, gate: jnp.ndarray) -> jnp.ndarray:
+        weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        out = (weight.astype(jnp.float32) * normed).astype(x.dtype)
+        return (
+            out.astype(jnp.float32) * jax.nn.silu(gate.astype(jnp.float32))
+        ).astype(x.dtype)
+
+
+def _l2norm(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
+
+
+def chunk_gated_delta_rule(
+    q: jnp.ndarray,  # [B, S, H, dk]
+    k: jnp.ndarray,  # [B, S, H, dk]
+    v: jnp.ndarray,  # [B, S, H, dv]
+    g: jnp.ndarray,  # [B, S, H] log-decay (negative)
+    beta: jnp.ndarray,  # [B, S, H] write strength in (0, 1)
+    chunk_size: int = 64,
+) -> jnp.ndarray:
+    """Chunked gated delta rule (HF `torch_chunk_gated_delta_rule`), fp32.
+
+    Within each chunk the delta-rule corrections solve a unit-lower-
+    triangular system (the reference's forward-substitution loop); across
+    chunks a `lax.scan` carries the [dk, dv] fast-weight state.
+    """
+    in_dtype = q.dtype
+    q = _l2norm(q.astype(jnp.float32))
+    k = _l2norm(k.astype(jnp.float32))
+    v = v.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    beta = beta.astype(jnp.float32)
+
+    batch, seq, heads, dk = q.shape
+    dv = v.shape[-1]
+    pad = (-seq) % chunk_size
+    if pad:
+        q, k, v = (jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0))) for x in (q, k, v))
+        g, beta = (jnp.pad(x, ((0, 0), (0, pad), (0, 0))) for x in (g, beta))
+    nc = (seq + pad) // chunk_size
+    c = chunk_size
+
+    # -> [B, H, nc, c, d]
+    def chunked(x):
+        return x.reshape(batch, nc, c, heads, -1).transpose(0, 3, 1, 2, 4)
+
+    q = chunked(q) * (dk ** -0.5)
+    k = chunked(k)
+    v = chunked(v)
+    g = g.reshape(batch, nc, c, heads).transpose(0, 3, 1, 2)  # [B, H, nc, c]
+    beta = beta.reshape(batch, nc, c, heads).transpose(0, 3, 1, 2)
+
+    v_beta = v * beta[..., None]
+    k_beta = k * beta[..., None]
+
+    g = jnp.cumsum(g, axis=-1)
+    # decay_ij = exp(g_i - g_j) on the lower triangle (i >= j), else 0
+    tril = jnp.tril(jnp.ones((c, c), bool))
+    decay = jnp.where(tril, jnp.exp(g[..., :, None] - g[..., None, :]), 0.0)
+
+    # strictly-lower correction matrix, then T = (I - A)^{-1} via a
+    # triangular solve — the reference computes this with a per-row loop
+    strict = jnp.tril(jnp.ones((c, c), bool), -1)
+    a_mat = jnp.where(
+        strict,
+        -jnp.einsum("bhncd,bhnmd->bhncm", k_beta, k) * decay,
+        0.0,
+    )
+    eye = jnp.eye(c, dtype=jnp.float32)
+    t_mat = jax.scipy.linalg.solve_triangular(
+        eye - a_mat, jnp.broadcast_to(eye, a_mat.shape), lower=True, unit_diagonal=True
+    )
+    v_corr = jnp.einsum("bhncm,bhnmd->bhncd", t_mat, v_beta)
+    k_cumdecay = jnp.einsum(
+        "bhncm,bhnmd->bhncd", t_mat, k_beta * jnp.exp(g)[..., None]
+    )
+
+    # [nc, B, H, ...] for the scan over chunks
+    def lead(x):
+        return jnp.moveaxis(x, 2, 0)
+
+    q_s, k_s, v_s, kc_s = lead(q), lead(k), lead(v_corr), lead(k_cumdecay)
+    g_s, decay_s = lead(g), lead(decay)
+
+    def step(state, xs):
+        q_i, k_i, v_i, kc_i, g_i, decay_i = xs
+        attn = jnp.where(
+            tril,
+            jnp.einsum("bhcd,bhmd->bhcm", q_i, k_i) * decay_i,
+            0.0,
+        )
+        v_prime = jnp.einsum("bhcd,bhdv->bhcv", kc_i, state)
+        v_new = v_i - v_prime
+        inter = jnp.einsum("bhcd,bhdv->bhcv", q_i * jnp.exp(g_i)[..., None], state)
+        out_i = inter + jnp.einsum("bhcm,bhmv->bhcv", attn, v_new)
+        g_last = g_i[..., -1]
+        state = state * jnp.exp(g_last)[..., None, None] + jnp.einsum(
+            "bhcd,bhcv->bhdv",
+            k_i * jnp.exp(g_last[..., None] - g_i)[..., None],
+            v_new,
+        )
+        return state, out_i
+
+    init = jnp.zeros((batch, heads, dk, dv), jnp.float32)
+    _, out = jax.lax.scan(step, init, (q_s, k_s, v_s, kc_s, g_s, decay_s))
+    # [nc, B, H, c, dv] -> [B, S, H, dv]
+    out = jnp.moveaxis(out, 0, 2).reshape(batch, heads, nc * c, dv)
+    out = out.transpose(0, 2, 1, 3)[:, :seq]
+    return out.astype(in_dtype)
+
+
+class GatedDeltaNet(nn.Module):
+    config: Qwen3NextConfig
+
+    @nn.compact
+    def __call__(self, hidden, pad_mask):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        kh, vh = cfg.linear_num_key_heads, cfg.linear_num_value_heads
+        dk, dv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
+        group = vh // kh
+        key_dim, value_dim = kh * dk, vh * dv
+
+        if pad_mask is not None:  # HF zeroes padded tokens at the layer input
+            hidden = hidden * pad_mask[..., None].astype(hidden.dtype)
+
+        qkvz = _dense(
+            cfg, key_dim * 2 + value_dim * 2, ("embed", "heads"),
+            "in_proj_qkvz", False,
+        )(hidden)
+        ba = _dense(cfg, vh * 2, ("embed", "heads"), "in_proj_ba", False)(hidden)
+
+        # HF interleaves per k-head: [q(dk) | k(dk) | v(group*dv) | z(group*dv)]
+        qkvz = qkvz.reshape(batch, seq, kh, 2 * dk + 2 * group * dv)
+        qh = qkvz[..., :dk]
+        khd = qkvz[..., dk:2 * dk]
+        vhd = qkvz[..., 2 * dk:2 * dk + group * dv].reshape(batch, seq, vh, dv)
+        z = qkvz[..., 2 * dk + group * dv:].reshape(batch, seq, vh, dv)
+        ba = ba.reshape(batch, seq, kh, 2 * group)
+        b = ba[..., :group].reshape(batch, seq, vh)
+        a = ba[..., group:].reshape(batch, seq, vh)
+
+        # depthwise causal conv (kernel 4, no bias) + silu over q|k|v channels
+        mixed = jnp.concatenate(
+            [qh.reshape(batch, seq, key_dim), khd.reshape(batch, seq, key_dim),
+             vhd.reshape(batch, seq, value_dim)],
+            axis=-1,
+        )
+        conv_w = self.param(
+            "conv_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), (None, "heads")
+            ),
+            (cfg.linear_conv_kernel_dim, mixed.shape[-1]),
+            cfg.param_jnp_dtype,
+        ).astype(mixed.dtype)
+        padded = jnp.pad(mixed, ((0, 0), (cfg.linear_conv_kernel_dim - 1, 0), (0, 0)))
+        conv = sum(
+            padded[:, i:i + seq] * conv_w[i]
+            for i in range(cfg.linear_conv_kernel_dim)
+        )
+        mixed = jax.nn.silu(conv)
+
+        qh = mixed[..., :key_dim].reshape(batch, seq, kh, dk)
+        khd = mixed[..., key_dim:2 * key_dim].reshape(batch, seq, kh, dk)
+        vhd = mixed[..., 2 * key_dim:].reshape(batch, seq, vh, dv)
+
+        a_log = self.param(
+            "A_log",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("heads",)),
+            (vh,),
+            jnp.float32,
+        )
+        dt_bias = self.param(
+            "dt_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("heads",)),
+            (vh,),
+            jnp.float32,
+        )
+        beta = jax.nn.sigmoid(b.astype(jnp.float32))
+        g = -jnp.exp(a_log) * jax.nn.softplus(a.astype(jnp.float32) + dt_bias)
+
+        # broadcast k-heads over the value-head groups
+        qh = jnp.repeat(qh, group, axis=2)
+        khd = jnp.repeat(khd, group, axis=2)
+
+        out = chunk_gated_delta_rule(
+            qh, khd, vhd, g, beta, chunk_size=cfg.delta_chunk_size
+        )
+        out = GatedRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(out, z)
+        out = out.reshape(batch, seq, value_dim)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "out_proj", False)(out)
+
+
+class GatedAttention(nn.Module):
+    config: Qwen3NextConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.head_dim
+
+        qg = _dense(cfg, heads * d * 2, ("embed", "heads"), "q_proj",
+                    cfg.attention_bias)(hidden)
+        qg = qg.reshape(batch, seq, heads, 2 * d)
+        q, gate = qg[..., :d], qg[..., d:]
+        gate = gate.reshape(batch, seq, heads * d)
+        k = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "k_proj", cfg.attention_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "v_proj", cfg.attention_bias)(hidden)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, d)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, d)
+
+        norm = lambda name: ZeroCenteredRMSNorm(
+            cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name
+        )
+        q = norm("q_norm")(q)
+        k = norm("k_norm")(k)
+
+        rot = int(d * cfg.partial_rotary_factor)
+        q_rot, k_rot = apply_rope(q[..., :rot], k[..., :rot], cos, sin)
+        q = jnp.concatenate([q_rot, q[..., rot:]], axis=-1)
+        k = jnp.concatenate([k_rot, k[..., rot:]], axis=-1)
+
+        out = dot_product_attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, heads * d)
+        out = out * jax.nn.sigmoid(gate.astype(jnp.float32)).astype(out.dtype)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      cfg.attention_bias)(out)
+
+
+class Qwen3NextDecoderLayer(nn.Module):
+    config: Qwen3NextConfig
+    is_linear: bool
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: ZeroCenteredRMSNorm(
+            cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name
+        )
+        pad_mask = None if segment_ids is None else segment_ids > 0
+
+        normed = norm("input_layernorm")(hidden)
+        if self.is_linear:
+            attn = GatedDeltaNet(cfg, name="linear_attn")(normed, pad_mask)
+        else:
+            attn = GatedAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+        hidden = hidden + attn
+
+        normed = norm("post_attention_layernorm")(hidden)
+        if cfg.num_experts:
+            mlp_out, stats = MoEMLP(cfg, name="mlp")(normed, pad_mask)
+        else:
+            from llm_training_tpu.models.llama.model import LlamaMLP
+
+            mlp_out, stats = LlamaMLP(cfg, name="mlp")(normed), jnp.float32(0.0)
+        return hidden + mlp_out, stats
+
+
+class Qwen3Next(nn.Module):
+    """Qwen3-Next causal LM with the `CausalLMProto` surface."""
+
+    config: Qwen3NextConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        policy = _remat_policy(cfg)
+        stats = []
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = Qwen3NextDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(Qwen3NextDecoderLayer, policy=policy)
+            hidden, layer_stats = layer_cls(
+                cfg, cfg.layer_is_linear(i), name=f"layers_{i}"
+            )(hidden, segment_ids, cos, sin)
+            stats.append(layer_stats)
+
+        hidden = ZeroCenteredRMSNorm(
+            cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm"
+        )(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        aux_loss = None
+        if cfg.num_experts:
+            sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+            aux_loss = cfg.num_experts * jnp.sum(
+                sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
+            )
+
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = embed_tokens.attend(hidden)
+            else:
+                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+            aux_loss=aux_loss,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        if self.config.tie_word_embeddings:
+            return "embed_tokens/embedding"
+        return "lm_head/kernel"
