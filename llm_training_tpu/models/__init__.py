@@ -15,6 +15,7 @@ from llm_training_tpu.models.gemma import Gemma, GemmaConfig
 from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
+from llm_training_tpu.models.minimax import MiniMax, MiniMaxConfig
 from llm_training_tpu.models.phi3 import Phi3, Phi3Config
 from llm_training_tpu.models.qwen3_next import Qwen3Next, Qwen3NextConfig
 
@@ -31,6 +32,8 @@ __all__ = [
     "HFCausalLMConfig",
     "Llama",
     "LlamaConfig",
+    "MiniMax",
+    "MiniMaxConfig",
     "Phi3",
     "Phi3Config",
     "Qwen3Next",

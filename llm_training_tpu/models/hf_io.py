@@ -36,6 +36,7 @@ _FAMILIES: dict[str, str] = {
     "DeepseekConfig": "llm_training_tpu.models.deepseek.hf_conversion",
     "GptOssConfig": "llm_training_tpu.models.gpt_oss.hf_conversion",
     "Qwen3NextConfig": "llm_training_tpu.models.qwen3_next.hf_conversion",
+    "MiniMaxConfig": "llm_training_tpu.models.minimax.hf_conversion",
 }
 
 
@@ -245,6 +246,7 @@ _ARCH_TO_FAMILY = {
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
     "gpt_oss": "llm_training_tpu.models.GptOss",  # sink attention + clamped-swiglu MoE
     "qwen3_next": "llm_training_tpu.models.Qwen3Next",  # hybrid gated DeltaNet
+    "minimax": "llm_training_tpu.models.MiniMax",  # hybrid lightning attention
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

@@ -1,0 +1,4 @@
+from llm_training_tpu.models.minimax.config import MiniMaxConfig
+from llm_training_tpu.models.minimax.model import MiniMax
+
+__all__ = ["MiniMax", "MiniMaxConfig"]

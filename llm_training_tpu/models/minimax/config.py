@@ -1,0 +1,114 @@
+"""MiniMax (MiniMax-Text-01 / M1) model config.
+
+Family member beyond the reference's named models (the reference reaches
+MiniMax only through `HFCausalLM`'s torch wrapping, `hf_causal_lm.py:22`);
+here the hybrid lightning-attention graph is native. Mirrors HF
+`MiniMaxConfig`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class MiniMaxConfig(BaseModelConfig):
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    head_dim: int | None = None
+    max_position_embeddings: int = 4096 * 32
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-5
+    pad_token_id: int | None = None
+    bos_token_id: int | None = None
+    eos_token_id: int | list[int] | None = None
+    tie_word_embeddings: bool = False
+    rope_theta: float = 1e6
+    rope_scaling: dict[str, Any] | None = None
+    attention_bias: bool = False
+    attention_dropout: float = 0.0
+    sliding_window: int | None = None
+
+    # per-layer 'linear_attention' / 'full_attention' (REQUIRED: HF derives
+    # its default in config __init__, so converted configs always carry it)
+    layer_types: list[str] | None = None
+    block_size: int = 256  # lightning-attention chunk length
+
+    # residual combiners: hidden = residual * alpha + block_out * beta
+    full_attn_alpha_factor: float = 1.0
+    full_attn_beta_factor: float = 1.0
+    linear_attn_alpha_factor: float = 1.0
+    linear_attn_beta_factor: float = 1.0
+    mlp_alpha_factor: float = 1.0
+    mlp_beta_factor: float = 1.0
+
+    # --- MoE (mixtral-style: block_sparse_moe, w1/w3/w2 expert naming);
+    # field names match what models.moe.MoEMLP reads
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: int | None = None
+    norm_topk_prob: bool = True  # Mixtral-style renormalization
+    shared_expert_intermediate_size: int | None = None
+    router_aux_loss_coef: float = 0.001
+    moe_style: str = "mixtral"
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    mlp_bias: bool = False
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    scan_layers: bool = False  # linear/full mix is non-uniform
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "MiniMaxConfig":
+        if self.attention_dropout != 0.0:
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.scan_layers:
+            raise ValueError("minimax layers are looped; set scan_layers=False")
+        if self.layer_types is None:
+            raise ValueError(
+                "layer_types is required (HF MiniMax configs always carry the "
+                "materialized list)"
+            )
+        if len(self.layer_types) != self.num_hidden_layers:
+            raise ValueError(
+                f"layer_types has {len(self.layer_types)} entries for "
+                f"{self.num_hidden_layers} layers"
+            )
+        if self.num_experts is None or self.moe_intermediate_size is None:
+            # every HF MiniMax is MoE; a dense variant would be unexportable
+            raise ValueError(
+                "MiniMax requires num_experts and moe_intermediate_size "
+                "(the architecture is MoE-only)"
+            )
+        bad = set(self.layer_types) - {"linear_attention", "full_attention"}
+        if bad:
+            raise ValueError(
+                f"unknown layer_types entries {sorted(bad)}; expected "
+                "'linear_attention' or 'full_attention'"
+            )
+        self.rope_config
+        return self
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.resolved_head_dim,
+            self.max_position_embeddings,
+        )
+
+    def layer_is_linear(self, layer_idx: int) -> bool:
+        return self.layer_types[layer_idx] == "linear_attention"

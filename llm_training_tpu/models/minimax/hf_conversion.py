@@ -1,0 +1,202 @@
+"""MiniMax <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to MiniMax
+(reached by the reference only through torch wrapping, `hf_causal_lm.py:22`).
+Layers are looped (linear/full mix); MoE expert weights go through the
+shared mixtral-style llama helpers (`block_sparse_moe.*.w1/w3/w2`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _moe_layer_out,
+    _moe_layer_parts,
+    _set_path,
+    _to_numpy,
+)
+from llm_training_tpu.models.minimax.config import MiniMaxConfig
+from llm_training_tpu.models.minimax.model import _slope_rate
+
+
+def _decay_buffers(config: MiniMaxConfig, i: int) -> dict[str, np.ndarray]:
+    """HF persists the (deterministic) lightning decay buffers in its state
+    dict; recompute them at export so reloads see identical tensors."""
+    heads = config.num_attention_heads
+    c = config.block_size
+    slope = _slope_rate(heads, i, config.num_hidden_layers)[:, None, None]
+    pos = (np.arange(c, dtype=np.float32) + 1.0)[:, None]
+    query_decay = np.exp(-slope * pos[None])
+    key_decay = np.exp(-slope * (c - pos)[None])
+    diff = pos - pos.T
+    diagonal_decay = np.where(diff >= 0, np.exp(-slope * diff[None]), 0.0)[None]
+    return {
+        "slope_rate": slope.astype(np.float32),
+        "query_decay": query_decay.astype(np.float32),
+        "key_decay": key_decay.astype(np.float32),
+        "diagonal_decay": diagonal_decay.astype(np.float32),
+    }
+
+_FULL_ATTN = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+]
+
+_LINEAR_ATTN = [
+    (("self_attn", "qkv_proj", "kernel"), "self_attn.qkv_proj.weight", True),
+    (("self_attn", "output_gate", "kernel"), "self_attn.output_gate.weight", True),
+    (("self_attn", "out_proj", "kernel"), "self_attn.out_proj.weight", True),
+    (("self_attn", "norm", "weight"), "self_attn.norm.weight", False),
+]
+
+_NORMS = [
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+
+def _layer_params(config: MiniMaxConfig, i: int) -> list:
+    return (_LINEAR_ATTN if config.layer_is_linear(i) else _FULL_ATTN) + _NORMS
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: MiniMaxConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            put((f"layers_{i}",) + path, value.T if transpose else value)
+        # our module name matches HF's block_sparse_moe, but the shared
+        # helper emits the path under 'mlp' — rename on the way in
+        for path, value in _moe_layer_parts(sd, config, i).items():
+            put((f"layers_{i}", "block_sparse_moe") + path[1:], value)
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: MiniMaxConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        if config.layer_is_linear(i):
+            for name, value in _decay_buffers(config, i).items():
+                out[f"model.layers.{i}.self_attn.{name}"] = value
+        get = lambda path: np.asarray(
+            _get_path(p, (f"layers_{i}", "block_sparse_moe") + path[1:])
+        )
+        _moe_layer_out(get, config, i, out)
+    return out
+
+
+def config_to_hf(config: MiniMaxConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["MiniMaxForCausalLM"],
+        "model_type": "minimax",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.resolved_head_dim,
+        # HF MiniMax (like Mixtral) uses intermediate_size as the per-expert
+        # width
+        "intermediate_size": config.moe_intermediate_size,
+        "layer_types": list(config.layer_types),
+        "block_size": config.block_size,
+        "full_attn_alpha_factor": config.full_attn_alpha_factor,
+        "full_attn_beta_factor": config.full_attn_beta_factor,
+        "linear_attn_alpha_factor": config.linear_attn_alpha_factor,
+        "linear_attn_beta_factor": config.linear_attn_beta_factor,
+        "mlp_alpha_factor": config.mlp_alpha_factor,
+        "mlp_beta_factor": config.mlp_beta_factor,
+        "num_local_experts": config.num_experts,
+        "num_experts_per_tok": config.num_experts_per_tok,
+        "router_aux_loss_coef": config.router_aux_loss_coef,
+        "router_jitter_noise": 0.0,
+        "output_router_logits": False,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": config.attention_dropout,
+        "sliding_window": config.sliding_window,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> MiniMaxConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    if get("router_jitter_noise", 0.0):
+        raise ValueError("minimax router_jitter_noise is not supported; set it to 0.0")
+    return MiniMaxConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        # HF intermediate_size IS the per-expert width (mixtral-style)
+        intermediate_size=get("intermediate_size"),
+        moe_intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim"),
+        max_position_embeddings=get("max_position_embeddings"),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id"),
+        eos_token_id=get("eos_token_id"),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 1e6),
+        rope_scaling=get("rope_scaling"),
+        attention_bias=get("attention_bias", False),
+        attention_dropout=get("attention_dropout", 0.0),
+        sliding_window=get("sliding_window"),
+        layer_types=list(get("layer_types") or []) or None,
+        block_size=get("block_size", 256),
+        full_attn_alpha_factor=get("full_attn_alpha_factor", 1.0),
+        full_attn_beta_factor=get("full_attn_beta_factor", 1.0),
+        linear_attn_alpha_factor=get("linear_attn_alpha_factor", 1.0),
+        linear_attn_beta_factor=get("linear_attn_beta_factor", 1.0),
+        mlp_alpha_factor=get("mlp_alpha_factor", 1.0),
+        mlp_beta_factor=get("mlp_beta_factor", 1.0),
+        num_experts=get("num_local_experts"),
+        num_experts_per_tok=get("num_experts_per_tok", 2),
+        norm_topk_prob=True,  # Mixtral-style renormalization
+        router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+    ), **overrides})

@@ -1,0 +1,269 @@
+"""MiniMax decoder, TPU-native.
+
+Graph verified against HF `modeling_minimax.py`:
+
+- hybrid layer stack (layer_types): lightning linear attention on most
+  layers, softmax attention (mixtral-style GQA + rope) on the rest; every
+  layer's MLP is the mixtral-style sparse MoE (shared `MoEMLP`).
+- lightning attention: silu(qkv_proj) split per head, NO softmax and NO
+  1/sqrt(d) — block-chunked linear attention with fixed per-head decay
+  slopes (ALiBi-style geometric ladder scaled by layer depth). Per block:
+  intra = (QK^T * pairwise-decay) @ V, inter = (Q * query-decay) @ S, and
+  the running KV state S updates as exp(-slope*block) * S +
+  (K * key-decay)^T @ V — a `lax.scan` over blocks. Output passes a
+  full-width RMSNorm, a sigmoid output gate computed from the layer INPUT,
+  and out_proj.
+- distinctive residual scheme: the layer input is normed FIRST and the
+  normed value is also the residual — hidden = normed * alpha +
+  block(normed) * beta, with per-kind alpha/beta factors from the config.
+
+Padding mirrors HF: v zeroes at padded positions (so padding writes
+nothing into the running state), but the state persists across packed
+documents (no boundary reset — same limitation as HF).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.llama.model import RMSNorm, _dense
+from llm_training_tpu.models.minimax.config import MiniMaxConfig
+from llm_training_tpu.models.moe import MoEMLP
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+def _slope_rate(num_heads: int, layer_idx: int, num_layers: int) -> np.ndarray:
+    """Fixed per-head decay slopes (HF get_slope_rate)."""
+    base = 1.0 / (2.0 ** (8.0 / num_heads))
+    rate = base ** (np.arange(num_heads) + 1)
+    factor = 1.0 - layer_idx / (num_layers - 1 + 1e-5) + 1e-5
+    return (rate * factor).astype(np.float32)  # [H]
+
+
+def lightning_attention(
+    q: jnp.ndarray,  # [B, S, H, d]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    slope: jnp.ndarray,  # [H]
+    block_size: int,
+) -> jnp.ndarray:
+    """Block-chunked linear attention with exponential decay (fp32)."""
+    in_dtype = q.dtype
+    q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    batch, seq, heads, d = q.shape
+    pad = (-seq) % block_size
+    if pad:
+        q, k, v = (jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0))) for x in (q, k, v))
+    nc = (seq + pad) // block_size
+    c = block_size
+
+    # [nc, B, H, c, d]
+    def chunked(x):
+        return x.reshape(batch, nc, c, heads, d).transpose(1, 0, 3, 2, 4)
+
+    q_s, k_s, v_s = chunked(q), chunked(k), chunked(v)
+
+    pos = jnp.arange(c, dtype=jnp.float32) + 1.0
+    sl = slope.astype(jnp.float32)[:, None]  # [H, 1]
+    query_decay = jnp.exp(-sl * pos[None, :])[:, :, None]  # [H, c, 1]
+    key_decay = jnp.exp(-sl * (c - pos)[None, :])[:, :, None]  # [H, c, 1]
+    diff = pos[:, None] - pos[None, :]
+    diag_decay = jnp.where(
+        diff >= 0, jnp.exp(-sl[..., None] * diff[None]), 0.0
+    )  # [H, c, c]
+    block_decay = jnp.exp(-slope.astype(jnp.float32) * c)  # [H]
+
+    def step(state, xs):
+        q_i, k_i, v_i = xs  # [B, H, c, d]
+        intra_w = jnp.einsum("bhcd,bhmd->bhcm", q_i, k_i) * diag_decay[None]
+        intra = jnp.einsum("bhcm,bhmd->bhcd", intra_w, v_i)
+        inter = jnp.einsum("bhcd,bhde->bhce", q_i * query_decay[None], state)
+        out_i = intra + inter
+        state = state * block_decay[None, :, None, None] + jnp.einsum(
+            "bhcd,bhce->bhde", k_i * key_decay[None], v_i
+        )
+        return state, out_i
+
+    init = jnp.zeros((batch, heads, d, d), jnp.float32)
+    _, out = jax.lax.scan(step, init, (q_s, k_s, v_s))
+    out = out.transpose(1, 0, 3, 2, 4).reshape(batch, nc * c, heads, d)
+    return out[:, :seq].astype(in_dtype)
+
+
+class LightningAttention(nn.Module):
+    config: MiniMaxConfig
+    layer_idx: int
+
+    @nn.compact
+    def __call__(self, hidden, pad_mask):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.resolved_head_dim
+
+        qkv = jax.nn.silu(
+            _dense(cfg, heads * d * 3, ("embed", "heads"), "qkv_proj", False)(hidden)
+        )
+        qkv = qkv.reshape(batch, seq, heads, 3 * d)
+        q, k, v = qkv[..., :d], qkv[..., d:2 * d], qkv[..., 2 * d:]
+        if pad_mask is not None:
+            # padded positions write nothing into the running state
+            v = v * pad_mask[..., None, None].astype(v.dtype)
+
+        slope = jnp.asarray(
+            _slope_rate(heads, self.layer_idx, cfg.num_hidden_layers)
+        )
+        out = lightning_attention(q, k, v, slope, cfg.block_size)
+        out = out.reshape(batch, seq, heads * d)
+        # HF hardcodes this norm's eps at the MiniMaxRMSNorm default (1e-6),
+        # independent of config.rms_norm_eps
+        out = RMSNorm(1e-6, cfg.param_jnp_dtype, name="norm")(out)
+        gate = _dense(cfg, heads * d, ("embed", "heads"), "output_gate", False)(hidden)
+        out = jax.nn.sigmoid(gate.astype(jnp.float32)).astype(out.dtype) * out
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "out_proj", False)(out)
+
+
+class MiniMaxAttention(nn.Module):
+    """Softmax layers: mixtral-style GQA + full-dim rope."""
+
+    config: MiniMaxConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.resolved_head_dim
+        q = _dense(cfg, heads * d, ("embed", "heads"), "q_proj",
+                   cfg.attention_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "k_proj", cfg.attention_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "v_proj", cfg.attention_bias)(hidden)
+        q = q.reshape(batch, seq, heads, d)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, d)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, d)
+        q, k = apply_rope(q, k, cos, sin)
+        out = dot_product_attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            sliding_window=cfg.sliding_window, impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, heads * d)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      cfg.attention_bias)(out)
+
+
+class MiniMaxDecoderLayer(nn.Module):
+    config: MiniMaxConfig
+    layer_idx: int
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        pad_mask = None if segment_ids is None else segment_ids > 0
+        linear = cfg.layer_is_linear(self.layer_idx)
+
+        # MiniMax residual scheme: the NORMED input is also the residual
+        hidden = norm("input_layernorm")(hidden)
+        if linear:
+            attn = LightningAttention(cfg, self.layer_idx, name="self_attn")(
+                hidden, pad_mask
+            )
+            alpha, beta = cfg.linear_attn_alpha_factor, cfg.linear_attn_beta_factor
+        else:
+            attn = MiniMaxAttention(cfg, name="self_attn")(hidden, segment_ids, cos, sin)
+            alpha, beta = cfg.full_attn_alpha_factor, cfg.full_attn_beta_factor
+        hidden = hidden * alpha + attn * beta
+
+        hidden = norm("post_attention_layernorm")(hidden)
+        mlp_out, stats = MoEMLP(cfg, name="block_sparse_moe")(hidden, pad_mask)
+        hidden = hidden * cfg.mlp_alpha_factor + mlp_out * cfg.mlp_beta_factor
+        return hidden, stats
+
+
+class MiniMax(nn.Module):
+    """MiniMax causal LM with the `CausalLMProto` surface."""
+
+    config: MiniMaxConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        policy = _remat_policy(cfg)
+        stats = []
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = MiniMaxDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(MiniMaxDecoderLayer, policy=policy)
+            hidden, layer_stats = layer_cls(cfg, i, name=f"layers_{i}")(
+                hidden, segment_ids, cos, sin
+            )
+            stats.append(layer_stats)
+
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+        aux_loss = cfg.num_experts * jnp.sum(
+            sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
+        )
+
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = embed_tokens.attend(hidden)
+            else:
+                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+            aux_loss=aux_loss,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        if self.config.tie_word_embeddings:
+            return "embed_tokens/embedding"
+        return "lm_head/kernel"
