@@ -240,6 +240,7 @@ _ARCH_TO_FAMILY = {
     "ernie4_5": "llm_training_tpu.models.Llama",  # interleaved full-dim rope
     "hunyuan_v1_dense": "llm_training_tpu.models.Llama",  # post-rope qk-norm
     "gpt2": "llm_training_tpu.models.Llama",  # learned positions, fused qkv
+    "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE

@@ -77,6 +77,8 @@ class LlamaConfig(BaseModelConfig):
     fused_gate_up: bool = False
     # GPT-2: learned absolute position embeddings (wpe) instead of rotary
     position_embedding_type: Literal["rope", "learned"] = "rope"
+    # SmolLM3 NoPE: per-layer rope flags, HF spelling (1 = rotate, 0 = NoPE)
+    no_rope_layers: list[int] | None = None
     # Phi-1/1.5/2: rotate only the first fraction of each head's dims
     # (rope tables span int(partial_rotary_factor * head_dim)), and the
     # untied lm_head carries a bias
@@ -137,6 +139,18 @@ class LlamaConfig(BaseModelConfig):
                     f"num_experts_per_tok ({self.num_experts_per_tok}) must be "
                     f"in [1, num_experts={self.num_experts}]"
                 )
+        if self.no_rope_layers is not None:
+            if self.position_embedding_type == "learned":
+                raise ValueError(
+                    "no_rope_layers is meaningless with learned positions"
+                )
+            if len(self.no_rope_layers) != self.num_hidden_layers:
+                raise ValueError(
+                    f"no_rope_layers has {len(self.no_rope_layers)} entries "
+                    f"for {self.num_hidden_layers} layers"
+                )
+            # per-layer rope on/off breaks the uniform scanned body
+            self.scan_layers = False
         self.rope_config  # construct to trigger RoPEConfig validation
         return self
 

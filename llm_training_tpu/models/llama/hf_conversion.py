@@ -644,6 +644,15 @@ def _check_exportable(config: LlamaConfig) -> None:
                 "HunYuan has ONE attention_bias flag covering q/k/v/o; "
                 "asymmetric attention biases cannot be exported"
             )
+    if config.no_rope_layers is not None and not (
+        config.norm_type == "rmsnorm" and config.mlp_type == "swiglu"
+        and config.norm_scheme == "pre" and not config.rope_interleaved
+        and not config.qk_norm and config.num_experts is None
+    ):
+        raise ValueError(
+            "no_rope_layers only exists in HF as SmolLM3 (a plain llama "
+            "graph); this combination cannot be exported"
+        )
     if config.clip_qkv is not None and not (
         config.num_experts and config.qk_norm and config.qk_norm_scope == "full"
     ):
@@ -822,6 +831,16 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "hidden_act": "gelu_pytorch_tanh"}
             if config.norm_type == "layernorm" and config.mlp_type == "gelu"
             and config.norm_scheme == "pre"
+            else {}
+        ),
+        # per-layer NoPE only exists as SmolLM3 in HF
+        **(
+            {"model_type": "smollm3", "architectures": ["SmolLM3ForCausalLM"],
+             "no_rope_layers": list(config.no_rope_layers),
+             "no_rope_layer_interval": 4,
+             "use_sliding_window": config.sliding_window is not None,
+             "sliding_window": config.sliding_window}
+            if config.no_rope_layers is not None
             else {}
         ),
         # any non-identity multiplier only exists as Granite in HF; our None
@@ -1050,8 +1069,14 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         sliding_window=(
             get("sliding_window")
             if get("use_sliding_window",
-                   model_type not in ("qwen2", "qwen3", "qwen2_moe", "qwen3_moe"))
+                   model_type not in ("qwen2", "qwen3", "qwen2_moe",
+                                      "qwen3_moe", "smollm3"))
             else None
+        ),
+        # SmolLM3 NoPE pattern (1 = rotate); absent elsewhere
+        no_rope_layers=(
+            list(get("no_rope_layers") or []) or None
+            if model_type == "smollm3" else None
         ),
         qk_norm=(
             get("use_qk_norm", False) if model_type == "cohere"

@@ -432,13 +432,22 @@ class Llama(nn.Module):
             )(cfg, LlamaDecoderLayer, name="layers")
             hidden, aux = scanned(hidden, segment_ids, cos, sin)
         else:
+            no_rope = getattr(cfg, "no_rope_layers", None)
+            if no_rope is not None and cos is not None:
+                # NoPE layers rotate with identity tables — zero layer-body
+                # variation, so conversion/remat stay uniform
+                id_cos = jnp.ones_like(cos)
+                id_sin = jnp.zeros_like(sin)
             stats = []
             for i in range(cfg.num_hidden_layers):
                 layer_cls = LlamaDecoderLayer
                 if policy is not None:
                     layer_cls = nn.remat(LlamaDecoderLayer, policy=policy)
+                use_rope = no_rope is None or bool(no_rope[i])
                 hidden, layer_aux = layer_cls(cfg, name=f"layers_{i}")(
-                    hidden, segment_ids, cos, sin
+                    hidden, segment_ids,
+                    cos if use_rope else id_cos,
+                    sin if use_rope else id_sin,
                 )
                 stats.append(layer_aux)
             aux = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)

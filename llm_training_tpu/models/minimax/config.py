@@ -18,7 +18,8 @@ from llm_training_tpu.models.base import BaseModelConfig
 class MiniMaxConfig(BaseModelConfig):
     vocab_size: int = 32000
     hidden_size: int = 4096
-    intermediate_size: int = 14336
+    # derived: HF MiniMax has ONE width field and it is the expert width
+    intermediate_size: int | None = None
     num_hidden_layers: int = 32
     num_attention_heads: int = 32
     num_key_value_heads: int = 8
@@ -88,6 +89,22 @@ class MiniMaxConfig(BaseModelConfig):
                 "MiniMax requires num_experts and moe_intermediate_size "
                 "(the architecture is MoE-only)"
             )
+        if self.intermediate_size is None:
+            self.intermediate_size = self.moe_intermediate_size
+        elif self.intermediate_size != self.moe_intermediate_size:
+            raise ValueError(
+                "MiniMax has one MLP width: intermediate_size must equal "
+                "moe_intermediate_size (HF stores only the expert width)"
+            )
+        if self.attention_bias or self.mlp_bias:
+            raise ValueError(
+                "HF MiniMax has no projection biases; the conversion would "
+                "silently drop them"
+            )
+        if self.shared_expert_intermediate_size is not None:
+            raise ValueError("HF MiniMax has no shared expert")
+        if self.moe_style != "mixtral":
+            raise ValueError("MiniMax experts use the mixtral naming scheme")
         bad = set(self.layer_types) - {"linear_attention", "full_attention"}
         if bad:
             raise ValueError(

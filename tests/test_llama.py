@@ -886,3 +886,32 @@ def test_gpt2_export_round_trip(tmp_path):
         hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
     ours = model.apply(params, ids).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_smollm3():
+    """SmolLM3 routes to the Llama module: a plain llama graph with
+    per-layer NoPE (every 4th layer skips rotary; NoPE layers rotate with
+    identity tables so the layer body stays uniform)."""
+    torch = pytest.importorskip("torch")
+    from transformers import SmolLM3Config, SmolLM3ForCausalLM
+
+    hf_config = SmolLM3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0,
+        attn_implementation="eager",
+    )
+    assert hf_config.no_rope_layers == [1, 1, 1, 0]
+    torch.manual_seed(0)
+    hf_model = SmolLM3ForCausalLM(hf_config).eval()
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.no_rope_layers == [1, 1, 1, 0] and not cfg.scan_layers
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(48).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
