@@ -9,6 +9,7 @@ families. Here, models are flax.linen Modules whose parameters carry
 `llm_training_tpu.parallel.sharding`.
 """
 
+from llm_training_tpu.models.bamba import Bamba, BambaConfig
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
 from llm_training_tpu.models.deepseek import Deepseek, DeepseekConfig
 from llm_training_tpu.models.gemma import Gemma, GemmaConfig
@@ -20,6 +21,8 @@ from llm_training_tpu.models.phi3 import Phi3, Phi3Config
 from llm_training_tpu.models.qwen3_next import Qwen3Next, Qwen3NextConfig
 
 __all__ = [
+    "Bamba",
+    "BambaConfig",
     "BaseModelConfig",
     "CausalLMOutput",
     "Deepseek",

@@ -1,0 +1,4 @@
+from llm_training_tpu.models.bamba.config import BambaConfig
+from llm_training_tpu.models.bamba.model import Bamba
+
+__all__ = ["Bamba", "BambaConfig"]

@@ -1,0 +1,101 @@
+"""Bamba (IBM Mamba-2 / attention hybrid) model config.
+
+Family member beyond the reference's named models (the reference reaches
+Bamba only through `HFCausalLM`'s torch wrapping, `hf_causal_lm.py:22`);
+here the Mamba-2 SSD graph is native. Mirrors HF `BambaConfig`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class BambaConfig(BaseModelConfig):
+    vocab_size: int = 128000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 262144
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-5
+    pad_token_id: int | None = 0
+    bos_token_id: int | None = 1
+    eos_token_id: int | list[int] | None = 2
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    partial_rotary_factor: float = 0.5
+    attention_bias: bool = False
+    attention_dropout: float = 0.0
+    mlp_bias: bool = False
+
+    # attention replaces mamba at these layer indices (None = pure mamba)
+    attn_layer_indices: list[int] | None = None
+
+    # --- mamba-2 mixer
+    mamba_n_heads: int = 128
+    mamba_d_head: int = 64
+    mamba_n_groups: int = 1
+    mamba_d_state: int = 256
+    mamba_expand: int = 2
+    mamba_d_conv: int = 4
+    mamba_conv_bias: bool = True
+    mamba_proj_bias: bool = False
+    mamba_chunk_size: int = 256
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    scan_layers: bool = False  # mamba/attention mix is non-uniform
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "BambaConfig":
+        if self.attention_dropout != 0.0:
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.scan_layers:
+            raise ValueError("bamba layers are looped; set scan_layers=False")
+        if self.mamba_n_heads * self.mamba_d_head != self.mamba_intermediate:
+            raise ValueError(
+                "mamba_n_heads * mamba_d_head must equal "
+                "mamba_expand * hidden_size"
+            )
+        if self.mamba_n_heads % self.mamba_n_groups:
+            raise ValueError("mamba_n_heads must be divisible by mamba_n_groups")
+        if self.attn_layer_indices:
+            bad = [i for i in self.attn_layer_indices
+                   if not 0 <= i < self.num_hidden_layers]
+            if bad:
+                raise ValueError(f"attn_layer_indices out of range: {bad}")
+        self.rope_config
+        return self
+
+    @property
+    def mamba_intermediate(self) -> int:
+        return self.mamba_expand * self.hidden_size
+
+    @property
+    def mamba_conv_dim(self) -> int:
+        return self.mamba_intermediate + 2 * self.mamba_n_groups * self.mamba_d_state
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta,
+            int(self.resolved_head_dim * self.partial_rotary_factor),
+            self.max_position_embeddings,
+        )
+
+    def layer_is_attention(self, layer_idx: int) -> bool:
+        return bool(self.attn_layer_indices) and layer_idx in self.attn_layer_indices

@@ -1,0 +1,188 @@
+"""Bamba <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to Bamba
+(reached by the reference only through torch wrapping, `hf_causal_lm.py:22`).
+Layers are looped (mamba/attention mix); the depthwise conv converts between
+HF's [C, 1, K] and our [K, C].
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.bamba.config import BambaConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+
+_ATTN = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+]
+
+_MAMBA = [
+    (("mamba", "in_proj", "kernel"), "mamba.in_proj.weight", True),
+    (("mamba", "out_proj", "kernel"), "mamba.out_proj.weight", True),
+    (("mamba", "norm", "weight"), "mamba.norm.weight", False),
+    (("mamba", "A_log"), "mamba.A_log", False),
+    (("mamba", "D"), "mamba.D", False),
+    (("mamba", "dt_bias"), "mamba.dt_bias", False),
+]
+
+_COMMON = [
+    (("feed_forward", "gate_proj", "kernel"), "feed_forward.gate_proj.weight", True),
+    (("feed_forward", "up_proj", "kernel"), "feed_forward.up_proj.weight", True),
+    (("feed_forward", "down_proj", "kernel"), "feed_forward.down_proj.weight", True),
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("pre_ff_layernorm", "weight"), "pre_ff_layernorm.weight", False),
+]
+
+
+def _layer_params(config: BambaConfig, i: int) -> list:
+    params = list(_ATTN if config.layer_is_attention(i) else _MAMBA)
+    if config.layer_is_attention(i):
+        if config.attention_bias:
+            params += [
+                ((("self_attn", proj, "bias")), f"self_attn.{proj}.bias", False)
+                for proj in ("q_proj", "k_proj", "v_proj", "o_proj")
+            ]
+    else:
+        if config.mamba_conv_bias:
+            params.append((("mamba", "conv_bias"), "mamba.conv1d.bias", False))
+        if config.mamba_proj_bias:
+            params += [
+                (("mamba", "in_proj", "bias"), "mamba.in_proj.bias", False),
+                (("mamba", "out_proj", "bias"), "mamba.out_proj.bias", False),
+            ]
+    if config.mlp_bias:
+        params += [
+            ((("feed_forward", proj, "bias")), f"feed_forward.{proj}.bias", False)
+            for proj in ("gate_proj", "up_proj", "down_proj")
+        ]
+    return params + _COMMON
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: BambaConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("final_layernorm", "weight"), _to_numpy(sd["final_layernorm.weight"]))
+    if not config.tie_word_embeddings:
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            put((f"layers_{i}",) + path, value.T if transpose else value)
+        if not config.layer_is_attention(i):
+            conv = _to_numpy(sd[f"layers.{i}.mamba.conv1d.weight"])
+            put((f"layers_{i}", "mamba", "conv_kernel"), conv[:, 0, :].T)
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: BambaConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.final_layernorm.weight"] = np.asarray(_get_path(p, ("final_layernorm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        if not config.layer_is_attention(i):
+            conv = np.asarray(_get_path(p, (f"layers_{i}", "mamba", "conv_kernel")))
+            out[f"model.layers.{i}.mamba.conv1d.weight"] = conv.T[:, None, :]
+    return out
+
+
+def config_to_hf(config: BambaConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["BambaForCausalLM"],
+        "model_type": "bamba",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "attn_layer_indices": config.attn_layer_indices,
+        "mamba_n_heads": config.mamba_n_heads,
+        "mamba_d_head": config.mamba_d_head,
+        "mamba_n_groups": config.mamba_n_groups,
+        "mamba_d_state": config.mamba_d_state,
+        "mamba_expand": config.mamba_expand,
+        "mamba_d_conv": config.mamba_d_conv,
+        "mamba_conv_bias": config.mamba_conv_bias,
+        "mamba_proj_bias": config.mamba_proj_bias,
+        "mamba_chunk_size": config.mamba_chunk_size,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "partial_rotary_factor": config.partial_rotary_factor,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": config.attention_dropout,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> BambaConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return BambaConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        max_position_embeddings=get("max_position_embeddings", 262144),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id", 1),
+        eos_token_id=get("eos_token_id", 2),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=get("rope_scaling"),
+        partial_rotary_factor=get("partial_rotary_factor", 0.5),
+        attention_bias=get("attention_bias", False),
+        attention_dropout=get("attention_dropout", 0.0),
+        mlp_bias=get("mlp_bias", False),
+        attn_layer_indices=list(get("attn_layer_indices") or []) or None,
+        mamba_n_heads=get("mamba_n_heads", 128),
+        mamba_d_head=get("mamba_d_head", 64),
+        mamba_n_groups=get("mamba_n_groups", 1),
+        mamba_d_state=get("mamba_d_state", 256),
+        mamba_expand=get("mamba_expand", 2),
+        mamba_d_conv=get("mamba_d_conv", 4),
+        mamba_conv_bias=get("mamba_conv_bias", True),
+        mamba_proj_bias=get("mamba_proj_bias", False),
+        mamba_chunk_size=get("mamba_chunk_size", 256),
+    ), **overrides})

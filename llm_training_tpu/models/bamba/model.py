@@ -1,0 +1,337 @@
+"""Bamba decoder (Mamba-2 SSD + attention hybrid), TPU-native.
+
+Graph verified against HF `modeling_bamba.py` (`BambaMixer.torch_forward`):
+
+- Mamba-2 mixer: one fused in_proj emits [gate | x,B,C | dt]; a depthwise
+  causal conv (kernel 4, biased) + silu runs over the x|B|C channels;
+  dt = clamp(softplus(dt + dt_bias)); A = -exp(A_log) per head; B/C are
+  grouped (GQA-style) and broadcast over heads.
+- the chunked SSD scan, written as einsums + ONE `lax.scan` (all fp32):
+  within a chunk, Y_diag = (C_i . B_j) * exp(A_cs_i - A_cs_j) applied to
+  dt-discretized x over the causal triangle; each chunk contributes a
+  [N, P] state sum(B_j * exp(A_last - A_j) (x) x_j); the cross-chunk
+  recurrence carries the state with per-chunk decay exp(A_last), and
+  Y_off = (C_i . state_prev) * exp(A_cs_i). A D skip (per head) adds the
+  raw x. Output passes the gated RMSNorm — x * silu(gate) FIRST, then
+  normalize (the Mamba-2 order, opposite of Qwen3-Next's) — and out_proj.
+- attention layers (attn_layer_indices) are llama-style GQA with PARTIAL
+  rotary (factor 0.5); every layer ends with pre_ff_layernorm + a SwiGLU
+  feed_forward.
+
+Padding mirrors HF `apply_mask_to_padding_states`: padded tokens zero at
+the mixer input and after the conv, but the SSM state decays THROUGH
+padding and across packed documents (no boundary reset — same as HF).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_training_tpu.models.bamba.config import BambaConfig
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.llama.model import LlamaMLP, RMSNorm, _dense
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+class GatedRMSNorm(nn.Module):
+    """Mamba-2 gated norm: x * silu(gate) FIRST, then RMS-normalize, then
+    weight (HF BambaRMSNormGated)."""
+
+    eps: float
+    param_dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, gate: jnp.ndarray) -> jnp.ndarray:
+        weight = self.param(
+            "weight",
+            nn.with_logical_partitioning(nn.initializers.ones, ("norm",)),
+            (x.shape[-1],),
+            self.param_dtype,
+        )
+        x32 = x.astype(jnp.float32) * jax.nn.silu(gate.astype(jnp.float32))
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (weight.astype(jnp.float32) * normed).astype(x.dtype)
+
+
+def mamba2_ssd(
+    x: jnp.ndarray,  # [B, S, H, P] raw (pre-discretization)
+    dt: jnp.ndarray,  # [B, S, H] post-softplus step sizes
+    a: jnp.ndarray,  # [H] negative decay rates
+    b_mat: jnp.ndarray,  # [B, S, H, N]
+    c_mat: jnp.ndarray,  # [B, S, H, N]
+    chunk_size: int,
+) -> jnp.ndarray:
+    """Chunked Mamba-2 SSD (HF torch_forward's 'ssd naive' branch), fp32."""
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32)
+    dt = dt.astype(jnp.float32)
+    b_mat = b_mat.astype(jnp.float32)
+    c_mat = c_mat.astype(jnp.float32)
+
+    batch, seq, heads, p = x.shape
+    xbar = x * dt[..., None]
+    abar = a.astype(jnp.float32)[None, None, :] * dt  # [B, S, H]
+
+    pad = (-seq) % chunk_size
+    if pad:
+        xbar = jnp.pad(xbar, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        b_mat = jnp.pad(b_mat, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        c_mat = jnp.pad(c_mat, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        abar = jnp.pad(abar, ((0, 0), (0, pad), (0, 0)))
+    nc = (seq + pad) // chunk_size
+    c = chunk_size
+
+    # -> [nc, B, H, c, ...] for the scan
+    def chunked(t):
+        return t.reshape(batch, nc, c, heads, -1).transpose(1, 0, 3, 2, 4)
+
+    x_s, b_s, c_s = chunked(xbar), chunked(b_mat), chunked(c_mat)
+    a_s = abar.reshape(batch, nc, c, heads).transpose(1, 0, 3, 2)  # [nc,B,H,c]
+    a_cs = jnp.cumsum(a_s, axis=-1)
+
+    tril = jnp.tril(jnp.ones((c, c), bool))
+    # L_ij = exp(sum_{k=j+1..i} abar_k) on the causal triangle
+    l_mat = jnp.where(
+        tril, jnp.exp(a_cs[..., :, None] - a_cs[..., None, :]), 0.0
+    )
+    g_mat = jnp.einsum("kbhin,kbhjn->kbhij", c_s, b_s)
+    y_diag = jnp.einsum("kbhij,kbhjp->kbhip", g_mat * l_mat, x_s)
+
+    decay_states = jnp.exp(a_cs[..., -1:] - a_cs)  # [nc,B,H,c]
+    states = jnp.einsum(
+        "kbhjn,kbhjp->kbhnp", b_s * decay_states[..., None], x_s
+    )
+    chunk_decay = jnp.exp(a_cs[..., -1])  # [nc,B,H]
+
+    def step(carry, xs):
+        states_k, decay_k = xs
+        prev = carry
+        carry = carry * decay_k[..., None, None] + states_k
+        return carry, prev
+
+    init = jnp.zeros((batch, heads, b_s.shape[-1], p), jnp.float32)
+    _, prev_states = jax.lax.scan(step, init, (states, chunk_decay))
+
+    y_off = jnp.einsum(
+        "kbhin,kbhnp->kbhip", c_s * jnp.exp(a_cs)[..., None], prev_states
+    )
+    y = y_diag + y_off  # [nc, B, H, c, P]
+    y = y.transpose(1, 0, 3, 2, 4).reshape(batch, nc * c, heads, p)[:, :seq]
+    return y.astype(in_dtype)
+
+
+class BambaMixer(nn.Module):
+    config: BambaConfig
+
+    @nn.compact
+    def __call__(self, hidden, pad_mask):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        inter = cfg.mamba_intermediate
+        heads, p = cfg.mamba_n_heads, cfg.mamba_d_head
+        groups, n = cfg.mamba_n_groups, cfg.mamba_d_state
+        conv_dim = cfg.mamba_conv_dim
+
+        if pad_mask is not None:
+            hidden = hidden * pad_mask[..., None].astype(hidden.dtype)
+
+        proj = _dense(
+            cfg, inter + conv_dim + heads, ("embed", "heads"), "in_proj",
+            cfg.mamba_proj_bias,
+        )(hidden)
+        gate = proj[..., :inter]
+        xbc = proj[..., inter:inter + conv_dim]
+        dt = proj[..., inter + conv_dim:]
+
+        # depthwise causal conv + silu over the x|B|C channels
+        conv_w = self.param(
+            "conv_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), (None, "heads")
+            ),
+            (cfg.mamba_d_conv, conv_dim),
+            cfg.param_jnp_dtype,
+        ).astype(xbc.dtype)
+        padded = jnp.pad(xbc, ((0, 0), (cfg.mamba_d_conv - 1, 0), (0, 0)))
+        conv = sum(
+            padded[:, i:i + seq] * conv_w[i] for i in range(cfg.mamba_d_conv)
+        )
+        if cfg.mamba_conv_bias:
+            conv_b = self.param(
+                "conv_bias",
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), ("heads",)),
+                (conv_dim,),
+                cfg.param_jnp_dtype,
+            )
+            conv = conv + conv_b.astype(conv.dtype)
+        xbc = jax.nn.silu(conv)
+        if pad_mask is not None:
+            xbc = xbc * pad_mask[..., None].astype(xbc.dtype)
+
+        x = xbc[..., :inter].reshape(batch, seq, heads, p)
+        b_mat = xbc[..., inter:inter + groups * n].reshape(batch, seq, groups, n)
+        c_mat = xbc[..., inter + groups * n:].reshape(batch, seq, groups, n)
+        b_mat = jnp.repeat(b_mat, heads // groups, axis=2)
+        c_mat = jnp.repeat(c_mat, heads // groups, axis=2)
+
+        a_log = self.param(
+            "A_log",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("heads",)),
+            (heads,),
+            jnp.float32,
+        )
+        dt_bias = self.param(
+            "dt_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("heads",)),
+            (heads,),
+            jnp.float32,
+        )
+        d_skip = self.param(
+            "D",
+            nn.with_logical_partitioning(nn.initializers.ones, ("heads",)),
+            (heads,),
+            jnp.float32,
+        )
+        dt = jax.nn.softplus(dt.astype(jnp.float32) + dt_bias)
+        a = -jnp.exp(a_log)
+
+        y = mamba2_ssd(x, dt, a, b_mat, c_mat, cfg.mamba_chunk_size)
+        y = y + (d_skip[None, None, :, None] * x.astype(jnp.float32)).astype(y.dtype)
+        y = y.reshape(batch, seq, inter)
+        y = GatedRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(y, gate)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "out_proj",
+                      cfg.mamba_proj_bias)(y)
+
+
+class BambaAttention(nn.Module):
+    """llama-style GQA with partial rotary (factor 0.5)."""
+
+    config: BambaConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.resolved_head_dim
+        q = _dense(cfg, heads * d, ("embed", "heads"), "q_proj",
+                   cfg.attention_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "k_proj", cfg.attention_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "v_proj", cfg.attention_bias)(hidden)
+        q = q.reshape(batch, seq, heads, d)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, d)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, d)
+        rot = int(d * cfg.partial_rotary_factor)
+        q_rot, k_rot = apply_rope(q[..., :rot], k[..., :rot], cos, sin)
+        q = jnp.concatenate([q_rot, q[..., rot:]], axis=-1)
+        k = jnp.concatenate([k_rot, k[..., rot:]], axis=-1)
+        out = dot_product_attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, heads * d)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      cfg.attention_bias)(out)
+
+
+class BambaDecoderLayer(nn.Module):
+    config: BambaConfig
+    is_attention: bool
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        pad_mask = None if segment_ids is None else segment_ids > 0
+
+        normed = norm("input_layernorm")(hidden)
+        if self.is_attention:
+            block = BambaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+        else:
+            block = BambaMixer(cfg, name="mamba")(normed, pad_mask)
+        hidden = hidden + block
+
+        normed = norm("pre_ff_layernorm")(hidden)
+        return hidden + LlamaMLP(cfg, name="feed_forward")(normed)
+
+
+class Bamba(nn.Module):
+    """Bamba causal LM with the `CausalLMProto` surface."""
+
+    config: BambaConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        policy = _remat_policy(cfg)
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = BambaDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(BambaDecoderLayer, policy=policy)
+            hidden = layer_cls(cfg, cfg.layer_is_attention(i), name=f"layers_{i}")(
+                hidden, segment_ids, cos, sin
+            )
+
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="final_layernorm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = embed_tokens.attend(hidden)
+            else:
+                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        if self.config.tie_word_embeddings:
+            return "embed_tokens/embedding"
+        return "lm_head/kernel"

@@ -37,6 +37,7 @@ _FAMILIES: dict[str, str] = {
     "GptOssConfig": "llm_training_tpu.models.gpt_oss.hf_conversion",
     "Qwen3NextConfig": "llm_training_tpu.models.qwen3_next.hf_conversion",
     "MiniMaxConfig": "llm_training_tpu.models.minimax.hf_conversion",
+    "BambaConfig": "llm_training_tpu.models.bamba.hf_conversion",
 }
 
 
@@ -248,6 +249,7 @@ _ARCH_TO_FAMILY = {
     "gpt_oss": "llm_training_tpu.models.GptOss",  # sink attention + clamped-swiglu MoE
     "qwen3_next": "llm_training_tpu.models.Qwen3Next",  # hybrid gated DeltaNet
     "minimax": "llm_training_tpu.models.MiniMax",  # hybrid lightning attention
+    "bamba": "llm_training_tpu.models.Bamba",  # Mamba-2 SSD + attention hybrid
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
     "qwen2_moe": "llm_training_tpu.models.Llama",

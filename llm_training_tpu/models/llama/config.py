@@ -150,6 +150,11 @@ class LlamaConfig(BaseModelConfig):
                     f"for {self.num_hidden_layers} layers"
                 )
             # per-layer rope on/off breaks the uniform scanned body
+            if self.scan_layers and "scan_layers" in self.model_fields_set:
+                raise ValueError(
+                    "no_rope_layers requires looped layers; set "
+                    "scan_layers=False"
+                )
             self.scan_layers = False
         self.rope_config  # construct to trigger RoPEConfig validation
         return self

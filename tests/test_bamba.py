@@ -1,0 +1,108 @@
+"""Bamba: Mamba-2 SSD + attention hybrid, HF parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_training_tpu.models.bamba import Bamba, BambaConfig
+from llm_training_tpu.models.bamba.hf_conversion import (
+    config_from_hf,
+    config_to_hf,
+    params_from_hf,
+    params_to_hf,
+)
+
+TINY = dict(
+    vocab_size=128,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    max_position_embeddings=128,
+    attn_layer_indices=[1],
+    mamba_n_heads=8,
+    mamba_d_head=8,
+    mamba_n_groups=2,
+    mamba_d_state=16,
+    mamba_expand=2,
+    mamba_d_conv=4,
+    mamba_chunk_size=8,
+    compute_dtype="float32",
+)
+
+
+def _hf_tiny(**extra):
+    torch = pytest.importorskip("torch")
+    from transformers import BambaConfig as HFConfig
+    from transformers import BambaForCausalLM
+
+    kwargs = dict(TINY)
+    kwargs.pop("compute_dtype")
+    kwargs.pop("mamba_chunk_size")
+    kwargs.update(attn_implementation="eager", **extra)
+    hf_config = HFConfig(**kwargs)
+    torch.manual_seed(0)
+    return BambaForCausalLM(hf_config).eval(), hf_config
+
+
+@pytest.mark.parametrize("seq", [6, 24])
+def test_logits_parity_with_hf(seq):
+    """SSD + attention hybrid vs HF eager ('ssd naive' torch path). seq 6
+    fits one chunk; seq 24 spans three (HF chunk 8 via our override),
+    exercising the cross-chunk state recurrence."""
+    torch = pytest.importorskip("torch")
+    hf_model, hf_config = _hf_tiny()
+    # HF's mamba_chunk_size default is 256; shrink it so multi-chunk paths
+    # run at test sizes (the chunking must not change the math)
+    hf_model.model.layers[0].mamba.chunk_size = 8
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mamba.in_proj.weight" in sd
+    assert "model.layers.1.self_attn.q_proj.weight" in sd
+    # make the decay dynamics non-trivial
+    with torch.no_grad():
+        sd["model.layers.0.mamba.A_log"].copy_(torch.linspace(-1.0, 1.0, 8))
+        sd["model.layers.0.mamba.dt_bias"].copy_(torch.linspace(-0.5, 0.5, 8))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32", mamba_chunk_size=8)
+    assert not cfg.layer_is_attention(0) and cfg.layer_is_attention(1)
+    params = params_from_hf(sd, cfg)
+    model = Bamba(cfg)
+
+    ids = np.random.default_rng(90).integers(0, 128, (2, seq))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=4e-4, atol=4e-4)
+
+
+def test_hf_round_trip():
+    hf_model, hf_config = _hf_tiny()
+    cfg = config_from_hf(hf_config)
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    back = params_to_hf(params, cfg)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    assert set(back) == set(sd)
+    for key in sd:
+        np.testing.assert_array_equal(back[key], sd[key], err_msg=key)
+
+
+def test_config_round_trip():
+    cfg = BambaConfig(**TINY)
+    hf = config_to_hf(cfg)
+    assert hf["model_type"] == "bamba"
+    cfg2 = config_from_hf(hf, compute_dtype="float32")
+    assert cfg2.model_dump() == cfg.model_dump()
+
+
+@pytest.mark.slow
+def test_e2e_fit_decreases_loss():
+    from conftest import fit_losses
+
+    losses = fit_losses(
+        "llm_training_tpu.models.Bamba",
+        dict(TINY, enable_gradient_checkpointing=True),
+        max_steps=20, lr=3e-3,
+    )
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])
