@@ -65,6 +65,11 @@ class BambaConfig(BaseModelConfig):
                 "mamba_n_heads * mamba_d_head must equal "
                 "mamba_expand * hidden_size"
             )
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be "
+                f"divisible by num_key_value_heads ({self.num_key_value_heads})"
+            )
         if self.mamba_n_heads % self.mamba_n_groups:
             raise ValueError("mamba_n_heads must be divisible by mamba_n_groups")
         if self.attn_layer_indices:

@@ -146,6 +146,7 @@ def config_to_hf(config: BambaConfig, torch_dtype: str = "bfloat16") -> dict[str
         "partial_rotary_factor": config.partial_rotary_factor,
         "attention_bias": config.attention_bias,
         "attention_dropout": config.attention_dropout,
+        "mlp_bias": config.mlp_bias,
         "use_cache": True,
         "torch_dtype": torch_dtype,
     }

@@ -233,6 +233,7 @@ _ARCH_TO_FAMILY = {
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
     "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
     "olmo2": "llm_training_tpu.models.Llama",  # + post-norm blocks, full qk-norm
+    "olmo3": "llm_training_tpu.models.Llama",  # + per-layer sliding, dual rope
     "granite": "llm_training_tpu.models.Llama",  # + 4 scalar multipliers
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope

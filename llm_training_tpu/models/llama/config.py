@@ -43,6 +43,10 @@ class LlamaConfig(BaseModelConfig):
     # Mistral/Qwen2-style local attention (None = full causal); consumed by
     # LlamaAttention via ops.dot_product_attention's sliding_window arg
     sliding_window: int | None = None
+    # OLMo-3-style per-layer 'sliding_attention' / 'full_attention' pattern;
+    # sliding layers use UNSCALED default rope, full layers the configured
+    # rope (+ rope_scaling). None = sliding_window applies to every layer.
+    layer_types: list[str] | None = None
     # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE);
     # scope 'full' is the OLMo-2/OLMoE variant (one norm over the whole
     # projected width, applied before the head reshape)
@@ -139,6 +143,26 @@ class LlamaConfig(BaseModelConfig):
                     f"num_experts_per_tok ({self.num_experts_per_tok}) must be "
                     f"in [1, num_experts={self.num_experts}]"
                 )
+        if self.layer_types is not None:
+            if len(self.layer_types) != self.num_hidden_layers:
+                raise ValueError(
+                    f"layer_types has {len(self.layer_types)} entries for "
+                    f"{self.num_hidden_layers} layers"
+                )
+            bad = set(self.layer_types) - {"sliding_attention", "full_attention"}
+            if bad:
+                raise ValueError(
+                    f"unknown layer_types entries {sorted(bad)}; expected "
+                    "'sliding_attention' or 'full_attention'"
+                )
+            if "sliding_attention" in self.layer_types and not self.sliding_window:
+                raise ValueError("sliding layer_types require sliding_window")
+            # per-layer windows/ropes break the uniform scanned body
+            if self.scan_layers and "scan_layers" in self.model_fields_set:
+                raise ValueError(
+                    "layer_types requires looped layers; set scan_layers=False"
+                )
+            self.scan_layers = False
         if self.no_rope_layers is not None:
             if self.position_embedding_type == "learned":
                 raise ValueError(
@@ -173,3 +197,21 @@ class LlamaConfig(BaseModelConfig):
             int(self.resolved_head_dim * self.partial_rotary_factor),
             self.max_position_embeddings,
         )
+
+    @property
+    def local_rope_config(self) -> RoPEConfig:
+        """OLMo-3 sliding layers: same theta, NEVER scaled."""
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            None, self.rope_theta,
+            int(self.resolved_head_dim * self.partial_rotary_factor),
+            self.max_position_embeddings,
+        )
+
+    def layer_sliding_window(self, layer_idx: int) -> int | None:
+        if self.layer_types is None:
+            return self.sliding_window
+        if self.layer_types[layer_idx] == "sliding_attention":
+            return self.sliding_window
+        return None

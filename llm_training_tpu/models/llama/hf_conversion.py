@@ -644,6 +644,14 @@ def _check_exportable(config: LlamaConfig) -> None:
                 "HunYuan has ONE attention_bias flag covering q/k/v/o; "
                 "asymmetric attention biases cannot be exported"
             )
+    if config.layer_types is not None and not (
+        config.norm_scheme == "post" and config.qk_norm
+        and config.qk_norm_scope == "full"
+    ):
+        raise ValueError(
+            "per-layer sliding layer_types only exist in HF as OLMo-3 "
+            "(post-norm + full qk-norm); this combination cannot be exported"
+        )
     if config.no_rope_layers is not None and not (
         config.norm_type == "rmsnorm" and config.mlp_type == "swiglu"
         and config.norm_scheme == "pre" and not config.rope_interleaved
@@ -735,10 +743,18 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             if config.qk_norm and config.qk_norm_scope == "head"
             else {}
         ),
-        # post-norm blocks + full-width qk-norm only exist as OLMo-2 in HF
+        # post-norm blocks + full-width qk-norm only exist as OLMo-2 in HF;
+        # with a per-layer sliding pattern they are OLMo-3
         **(
             {"model_type": "olmo2", "architectures": ["Olmo2ForCausalLM"]}
-            if config.norm_scheme == "post"
+            if config.norm_scheme == "post" and config.layer_types is None
+            else {}
+        ),
+        **(
+            {"model_type": "olmo3", "architectures": ["Olmo3ForCausalLM"],
+             "layer_types": list(config.layer_types),
+             "sliding_window": config.sliding_window}
+            if config.norm_scheme == "post" and config.layer_types is not None
             else {}
         ),
         # interleaved rope + fused gate_up under pre/sandwich norms only
@@ -1064,6 +1080,11 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             else get("mlp_bias", False)
         ),
         rope_scaling=get("rope_scaling"),
+        # OLMo-3 carries an explicit per-layer sliding/full pattern
+        layer_types=(
+            list(get("layer_types") or []) or None
+            if model_type == "olmo3" else None
+        ),
         # Mistral sets sliding_window unconditionally; the Qwen families gate
         # it behind use_sliding_window (default False)
         sliding_window=(
@@ -1080,15 +1101,17 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         ),
         qk_norm=(
             get("use_qk_norm", False) if model_type == "cohere"
-            else model_type in ("qwen3", "olmo2", "qwen3_moe", "olmoe",
-                                "hunyuan_v1_dense")
+            else model_type in ("qwen3", "olmo2", "olmo3", "qwen3_moe",
+                                "olmoe", "hunyuan_v1_dense")
         ),
         qk_norm_position=(
             "post_rope" if model_type == "hunyuan_v1_dense" else "pre_rope"
         ),
-        qk_norm_scope="full" if model_type in ("olmo2", "olmoe") else "head",
+        qk_norm_scope=(
+            "full" if model_type in ("olmo2", "olmo3", "olmoe") else "head"
+        ),
         norm_scheme=(
-            "post" if model_type == "olmo2"
+            "post" if model_type in ("olmo2", "olmo3")
             else "parallel" if model_type in ("cohere", "phi")
             else "sandwich" if model_type == "glm4"
             else "pre"

@@ -129,6 +129,10 @@ def _dense(config: LlamaConfig, features: int, logical_axes: tuple[str, str], na
 class LlamaAttention(nn.Module):
     """GQA attention (reference `llama_model.py:434-663`).
 
+    `sliding_window_override` carries the per-layer window for layer_types
+    models (set by the looped `_layers`; the scanned path never uses it —
+    "unset" means fall back to config.sliding_window).
+
     q/k/v projections are colwise-parallel ('heads'/'kv_heads' → tensor axis),
     o_proj rowwise ('embed' output) — the reference TP plan
     (`llama_model.py:197-244`) via logical axes.
@@ -138,6 +142,7 @@ class LlamaAttention(nn.Module):
     upcast workaround, `phi3_model.py:172-187`)."""
 
     config: LlamaConfig
+    sliding_window_override: int | None | str = "unset"
 
     @nn.compact
     def __call__(
@@ -281,11 +286,16 @@ class LlamaAttention(nn.Module):
                     out_specs=spec_qkv,
                     check_vma=False,
                 )(q, k, v, segment_ids)
+        window = (
+            getattr(cfg, "sliding_window", None)
+            if self.sliding_window_override == "unset"
+            else self.sliding_window_override
+        )
         return dot_product_attention(
             q, k, v,
             segment_ids=segment_ids,
             causal=True,
-            sliding_window=getattr(cfg, "sliding_window", None),
+            sliding_window=window,
             # Granite replaces 1/sqrt(head_dim) with a config scalar
             scale=getattr(cfg, "attention_multiplier", None),
             impl=cfg.attention_impl,
@@ -321,6 +331,7 @@ class LlamaDecoderLayer(nn.Module):
     """Pre-norm block (reference `llama_model.py:747-789`)."""
 
     config: LlamaConfig
+    sliding_window_override: int | None | str = "unset"
 
     @nn.compact
     def __call__(
@@ -333,6 +344,10 @@ class LlamaDecoderLayer(nn.Module):
         cfg = self.config
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
         norm = lambda name: _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+
+        attention = lambda name: LlamaAttention(
+            cfg, self.sliding_window_override, name=name
+        )
 
         def mlp(x):
             """(out, aux): MoE block returns per-layer router stats
@@ -354,14 +369,14 @@ class LlamaDecoderLayer(nn.Module):
             # Cohere: ONE input norm feeds attention and mlp; both outputs
             # join the residual in a single add
             normed = norm("input_layernorm")(hidden)
-            attn = LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+            attn = attention("self_attn")(normed, segment_ids, cos, sin)
             mlp_out, aux = mlp(normed)
             hidden = hidden + join(attn) + join(mlp_out)
             return hidden, aux
         if cfg.norm_scheme == "sandwich":
             # GLM-4: pre-norm AND output-norm around both blocks
             normed = norm("input_layernorm")(hidden)
-            attn = LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
+            attn = attention("self_attn")(normed, segment_ids, cos, sin)
             hidden = hidden + join(norm("post_self_attn_layernorm")(attn))
             normed = norm("post_attention_layernorm")(hidden)
             mlp_out, aux = mlp(normed)
@@ -370,13 +385,13 @@ class LlamaDecoderLayer(nn.Module):
         if cfg.norm_scheme == "post":
             # OLMo-2 reordering: no input norms; normalize each block's
             # OUTPUT before it joins the residual stream
-            attn = LlamaAttention(cfg, name="self_attn")(hidden, segment_ids, cos, sin)
+            attn = attention("self_attn")(hidden, segment_ids, cos, sin)
             hidden = hidden + join(norm("post_attention_layernorm")(attn))
             mlp_out, aux = mlp(hidden)
             hidden = hidden + join(norm("post_feedforward_layernorm")(mlp_out))
             return hidden, aux
         normed = norm("input_layernorm")(hidden)
-        hidden = hidden + join(LlamaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin))
+        hidden = hidden + join(attention("self_attn")(normed, segment_ids, cos, sin))
         normed = norm("post_attention_layernorm")(hidden)
         mlp_out, aux = mlp(normed)
         hidden = hidden + join(mlp_out)
@@ -408,7 +423,7 @@ class Llama(nn.Module):
 
     config: LlamaConfig
 
-    def _layers(self, hidden, segment_ids, cos, sin):
+    def _layers(self, hidden, segment_ids, cos, sin, local_cos=None, local_sin=None):
         """Returns (hidden, aux_loss). For MoE configs the per-layer router
         stats (sel_frac, mean_prob) are pooled across depth BEFORE the
         E * sum(f * P) product — matching HF `load_balancing_loss_func`,
@@ -438,16 +453,25 @@ class Llama(nn.Module):
                 # variation, so conversion/remat stay uniform
                 id_cos = jnp.ones_like(cos)
                 id_sin = jnp.zeros_like(sin)
+            layer_types = getattr(cfg, "layer_types", None)
             stats = []
             for i in range(cfg.num_hidden_layers):
                 layer_cls = LlamaDecoderLayer
                 if policy is not None:
                     layer_cls = nn.remat(LlamaDecoderLayer, policy=policy)
                 use_rope = no_rope is None or bool(no_rope[i])
-                hidden, layer_aux = layer_cls(cfg, name=f"layers_{i}")(
-                    hidden, segment_ids,
-                    cos if use_rope else id_cos,
-                    sin if use_rope else id_sin,
+                window = (
+                    cfg.layer_sliding_window(i) if layer_types is not None
+                    else "unset"
+                )
+                lcos, lsin = cos, sin
+                if not use_rope:
+                    lcos, lsin = id_cos, id_sin
+                elif layer_types is not None and window and local_cos is not None:
+                    # OLMo-3: sliding layers rotate with the UNSCALED tables
+                    lcos, lsin = local_cos, local_sin
+                hidden, layer_aux = layer_cls(cfg, window, name=f"layers_{i}")(
+                    hidden, segment_ids, lcos, lsin,
                 )
                 stats.append(layer_aux)
             aux = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
@@ -530,7 +554,22 @@ class Llama(nn.Module):
             cos = jnp.repeat(cos[..., :half], 2, axis=-1)
             sin = jnp.repeat(sin[..., :half], 2, axis=-1)
 
-        hidden, aux_loss = self._layers(hidden, segment_ids, cos, sin)
+        local_cos = local_sin = None
+        if getattr(cfg, "layer_types", None) is not None and cfg.rope_scaling:
+            # sliding layers use the UNSCALED default tables (OLMo-3)
+            inv_freq_l, scaling_l = compute_rope_frequencies(
+                cfg.local_rope_config, seq_len=seq
+            )
+            local_cos, local_sin = compute_rope_cos_sin(
+                inv_freq_l, position_ids, scaling_l
+            )
+            if getattr(cfg, "rope_interleaved", False):
+                half = local_cos.shape[-1] // 2
+                local_cos = jnp.repeat(local_cos[..., :half], 2, axis=-1)
+                local_sin = jnp.repeat(local_sin[..., :half], 2, axis=-1)
+        hidden, aux_loss = self._layers(
+            hidden, segment_ids, cos, sin, local_cos, local_sin
+        )
         hidden = _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         mult = getattr(cfg, "logit_scale", None)
         if mult is not None:

@@ -915,3 +915,39 @@ def test_logits_parity_with_hf_smollm3():
         hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
     ours = model.apply(params, jnp.asarray(ids)).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_olmo3():
+    """OLMo-3 routes to the Llama module: OLMo-2's post-norm + full qk-norm
+    plus a per-layer sliding/full pattern with DUAL rope tables — sliding
+    layers rotate unscaled, full layers with the configured rope_scaling."""
+    torch = pytest.importorskip("torch")
+    from transformers import Olmo3Config, Olmo3ForCausalLM
+
+    hf_config = Olmo3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        rope_scaling={"rope_type": "yarn", "factor": 4.0},
+        attn_implementation="eager",
+    )
+    assert hf_config.layer_types == [
+        "sliding_attention", "sliding_attention", "sliding_attention",
+        "full_attention",
+    ]
+    torch.manual_seed(0)
+    hf_model = Olmo3ForCausalLM(hf_config).eval()
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == "post" and cfg.qk_norm_scope == "full"
+    assert cfg.layer_sliding_window(0) == 8 and cfg.layer_sliding_window(3) is None
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Llama(cfg)
+
+    # 24 > sliding_window so local attention truncates, and yarn is live on
+    # the full layer only
+    ids = np.random.default_rng(49).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
