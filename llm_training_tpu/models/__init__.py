@@ -13,6 +13,7 @@ from llm_training_tpu.models.bamba import Bamba, BambaConfig
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
 from llm_training_tpu.models.deepseek import Deepseek, DeepseekConfig
 from llm_training_tpu.models.gemma import Gemma, GemmaConfig
+from llm_training_tpu.models.glm4_moe import Glm4Moe, Glm4MoeConfig
 from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
@@ -29,6 +30,8 @@ __all__ = [
     "DeepseekConfig",
     "Gemma",
     "GemmaConfig",
+    "Glm4Moe",
+    "Glm4MoeConfig",
     "GptOss",
     "GptOssConfig",
     "HFCausalLM",

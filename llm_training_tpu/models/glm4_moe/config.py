@@ -1,0 +1,95 @@
+"""GLM-4.5 (glm4_moe) model config.
+
+Family member beyond the reference's named models (reached by the reference
+only through torch wrapping, `hf_causal_lm.py:22`). Mirrors HF
+`Glm4MoeConfig`: standard GQA attention with partial rotary and optional
+per-head qk-norm, plus the DeepSeek-V3-style noaux MoE — the MoE field
+names match what `models.deepseek.model.DeepseekMoE` reads, so the block is
+reused directly (`version` is pinned to 3 for the sigmoid router).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class Glm4MoeConfig(BaseModelConfig):
+    vocab_size: int = 151552
+    hidden_size: int = 4096
+    intermediate_size: int = 10944  # dense layers (and the MoE-free prefix)
+    num_hidden_layers: int = 46
+    num_attention_heads: int = 96
+    num_key_value_heads: int = 8
+    head_dim: int = 128
+    max_position_embeddings: int = 131072
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-5
+    pad_token_id: int | None = None
+    bos_token_id: int | None = None
+    eos_token_id: int | list[int] | None = None
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    partial_rotary_factor: float = 0.5
+    attention_bias: bool = False
+    attention_dropout: float = 0.0
+    use_qk_norm: bool = False  # per-head RMSNorm (GLM-4.5-Air)
+
+    # --- DeepSeek-V3-style MoE (field names shared with DeepseekMoE)
+    version: Literal[3] = 3  # sigmoid router + noaux bias, always
+    n_routed_experts: int = 128
+    n_shared_experts: int = 1
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int | None = None
+    first_k_dense_replace: int = 1
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
+    n_group: int | None = None
+    topk_group: int | None = None
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    scan_layers: bool = False  # dense prefix makes the stack non-uniform
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "Glm4MoeConfig":
+        if self.attention_dropout != 0.0:
+            raise ValueError("attention_dropout is not supported; set it to 0.0")
+        if self.scan_layers:
+            raise ValueError("glm4_moe layers are looped; set scan_layers=False")
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be "
+                f"divisible by num_key_value_heads ({self.num_key_value_heads})"
+            )
+        if self.moe_intermediate_size is None:
+            raise ValueError("glm4_moe requires moe_intermediate_size")
+        if self.n_group is not None:
+            if self.n_routed_experts % self.n_group:
+                raise ValueError("n_routed_experts must divide into n_group groups")
+            if self.topk_group is None:
+                raise ValueError("n_group requires topk_group")
+        self.rope_config
+        return self
+
+    # DeepseekMoE reads cfg.num_experts... no — it reads n_routed_experts;
+    # keep parity with its expectations via identical field names above.
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta,
+            int(self.head_dim * self.partial_rotary_factor),
+            self.max_position_embeddings,
+        )
+
+    def layer_is_moe(self, layer_idx: int) -> bool:
+        return layer_idx >= self.first_k_dense_replace

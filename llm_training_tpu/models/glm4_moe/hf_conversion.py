@@ -1,0 +1,206 @@
+"""GLM-4.5 <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to GLM-4.5
+(reached by the reference only through torch wrapping, `hf_causal_lm.py:22`).
+The MoE key layout is DeepSeek's (gate + e_score_correction_bias + per-expert
+projections + shared_experts); the attention is plain GQA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.glm4_moe.config import Glm4MoeConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+
+_ATTN = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+]
+
+_ATTN_BIASES = [
+    (("self_attn", "q_proj", "bias"), "self_attn.q_proj.bias", False),
+    (("self_attn", "k_proj", "bias"), "self_attn.k_proj.bias", False),
+    (("self_attn", "v_proj", "bias"), "self_attn.v_proj.bias", False),
+]
+
+_QK_NORMS = [
+    (("self_attn", "q_norm", "weight"), "self_attn.q_norm.weight", False),
+    (("self_attn", "k_norm", "weight"), "self_attn.k_norm.weight", False),
+]
+
+_DENSE_MLP = [
+    (("mlp", "gate_proj", "kernel"), "mlp.gate_proj.weight", True),
+    (("mlp", "up_proj", "kernel"), "mlp.up_proj.weight", True),
+    (("mlp", "down_proj", "kernel"), "mlp.down_proj.weight", True),
+]
+
+_SHARED_MLP = [
+    (("mlp", "shared_experts", "gate_proj", "kernel"), "mlp.shared_experts.gate_proj.weight", True),
+    (("mlp", "shared_experts", "up_proj", "kernel"), "mlp.shared_experts.up_proj.weight", True),
+    (("mlp", "shared_experts", "down_proj", "kernel"), "mlp.shared_experts.down_proj.weight", True),
+]
+
+_NORMS = [
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+_EXPERT_PROJS = ("gate_proj", "up_proj", "down_proj")
+
+
+def _layer_params(config: Glm4MoeConfig, i: int) -> list:
+    params = list(_ATTN)
+    if config.attention_bias:
+        # HF gates q/k/v biases on attention_bias (o_proj stays bias-free)
+        params += _ATTN_BIASES
+    if config.use_qk_norm:
+        params += _QK_NORMS
+    if not config.layer_is_moe(i):
+        params += _DENSE_MLP
+    else:
+        params += _SHARED_MLP
+        params.append((("mlp", "gate_kernel"), "mlp.gate.weight", True))
+        params.append(
+            (("mlp", "e_score_correction_bias"), "mlp.gate.e_score_correction_bias", False)
+        )
+    return params + _NORMS
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: Glm4MoeConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            put((f"layers_{i}",) + path, value.T if transpose else value)
+        if config.layer_is_moe(i):
+            for proj in _EXPERT_PROJS:
+                put(
+                    (f"layers_{i}", "mlp", f"experts_{proj}"),
+                    np.stack([
+                        _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
+                        for e in range(config.n_routed_experts)
+                    ]),
+                )
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: Glm4MoeConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        if config.layer_is_moe(i):
+            for proj in _EXPERT_PROJS:
+                stacked = np.asarray(
+                    _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}"))
+                )
+                for e in range(config.n_routed_experts):
+                    out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+    return out
+
+
+def config_to_hf(config: Glm4MoeConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["Glm4MoeForCausalLM"],
+        "model_type": "glm4_moe",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "moe_intermediate_size": config.moe_intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.head_dim,
+        "partial_rotary_factor": config.partial_rotary_factor,
+        "use_qk_norm": config.use_qk_norm,
+        "n_routed_experts": config.n_routed_experts,
+        "n_shared_experts": config.n_shared_experts,
+        "num_experts_per_tok": config.num_experts_per_tok,
+        "first_k_dense_replace": config.first_k_dense_replace,
+        "norm_topk_prob": config.norm_topk_prob,
+        "routed_scaling_factor": config.routed_scaling_factor,
+        "n_group": config.n_group,
+        "topk_group": config.topk_group,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "attention_bias": config.attention_bias,
+        "attention_dropout": config.attention_dropout,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> Glm4MoeConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return Glm4MoeConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        moe_intermediate_size=get("moe_intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim", 128),
+        max_position_embeddings=get("max_position_embeddings", 131072),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id"),
+        eos_token_id=get("eos_token_id"),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=get("rope_scaling"),
+        partial_rotary_factor=get("partial_rotary_factor", 0.5),
+        attention_bias=get("attention_bias", False),
+        attention_dropout=get("attention_dropout", 0.0),
+        use_qk_norm=get("use_qk_norm", False),
+        n_routed_experts=get("n_routed_experts", 128),
+        n_shared_experts=get("n_shared_experts", 1),
+        num_experts_per_tok=get("num_experts_per_tok", 8),
+        first_k_dense_replace=get("first_k_dense_replace", 1),
+        norm_topk_prob=get("norm_topk_prob", True),
+        routed_scaling_factor=get("routed_scaling_factor", 1.0),
+        n_group=get("n_group"),
+        topk_group=get("topk_group"),
+    ), **overrides})

@@ -1,0 +1,152 @@
+"""GLM-4.5 decoder, TPU-native.
+
+Graph verified against HF `modeling_glm4_moe.py`: standard pre-norm GQA
+attention with partial rotary (factor 0.5, half-rotation pairing — unlike
+dense GLM-4, NOT interleaved) and optional per-head qk-norm, plus the
+DeepSeek-V3-style noaux MoE (sigmoid router + e_score_correction_bias +
+top-2-sum group selection, always-on shared experts, dense layer prefix) —
+the MoE block is `models.deepseek.model.DeepseekMoE`, reused as-is.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.deepseek.model import DeepseekMLP, DeepseekMoE
+from llm_training_tpu.models.glm4_moe.config import Glm4MoeConfig
+from llm_training_tpu.models.llama.model import RMSNorm, _dense
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+class Glm4MoeAttention(nn.Module):
+    config: Glm4MoeConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.head_dim
+        q = _dense(cfg, heads * d, ("embed", "heads"), "q_proj",
+                   cfg.attention_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "k_proj", cfg.attention_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "v_proj", cfg.attention_bias)(hidden)
+        q = q.reshape(batch, seq, heads, d)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, d)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, d)
+        if cfg.use_qk_norm:
+            q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
+            k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+        rot = int(d * cfg.partial_rotary_factor)
+        q_rot, k_rot = apply_rope(q[..., :rot], k[..., :rot], cos, sin)
+        q = jnp.concatenate([q_rot, q[..., rot:]], axis=-1)
+        k = jnp.concatenate([k_rot, k[..., rot:]], axis=-1)
+        out = dot_product_attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, heads * d)
+        # HF GLM-4.5 biases q/k/v but NEVER o_proj (released checkpoints set
+        # attention_bias=true)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      False)(out)
+
+
+class Glm4MoeDecoderLayer(nn.Module):
+    config: Glm4MoeConfig
+    is_moe: bool
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        normed = norm("input_layernorm")(hidden)
+        hidden = hidden + Glm4MoeAttention(cfg, name="self_attn")(
+            normed, segment_ids, cos, sin
+        )
+        normed = norm("post_attention_layernorm")(hidden)
+        if self.is_moe:
+            mlp_out = DeepseekMoE(cfg, name="mlp")(normed)
+        else:
+            mlp_out = DeepseekMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
+        return hidden + mlp_out
+
+
+class Glm4Moe(nn.Module):
+    """GLM-4.5 causal LM with the `CausalLMProto` surface."""
+
+    config: Glm4MoeConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        policy = _remat_policy(cfg)
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = Glm4MoeDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(Glm4MoeDecoderLayer, policy=policy)
+            hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+                hidden, segment_ids, cos, sin
+            )
+
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = embed_tokens.attend(hidden)
+            else:
+                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        if self.config.tie_word_embeddings:
+            return "embed_tokens/embedding"
+        return "lm_head/kernel"

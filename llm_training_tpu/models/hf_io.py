@@ -38,6 +38,7 @@ _FAMILIES: dict[str, str] = {
     "Qwen3NextConfig": "llm_training_tpu.models.qwen3_next.hf_conversion",
     "MiniMaxConfig": "llm_training_tpu.models.minimax.hf_conversion",
     "BambaConfig": "llm_training_tpu.models.bamba.hf_conversion",
+    "Glm4MoeConfig": "llm_training_tpu.models.glm4_moe.hf_conversion",
 }
 
 
@@ -245,6 +246,7 @@ _ARCH_TO_FAMILY = {
     "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
+    "glm4_moe": "llm_training_tpu.models.Glm4Moe",  # GLM-4.5: V3-style noaux MoE
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
     "gpt_oss": "llm_training_tpu.models.GptOss",  # sink attention + clamped-swiglu MoE
