@@ -56,6 +56,24 @@ def _get_path_or_none(tree: Any, path: str) -> jnp.ndarray | None:
         return None
 
 
+def head_and_bias(model: Any, p: Any) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+    """(lm-head matrix [embed, vocab], optional bias [vocab]) for the fused
+    CE/log-prob objectives. Handles tied embeddings (transposed), explicit
+    standalone bias paths (get_output_bias_path — e.g. a bias riding on a
+    TIED head), and the Phi-style bias-next-to-kernel convention."""
+    head_path = model.get_output_embeddings_path()
+    head = _get_path(p, head_path)
+    bias_path = getattr(model, "get_output_bias_path", lambda: None)()
+    if head_path == model.get_input_embeddings_path():
+        head = head.T  # tied embeddings: [vocab, embed] -> [embed, vocab]
+        bias = _get_path(p, bias_path) if bias_path else None
+    elif bias_path:
+        bias = _get_path(p, bias_path)
+    else:
+        bias = _get_path_or_none(p, head_path.rsplit("/", 1)[0] + "/bias")
+    return head, bias
+
+
 class CLM:
     """The CLM objective as a pure-function bundle.
 
@@ -133,14 +151,7 @@ class CLM:
             compute_logits=False,
             return_last_hidden_states=True,
         )
-        head_path = model.get_output_embeddings_path()
-        head = _get_path(p, head_path)
-        if head_path == model.get_input_embeddings_path():
-            head = head.T  # tied embeddings: [vocab, embed] -> [embed, vocab]
-            head_bias = None
-        else:
-            # Phi-style heads carry a bias next to the kernel
-            head_bias = _get_path_or_none(p, head_path.rsplit("/", 1)[0] + "/bias")
+        head, head_bias = head_and_bias(model, p)
         total, count = fused_linear_cross_entropy(
             out.last_hidden_states,
             head.astype(out.last_hidden_states.dtype),

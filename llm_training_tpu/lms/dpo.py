@@ -122,14 +122,9 @@ class DPO:
             return_last_hidden_states=True,
         )
         p = params["params"] if "params" in params else params
-        head_path = model.get_output_embeddings_path()
-        head = _get_path(p, head_path)
-        if head_path == model.get_input_embeddings_path():
-            head = head.T
-            head_bias = None
-        else:
-            # Phi-style heads carry a bias next to the kernel
-            head_bias = _get_path_or_none(p, head_path.rsplit("/", 1)[0] + "/bias")
+        from llm_training_tpu.lms.clm import head_and_bias
+
+        head, head_bias = head_and_bias(model, p)
         logps, counts = fused_linear_log_probs(
             out.last_hidden_states,
             head.astype(out.last_hidden_states.dtype),

@@ -12,6 +12,7 @@ families. Here, models are flax.linen Modules whose parameters carry
 from llm_training_tpu.models.bamba import Bamba, BambaConfig
 from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
 from llm_training_tpu.models.deepseek import Deepseek, DeepseekConfig
+from llm_training_tpu.models.ernie45_moe import Ernie45Moe, Ernie45MoeConfig
 from llm_training_tpu.models.gemma import Gemma, GemmaConfig
 from llm_training_tpu.models.glm4_moe import Glm4Moe, Glm4MoeConfig
 from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
@@ -28,6 +29,8 @@ __all__ = [
     "CausalLMOutput",
     "Deepseek",
     "DeepseekConfig",
+    "Ernie45Moe",
+    "Ernie45MoeConfig",
     "Gemma",
     "GemmaConfig",
     "Glm4Moe",

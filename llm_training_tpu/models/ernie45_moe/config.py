@@ -1,0 +1,95 @@
+"""Ernie 4.5 MoE model config.
+
+Family member beyond the reference's named models (reached by the reference
+only through torch wrapping, `hf_causal_lm.py:22`). Mirrors HF
+`Ernie4_5_MoeConfig`: the dense-Ernie attention (GLM-style interleaved
+full-dim rope, one use_bias flag over q/k/v/o) with a softmax router whose
+SELECTION adds the aux-free e_score_correction_bias (combine weights stay
+raw softmax probabilities, renormalized with a norm_min clamp), plus
+gate-free dense shared experts and a dense layer prefix.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class Ernie45MoeConfig(BaseModelConfig):
+    vocab_size: int = 103424
+    hidden_size: int = 2560
+    intermediate_size: int = 12288  # dense layers (and the MoE-free prefix)
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 20
+    num_key_value_heads: int = 4
+    head_dim: int | None = None
+    max_position_embeddings: int = 131072
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-5
+    pad_token_id: int | None = None
+    bos_token_id: int | None = 1
+    eos_token_id: int | list[int] | None = 2
+    tie_word_embeddings: bool = True
+    rope_theta: float = 500000.0
+    rope_scaling: dict[str, Any] | None = None
+    use_bias: bool = False  # q/k/v/o together, like dense Ernie
+
+    # --- MoE
+    moe_num_experts: int = 64
+    moe_k: int = 6
+    moe_intermediate_size: int | None = None
+    moe_num_shared_experts: int = 0  # dense gate-free shared experts
+    moe_layer_start_index: int = 1
+    moe_layer_end_index: int = -1  # -1 = last layer (HF semantics)
+    moe_layer_interval: int = 1
+    moe_norm_min: float = 1e-12
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    scan_layers: bool = False  # dense prefix makes the stack non-uniform
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "Ernie45MoeConfig":
+        if self.scan_layers:
+            raise ValueError("ernie4_5_moe layers are looped; set scan_layers=False")
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be "
+                f"divisible by num_key_value_heads ({self.num_key_value_heads})"
+            )
+        if self.moe_intermediate_size is None:
+            raise ValueError("ernie4_5_moe requires moe_intermediate_size")
+        if self.moe_k > self.moe_num_experts:
+            raise ValueError("moe_k exceeds moe_num_experts")
+        self.rope_config
+        return self
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.resolved_head_dim,
+            self.max_position_embeddings,
+        )
+
+    def layer_is_moe(self, layer_idx: int) -> bool:
+        """HF gate: (i + 1) % interval == 0 within [start, end]."""
+        end = (
+            self.moe_layer_end_index
+            if self.moe_layer_end_index >= 0
+            else self.num_hidden_layers - 1
+        )
+        return (
+            self.moe_layer_start_index <= layer_idx <= end
+            and (layer_idx + 1) % self.moe_layer_interval == 0
+        )

@@ -1,0 +1,222 @@
+"""Ernie 4.5 MoE <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to Ernie
+4.5 MoE (reached by the reference only through torch wrapping,
+`hf_causal_lm.py:22`). The selection bias lives under `mlp.moe_statics`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.ernie45_moe.config import Ernie45MoeConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+
+_EXPERT_PROJS = ("gate_proj", "up_proj", "down_proj")
+
+_NORMS = [
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+_DENSE_MLP = [
+    (("mlp", "gate_proj", "kernel"), "mlp.gate_proj.weight", True),
+    (("mlp", "up_proj", "kernel"), "mlp.up_proj.weight", True),
+    (("mlp", "down_proj", "kernel"), "mlp.down_proj.weight", True),
+]
+
+_SHARED_MLP = [
+    (("mlp", "shared_experts", "gate_proj", "kernel"), "mlp.shared_experts.gate_proj.weight", True),
+    (("mlp", "shared_experts", "up_proj", "kernel"), "mlp.shared_experts.up_proj.weight", True),
+    (("mlp", "shared_experts", "down_proj", "kernel"), "mlp.shared_experts.down_proj.weight", True),
+]
+
+
+def _layer_params(config: Ernie45MoeConfig, i: int) -> list:
+    params = [
+        (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+        (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+        (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+        (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+    ]
+    if config.use_bias:
+        params += [
+            (("self_attn", proj, "bias"), f"self_attn.{proj}.bias", False)
+            for proj in ("q_proj", "k_proj", "v_proj", "o_proj")
+        ]
+    def _mlp_biases(prefix_ours, prefix_hf):
+        return [
+            (prefix_ours + (proj, "bias"), f"{prefix_hf}.{proj}.bias", False)
+            for proj in ("gate_proj", "up_proj", "down_proj")
+        ]
+
+    if not config.layer_is_moe(i):
+        params += _DENSE_MLP
+        if config.use_bias:
+            params += _mlp_biases(("mlp",), "mlp")
+    else:
+        params.append((("mlp", "gate_kernel"), "mlp.gate.weight", True))
+        params.append((
+            ("mlp", "e_score_correction_bias"),
+            "mlp.moe_statics.e_score_correction_bias",
+            False,
+        ))
+        if config.moe_num_shared_experts:
+            params += _SHARED_MLP
+            if config.use_bias:
+                params += _mlp_biases(("mlp", "shared_experts"), "mlp.shared_experts")
+    return params + _NORMS
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: Ernie45MoeConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+    if config.use_bias:
+        put(("lm_head_bias",), _to_numpy(sd["lm_head.bias"]))
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+            if path[-1] == "e_score_correction_bias":
+                value = value.reshape(-1)  # HF stores [1, E]
+            put((f"layers_{i}",) + path, value.T if transpose else value)
+        if config.layer_is_moe(i):
+            for proj in _EXPERT_PROJS:
+                put(
+                    (f"layers_{i}", "mlp", f"experts_{proj}"),
+                    np.stack([
+                        _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
+                        for e in range(config.moe_num_experts)
+                    ]),
+                )
+                if config.use_bias:
+                    put(
+                        (f"layers_{i}", "mlp", f"experts_{proj}_bias"),
+                        np.stack([
+                            _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.bias"])
+                            for e in range(config.moe_num_experts)
+                        ]),
+                    )
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: Ernie45MoeConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+    else:
+        # HF materializes the tied view in its state dicts
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    if config.use_bias:
+        out["lm_head.bias"] = np.asarray(_get_path(p, ("lm_head_bias",)))
+
+    for i in range(config.num_hidden_layers):
+        for path, hf_name, transpose in _layer_params(config, i):
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            if path[-1] == "e_score_correction_bias":
+                value = value.reshape(1, -1)  # HF stores [1, E]
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        if config.layer_is_moe(i):
+            for proj in _EXPERT_PROJS:
+                stacked = np.asarray(
+                    _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}"))
+                )
+                for e in range(config.moe_num_experts):
+                    out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+                if config.use_bias:
+                    bias = np.asarray(
+                        _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}_bias"))
+                    )
+                    for e in range(config.moe_num_experts):
+                        out[f"model.layers.{i}.mlp.experts.{e}.{proj}.bias"] = bias[e]
+    return out
+
+
+def config_to_hf(config: Ernie45MoeConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["Ernie4_5_MoeForCausalLM"],
+        "model_type": "ernie4_5_moe",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "moe_intermediate_size": config.moe_intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.resolved_head_dim,
+        "moe_num_experts": config.moe_num_experts,
+        "moe_k": config.moe_k,
+        "moe_num_shared_experts": config.moe_num_shared_experts,
+        "moe_layer_start_index": config.moe_layer_start_index,
+        "moe_layer_end_index": config.moe_layer_end_index,
+        "moe_layer_interval": config.moe_layer_interval,
+        "moe_norm_min": config.moe_norm_min,
+        "use_bias": config.use_bias,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> Ernie45MoeConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    return Ernie45MoeConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        moe_intermediate_size=get("moe_intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim"),
+        max_position_embeddings=get("max_position_embeddings", 131072),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id", 1),
+        eos_token_id=get("eos_token_id", 2),
+        tie_word_embeddings=get("tie_word_embeddings", True),
+        rope_theta=get("rope_theta", 500000.0),
+        rope_scaling=get("rope_scaling"),
+        use_bias=get("use_bias", False),
+        moe_num_experts=get("moe_num_experts", 64),
+        moe_k=get("moe_k", 6),
+        moe_num_shared_experts=get("moe_num_shared_experts", 2),
+        moe_layer_start_index=get("moe_layer_start_index", 1),
+        moe_layer_end_index=get("moe_layer_end_index", -1),
+        moe_layer_interval=get("moe_layer_interval", 1),
+        moe_norm_min=get("moe_norm_min", 1e-12),
+    ), **overrides})

@@ -1,0 +1,286 @@
+"""Ernie 4.5 MoE decoder, TPU-native.
+
+Graph verified against HF `modeling_ernie4_5_moe.py`: dense-Ernie
+attention (interleaved full-dim rope, one use_bias over q/k/v/o) in a
+pre-norm llama block, with a sparse MoE whose fp32 softmax router SELECTS
+by probs + e_score_correction_bias (the aux-free balancing trick over
+softmax scores) while the combine weights stay the raw selected
+probabilities, renormalized with a norm_min clamp. Shared experts (when
+configured) are a gate-free dense SwiGLU. Layers before
+moe_layer_start_index (and off the moe_layer_interval grid) use the dense
+MLP.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.ernie45_moe.config import Ernie45MoeConfig
+from llm_training_tpu.models.llama.model import RMSNorm, _dense
+from llm_training_tpu.models.moe import dropless_moe_apply
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+class Ernie45MoeAttention(nn.Module):
+    config: Ernie45MoeConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.resolved_head_dim
+        q = _dense(cfg, heads * d, ("embed", "heads"), "q_proj", cfg.use_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "k_proj", cfg.use_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "v_proj", cfg.use_bias)(hidden)
+        q = q.reshape(batch, seq, heads, d)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, d)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, d)
+        q, k = apply_rope(q, k, cos, sin, interleaved=True)
+        out = dot_product_attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, heads * d)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      cfg.use_bias)(out)
+
+
+class Ernie45MoeMLP(nn.Module):
+    """SwiGLU MLP whose projections honor use_bias (HF applies the single
+    flag to attention AND every MLP, experts included)."""
+
+    config: Ernie45MoeConfig
+    intermediate_size: int
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        gate = _dense(cfg, self.intermediate_size, ("embed", "mlp"), "gate_proj",
+                      cfg.use_bias)(hidden)
+        up = _dense(cfg, self.intermediate_size, ("embed", "mlp"), "up_proj",
+                    cfg.use_bias)(hidden)
+        return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj",
+                      cfg.use_bias)(nn.silu(gate) * up)
+
+
+class Ernie45MoeBlock(nn.Module):
+    """Softmax router with aux-free selection bias + dropless experts."""
+
+    config: Ernie45MoeConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        num_experts = cfg.moe_num_experts
+        inter = cfg.moe_intermediate_size
+        compute_dtype = cfg.compute_jnp_dtype
+        param_dtype = cfg.param_jnp_dtype
+        batch, seq, embed = hidden.shape
+        x = hidden.reshape(-1, embed)
+
+        gate_kernel = self.param(
+            "gate_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("embed", "expert")
+            ),
+            (embed, num_experts),
+            param_dtype,
+        )
+        bias = self.param(
+            "e_score_correction_bias",
+            nn.with_logical_partitioning(nn.initializers.zeros_init(), ("expert",)),
+            (num_experts,),
+            jnp.float32,
+        )
+        logits = x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        # selection sees probs + bias (aux-free balancing); combine weights
+        # are the RAW probabilities at the chosen indices
+        _, topk_idx = jax.lax.top_k(probs + jax.lax.stop_gradient(bias), cfg.moe_k)
+        topk_weights = jnp.take_along_axis(probs, topk_idx, axis=1)
+        topk_weights = topk_weights / jnp.clip(
+            topk_weights.sum(axis=-1, keepdims=True), min=cfg.moe_norm_min
+        )
+        topk_weights = topk_weights.astype(compute_dtype)
+
+        def expert_param(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(cfg.initializer_range), axes
+                ),
+                shape,
+                param_dtype,
+            ).astype(compute_dtype)
+
+        w_gate = expert_param(
+            "experts_gate_proj", (num_experts, embed, inter), ("expert", "embed", "mlp")
+        )
+        w_up = expert_param(
+            "experts_up_proj", (num_experts, embed, inter), ("expert", "embed", "mlp")
+        )
+        w_down = expert_param(
+            "experts_down_proj", (num_experts, inter, embed), ("expert", "mlp", "embed")
+        )
+        if cfg.use_bias:
+            b_gate = expert_param(
+                "experts_gate_proj_bias", (num_experts, inter), ("expert", "mlp")
+            )
+            b_up = expert_param(
+                "experts_up_proj_bias", (num_experts, inter), ("expert", "mlp")
+            )
+            b_down = expert_param(
+                "experts_down_proj_bias", (num_experts, embed), ("expert", "embed")
+            )
+
+        def dense_fn(xc):
+            gate = jnp.einsum("th,ehi->tei", xc, w_gate)
+            up = jnp.einsum("th,ehi->tei", xc, w_up)
+            if cfg.use_bias:
+                gate = gate + b_gate[None]
+                up = up + b_up[None]
+            out = jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
+            return out + b_down[None] if cfg.use_bias else out
+
+        def ragged_fn(xs, group_sizes, expert_order):
+            gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+            up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+            if cfg.use_bias:
+                gate = gate + b_gate[expert_order]
+                up = up + b_up[expert_order]
+            out = jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
+            return out + b_down[expert_order] if cfg.use_bias else out
+
+        out = dropless_moe_apply(
+            x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
+            cfg.moe_impl, dense_fn, ragged_fn,
+        )
+        out = out.reshape(batch, seq, embed).astype(hidden.dtype)
+        if cfg.moe_num_shared_experts:
+            out = out + Ernie45MoeMLP(
+                cfg, cfg.moe_intermediate_size * cfg.moe_num_shared_experts,
+                name="shared_experts",
+            )(hidden)
+        return out
+
+
+class Ernie45MoeDecoderLayer(nn.Module):
+    config: Ernie45MoeConfig
+    is_moe: bool
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        normed = norm("input_layernorm")(hidden)
+        hidden = hidden + Ernie45MoeAttention(cfg, name="self_attn")(
+            normed, segment_ids, cos, sin
+        )
+        normed = norm("post_attention_layernorm")(hidden)
+        if self.is_moe:
+            mlp_out = Ernie45MoeBlock(cfg, name="mlp")(normed)
+        else:
+            mlp_out = Ernie45MoeMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
+        return hidden + mlp_out
+
+
+class Ernie45Moe(nn.Module):
+    """Ernie 4.5 MoE causal LM with the `CausalLMProto` surface."""
+
+    config: Ernie45MoeConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+        # interleaved (GLM-style) pairing: repeat_interleave tables
+        half = cos.shape[-1] // 2
+        cos = jnp.repeat(cos[..., :half], 2, axis=-1)
+        sin = jnp.repeat(sin[..., :half], 2, axis=-1)
+
+        policy = _remat_policy(cfg)
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = Ernie45MoeDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(Ernie45MoeDecoderLayer, policy=policy)
+            hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+                hidden, segment_ids, cos, sin
+            )
+
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        head_bias = None
+        if cfg.use_bias:
+            # HF's lm_head bias is real even when the weight is tied
+            head_bias = self.param(
+                "lm_head_bias",
+                nn.with_logical_partitioning(nn.initializers.zeros_init(), ("vocab",)),
+                (cfg.vocab_size,),
+                cfg.param_jnp_dtype,
+            )
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = embed_tokens.attend(hidden)
+            else:
+                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            if head_bias is not None:
+                logits = logits + head_bias.astype(logits.dtype)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        if self.config.tie_word_embeddings:
+            return "embed_tokens/embedding"
+        return "lm_head/kernel"
+
+    def get_output_bias_path(self) -> str | None:
+        """Consulted by the fused CE/log-prob objectives (the tied-weight
+        heuristic there cannot see a standalone head bias)."""
+        return "lm_head_bias" if self.config.use_bias else None

@@ -39,6 +39,7 @@ _FAMILIES: dict[str, str] = {
     "MiniMaxConfig": "llm_training_tpu.models.minimax.hf_conversion",
     "BambaConfig": "llm_training_tpu.models.bamba.hf_conversion",
     "Glm4MoeConfig": "llm_training_tpu.models.glm4_moe.hf_conversion",
+    "Ernie45MoeConfig": "llm_training_tpu.models.ernie45_moe.hf_conversion",
 }
 
 
@@ -241,6 +242,7 @@ _ARCH_TO_FAMILY = {
     "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
     "nemotron": "llm_training_tpu.models.Llama",  # layernorm1p + relu^2 MLP
     "ernie4_5": "llm_training_tpu.models.Llama",  # interleaved full-dim rope
+    "ernie4_5_moe": "llm_training_tpu.models.Ernie45Moe",  # + aux-free softmax MoE
     "hunyuan_v1_dense": "llm_training_tpu.models.Llama",  # post-rope qk-norm
     "gpt2": "llm_training_tpu.models.Llama",  # learned positions, fused qkv
     "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
