@@ -24,7 +24,7 @@ import numpy as np
 from pydantic import ConfigDict
 
 from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
-from llm_training_tpu.lms.clm import _get_path_or_none
+from llm_training_tpu.lms.clm import head_and_bias
 from llm_training_tpu.ops import shift_labels
 from llm_training_tpu.ops.cross_entropy import fused_linear_log_probs
 
@@ -38,17 +38,6 @@ class DPOConfig(BaseLMConfig):
     label_smoothing: float = 0.0
     ignore_index: int = -100
     logps_chunk_size: int = 1024
-
-
-def _get_path(tree: Any, path: str) -> jnp.ndarray:
-    import flax.linen as nn
-
-    node = tree
-    for key in path.split("/"):
-        node = node[key]
-    if isinstance(node, nn.Partitioned):
-        node = node.value
-    return node
 
 
 class DPO:
@@ -122,8 +111,6 @@ class DPO:
             return_last_hidden_states=True,
         )
         p = params["params"] if "params" in params else params
-        from llm_training_tpu.lms.clm import head_and_bias
-
         head, head_bias = head_and_bias(model, p)
         logps, counts = fused_linear_log_probs(
             out.last_hidden_states,

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from pydantic import ConfigDict
 
 from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
-from llm_training_tpu.lms.dpo import _get_path, _get_path_or_none
+from llm_training_tpu.lms.clm import head_and_bias
 from llm_training_tpu.ops import shift_labels
 from llm_training_tpu.ops.cross_entropy import fused_linear_log_probs
 
@@ -61,8 +61,6 @@ class ORPO:
             return_last_hidden_states=True,
         )
         p = params["params"] if "params" in params else params
-        from llm_training_tpu.lms.clm import head_and_bias
-
         head, head_bias = head_and_bias(self.model, p)
         logps, counts = fused_linear_log_probs(
             out.last_hidden_states,

@@ -17,6 +17,7 @@ from llm_training_tpu.models.gemma import Gemma, GemmaConfig
 from llm_training_tpu.models.glm4_moe import Glm4Moe, Glm4MoeConfig
 from llm_training_tpu.models.gpt_oss import GptOss, GptOssConfig
 from llm_training_tpu.models.hf_causal_lm import HFCausalLM, HFCausalLMConfig
+from llm_training_tpu.models.hunyuan_moe import HunYuanMoe, HunYuanMoeConfig
 from llm_training_tpu.models.llama import Llama, LlamaConfig
 from llm_training_tpu.models.minimax import MiniMax, MiniMaxConfig
 from llm_training_tpu.models.phi3 import Phi3, Phi3Config
@@ -39,6 +40,8 @@ __all__ = [
     "GptOssConfig",
     "HFCausalLM",
     "HFCausalLMConfig",
+    "HunYuanMoe",
+    "HunYuanMoeConfig",
     "Llama",
     "LlamaConfig",
     "MiniMax",

@@ -41,7 +41,7 @@ class Ernie45MoeConfig(BaseModelConfig):
     moe_num_experts: int = 64
     moe_k: int = 6
     moe_intermediate_size: int | None = None
-    moe_num_shared_experts: int = 0  # dense gate-free shared experts
+    moe_num_shared_experts: int = 2  # dense gate-free shared experts (HF default)
     moe_layer_start_index: int = 1
     moe_layer_end_index: int = -1  # -1 = last layer (HF semantics)
     moe_layer_interval: int = 1

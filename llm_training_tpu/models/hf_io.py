@@ -40,6 +40,7 @@ _FAMILIES: dict[str, str] = {
     "BambaConfig": "llm_training_tpu.models.bamba.hf_conversion",
     "Glm4MoeConfig": "llm_training_tpu.models.glm4_moe.hf_conversion",
     "Ernie45MoeConfig": "llm_training_tpu.models.ernie45_moe.hf_conversion",
+    "HunYuanMoeConfig": "llm_training_tpu.models.hunyuan_moe.hf_conversion",
 }
 
 
@@ -244,6 +245,7 @@ _ARCH_TO_FAMILY = {
     "ernie4_5": "llm_training_tpu.models.Llama",  # interleaved full-dim rope
     "ernie4_5_moe": "llm_training_tpu.models.Ernie45Moe",  # + aux-free softmax MoE
     "hunyuan_v1_dense": "llm_training_tpu.models.Llama",  # post-rope qk-norm
+    "hunyuan_v1_moe": "llm_training_tpu.models.HunYuanMoe",  # + softmax top-k MoE
     "gpt2": "llm_training_tpu.models.Llama",  # learned positions, fused qkv
     "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up

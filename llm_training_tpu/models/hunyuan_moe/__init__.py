@@ -1,0 +1,4 @@
+from llm_training_tpu.models.hunyuan_moe.config import HunYuanMoeConfig
+from llm_training_tpu.models.hunyuan_moe.model import HunYuanMoe
+
+__all__ = ["HunYuanMoe", "HunYuanMoeConfig"]

@@ -1,0 +1,71 @@
+"""HunYuan V1 MoE model config.
+
+Family member beyond the reference's named models (reached by the reference
+only through torch wrapping, `hf_causal_lm.py:22`). Mirrors HF
+`HunYuanMoEV1Config`: dense-HunYuan attention (post-rope per-head qk-norm)
+over a mixtral-style softmax top-k MoE with an always-on gate-free shared
+MLP; the router kernel lives under `gate.wg`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+from pydantic import model_validator
+
+from llm_training_tpu.models.base import BaseModelConfig
+
+
+class HunYuanMoeConfig(BaseModelConfig):
+    vocab_size: int = 290943
+    hidden_size: int = 4096
+    intermediate_size: int = 3072  # per-expert AND shared-mlp width
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    head_dim: int | None = None
+    max_position_embeddings: int = 32768
+    initializer_range: float = 0.02
+    rms_norm_eps: float = 1e-5
+    pad_token_id: int | None = None
+    bos_token_id: int | None = 1
+    eos_token_id: int | list[int] | None = 2
+    tie_word_embeddings: bool = False
+    rope_theta: float = 10000.0
+    rope_scaling: dict[str, Any] | None = None
+    attention_bias: bool = False
+
+    # --- MoE
+    num_experts: int = 16
+    moe_topk: int = 2
+
+    enable_gradient_checkpointing: bool = False
+    recompute_granularity: Literal["full", "selective"] = "full"
+    scan_layers: bool = True  # every layer is identical -> loop also fine
+    attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+
+    @model_validator(mode="after")
+    def _validate(self) -> "HunYuanMoeConfig":
+        if self.num_attention_heads % self.num_key_value_heads:
+            raise ValueError(
+                f"num_attention_heads ({self.num_attention_heads}) must be "
+                f"divisible by num_key_value_heads ({self.num_key_value_heads})"
+            )
+        if self.moe_topk > self.num_experts:
+            raise ValueError("moe_topk exceeds num_experts")
+        self.rope_config
+        return self
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_attention_heads
+
+    @property
+    def rope_config(self):
+        from llm_training_tpu.ops.rope_utils import rope_config_from_hf
+
+        return rope_config_from_hf(
+            self.rope_scaling, self.rope_theta, self.resolved_head_dim,
+            self.max_position_embeddings,
+        )

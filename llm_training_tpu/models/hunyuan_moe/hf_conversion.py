@@ -1,0 +1,179 @@
+"""HunYuan V1 MoE <-> HuggingFace state-dict conversion.
+
+Capability parity: reference `hf_compat_model.py:96-119` applied to HunYuan
+MoE (reached by the reference only through torch wrapping,
+`hf_causal_lm.py:22`). The router kernel lives under `mlp.gate.wg.weight`;
+layers are uniform, so both scan and looped layouts convert.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.hunyuan_moe.config import HunYuanMoeConfig
+from llm_training_tpu.models.llama.hf_conversion import (
+    _get_path,
+    _set_path,
+    _to_numpy,
+)
+
+_LAYER_PARAMS = [
+    (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
+    (("self_attn", "k_proj", "kernel"), "self_attn.k_proj.weight", True),
+    (("self_attn", "v_proj", "kernel"), "self_attn.v_proj.weight", True),
+    (("self_attn", "o_proj", "kernel"), "self_attn.o_proj.weight", True),
+    (("self_attn", "q_norm", "weight"), "self_attn.query_layernorm.weight", False),
+    (("self_attn", "k_norm", "weight"), "self_attn.key_layernorm.weight", False),
+    (("mlp", "gate_kernel"), "mlp.gate.wg.weight", True),
+    (("mlp", "shared_gate_proj", "kernel"), "mlp.shared_mlp.gate_proj.weight", True),
+    (("mlp", "shared_up_proj", "kernel"), "mlp.shared_mlp.up_proj.weight", True),
+    (("mlp", "shared_down_proj", "kernel"), "mlp.shared_mlp.down_proj.weight", True),
+    (("input_layernorm", "weight"), "input_layernorm.weight", False),
+    (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
+]
+
+_EXPERT_PROJS = ("gate_proj", "up_proj", "down_proj")
+
+
+def _expert_stack(sd: Mapping, config: HunYuanMoeConfig, i: int, proj: str):
+    return np.stack([
+        _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
+        for e in range(config.num_experts)
+    ])
+
+
+def params_from_hf(
+    state_dict: Mapping[str, Any], config: HunYuanMoeConfig, leaf_fn: Any = None
+) -> dict:
+    params: dict = {}
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def put(path, value):
+        _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
+
+    put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
+    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if not config.tie_word_embeddings:
+        put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
+
+    def layer_value(i, hf_name, transpose):
+        value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+        return value.T if transpose else value
+
+    if config.scan_layers:
+        for path, hf_name, transpose in _LAYER_PARAMS:
+            put(("layers", "layer") + path, np.stack([
+                layer_value(i, hf_name, transpose)
+                for i in range(config.num_hidden_layers)
+            ]))
+        for proj in _EXPERT_PROJS:
+            put(("layers", "layer", "mlp", f"experts_{proj}"), np.stack([
+                _expert_stack(sd, config, i, proj)
+                for i in range(config.num_hidden_layers)
+            ]))
+    else:
+        for i in range(config.num_hidden_layers):
+            for path, hf_name, transpose in _LAYER_PARAMS:
+                put((f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
+            for proj in _EXPERT_PROJS:
+                put((f"layers_{i}", "mlp", f"experts_{proj}"),
+                    _expert_stack(sd, config, i, proj))
+    return {"params": params}
+
+
+def params_to_hf(params: Mapping, config: HunYuanMoeConfig) -> dict[str, np.ndarray]:
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    p = nn.meta.unbox(p)
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
+    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if not config.tie_word_embeddings:
+        out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
+
+    cache: dict = {}
+
+    def fetch(path):
+        # device->host once per stacked path, then slice per layer
+        if path not in cache:
+            cache[path] = np.asarray(_get_path(p, ("layers", "layer") + path))
+        return cache[path]
+
+    for i in range(config.num_hidden_layers):
+        if config.scan_layers:
+            g = lambda *path: fetch(path)[i]
+        else:
+            g = lambda *path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
+        for path, hf_name, transpose in _LAYER_PARAMS:
+            value = g(*path)
+            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+        for proj in _EXPERT_PROJS:
+            stacked = g("mlp", f"experts_{proj}")
+            for e in range(config.num_experts):
+                out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+    return out
+
+
+def config_to_hf(config: HunYuanMoeConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    return {
+        "architectures": ["HunYuanMoEV1ForCausalLM"],
+        "model_type": "hunyuan_v1_moe",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_hidden_layers,
+        "num_attention_heads": config.num_attention_heads,
+        "num_key_value_heads": config.num_key_value_heads,
+        "head_dim": config.resolved_head_dim,
+        "num_experts": config.num_experts,
+        "moe_topk": config.moe_topk,
+        "hidden_act": "silu",
+        "max_position_embeddings": config.max_position_embeddings,
+        "initializer_range": config.initializer_range,
+        "rms_norm_eps": config.rms_norm_eps,
+        "pad_token_id": config.pad_token_id,
+        "bos_token_id": config.bos_token_id,
+        "eos_token_id": config.eos_token_id,
+        "tie_word_embeddings": config.tie_word_embeddings,
+        "rope_theta": config.rope_theta,
+        "rope_scaling": config.rope_scaling,
+        "attention_bias": config.attention_bias,
+        "use_cache": True,
+        "torch_dtype": torch_dtype,
+    }
+
+
+def config_from_hf(hf_config: Any, **overrides: Any) -> HunYuanMoeConfig:
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    for field in ("num_experts", "moe_topk"):
+        if isinstance(get(field), (list, tuple)):
+            raise ValueError(
+                f"per-layer {field} lists are not supported (uniform expert "
+                "counts only)"
+            )
+    return HunYuanMoeConfig(**{**dict(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads"),
+        head_dim=get("head_dim"),
+        max_position_embeddings=get("max_position_embeddings", 32768),
+        initializer_range=get("initializer_range", 0.02),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        pad_token_id=get("pad_token_id"),
+        bos_token_id=get("bos_token_id", 1),
+        eos_token_id=get("eos_token_id", 2),
+        tie_word_embeddings=get("tie_word_embeddings", False),
+        rope_theta=get("rope_theta", 10000.0),
+        rope_scaling=get("rope_scaling"),
+        attention_bias=get("attention_bias", False),
+        num_experts=get("num_experts", 16),
+        moe_topk=get("moe_topk", 2),
+    ), **overrides})

@@ -1,0 +1,244 @@
+"""HunYuan V1 MoE decoder, TPU-native.
+
+Graph verified against HF `modeling_hunyuan_v1_moe.py`: the dense-HunYuan
+attention (per-head RMS qk-norm applied AFTER rotary) in a pre-norm llama
+block, with a mixtral-style MoE on every layer — fp32 softmax router,
+top-k, renormalize — plus an always-on gate-free shared SwiGLU whose width
+equals the per-expert width. Layers are uniform, so `scan_layers` keeps
+constant compile time.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.hunyuan_moe.config import HunYuanMoeConfig
+from llm_training_tpu.models.llama.model import RMSNorm, _dense
+from llm_training_tpu.models.moe import dropless_moe_apply
+from llm_training_tpu.models.remat import remat_policy as _remat_policy
+from llm_training_tpu.ops import apply_rope, dot_product_attention
+from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
+
+
+class HunYuanMoeAttention(nn.Module):
+    config: HunYuanMoeConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        batch, seq, _ = hidden.shape
+        heads, d = cfg.num_attention_heads, cfg.resolved_head_dim
+        q = _dense(cfg, heads * d, ("embed", "heads"), "q_proj",
+                   cfg.attention_bias)(hidden)
+        k = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "k_proj", cfg.attention_bias)(hidden)
+        v = _dense(cfg, cfg.num_key_value_heads * d, ("embed", "kv_heads"),
+                   "v_proj", cfg.attention_bias)(hidden)
+        q = q.reshape(batch, seq, heads, d)
+        k = k.reshape(batch, seq, cfg.num_key_value_heads, d)
+        v = v.reshape(batch, seq, cfg.num_key_value_heads, d)
+        q, k = apply_rope(q, k, cos, sin)
+        # HunYuan: per-head RMS norms AFTER rotary (shared weight over d)
+        q = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
+        k = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
+        out = dot_product_attention(
+            q, k, v, segment_ids=segment_ids, causal=True,
+            impl=cfg.attention_impl,
+        )
+        out = out.astype(hidden.dtype).reshape(batch, seq, heads * d)
+        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj",
+                      cfg.attention_bias)(out)
+
+
+class HunYuanMoeBlock(nn.Module):
+    """Softmax top-k router + dropless experts + gate-free shared MLP."""
+
+    config: HunYuanMoeConfig
+
+    @nn.compact
+    def __call__(self, hidden):
+        cfg = self.config
+        num_experts = cfg.num_experts
+        inter = cfg.intermediate_size
+        compute_dtype = cfg.compute_jnp_dtype
+        param_dtype = cfg.param_jnp_dtype
+        batch, seq, embed = hidden.shape
+        x = hidden.reshape(-1, embed)
+
+        gate_kernel = self.param(
+            "gate_kernel",
+            nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("embed", "expert")
+            ),
+            (embed, num_experts),
+            param_dtype,
+        )
+        logits = x.astype(jnp.float32) @ gate_kernel.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        topk_weights, topk_idx = jax.lax.top_k(probs, cfg.moe_topk)
+        topk_weights = topk_weights / topk_weights.sum(axis=-1, keepdims=True)
+        topk_weights = topk_weights.astype(compute_dtype)
+
+        def expert_param(name, shape, axes):
+            return self.param(
+                name,
+                nn.with_logical_partitioning(
+                    nn.initializers.normal(cfg.initializer_range), axes
+                ),
+                shape,
+                param_dtype,
+            ).astype(compute_dtype)
+
+        w_gate = expert_param(
+            "experts_gate_proj", (num_experts, embed, inter), ("expert", "embed", "mlp")
+        )
+        w_up = expert_param(
+            "experts_up_proj", (num_experts, embed, inter), ("expert", "embed", "mlp")
+        )
+        w_down = expert_param(
+            "experts_down_proj", (num_experts, inter, embed), ("expert", "mlp", "embed")
+        )
+
+        def dense_fn(xc):
+            gate = jnp.einsum("th,ehi->tei", xc, w_gate)
+            up = jnp.einsum("th,ehi->tei", xc, w_up)
+            return jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
+
+        def ragged_fn(xs, group_sizes, expert_order):
+            gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+            up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+            return jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
+
+        out = dropless_moe_apply(
+            x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
+            cfg.moe_impl, dense_fn, ragged_fn,
+        )
+        out = out.reshape(batch, seq, embed).astype(hidden.dtype)
+
+        # always-on gate-free shared SwiGLU (per-expert width)
+        s_gate = _dense(cfg, inter, ("embed", "mlp"), "shared_gate_proj", False)(hidden)
+        s_up = _dense(cfg, inter, ("embed", "mlp"), "shared_up_proj", False)(hidden)
+        shared = _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "shared_down_proj", False)(
+            nn.silu(s_gate) * s_up
+        )
+        return out + shared
+
+
+class HunYuanMoeDecoderLayer(nn.Module):
+    config: HunYuanMoeConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+        norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
+        normed = norm("input_layernorm")(hidden)
+        hidden = hidden + HunYuanMoeAttention(cfg, name="self_attn")(
+            normed, segment_ids, cos, sin
+        )
+        normed = norm("post_attention_layernorm")(hidden)
+        return hidden + HunYuanMoeBlock(cfg, name="mlp")(normed)
+
+
+class _ScannedLayer(nn.Module):
+    config: HunYuanMoeConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        hidden = HunYuanMoeDecoderLayer(self.config, name="layer")(
+            hidden, segment_ids, cos, sin
+        )
+        return hidden, None
+
+
+class HunYuanMoe(nn.Module):
+    """HunYuan V1 MoE causal LM with the `CausalLMProto` surface."""
+
+    config: HunYuanMoeConfig
+
+    def _layers(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        policy = _remat_policy(cfg)
+        if cfg.scan_layers:
+            body = _ScannedLayer
+            if policy is not None:
+                body = nn.remat(_ScannedLayer, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            hidden, _ = scanned(hidden, segment_ids, cos, sin)
+            return hidden
+        for i in range(cfg.num_hidden_layers):
+            layer_cls = HunYuanMoeDecoderLayer
+            if policy is not None:
+                layer_cls = nn.remat(HunYuanMoeDecoderLayer, policy=policy)
+            hidden = layer_cls(cfg, name=f"layers_{i}")(hidden, segment_ids, cos, sin)
+        return hidden
+
+    @nn.compact
+    def __call__(
+        self,
+        input_ids: jnp.ndarray | None = None,
+        segment_ids: jnp.ndarray | None = None,
+        position_ids: jnp.ndarray | None = None,
+        inputs_embeds: jnp.ndarray | None = None,
+        compute_logits: bool = True,
+        return_last_hidden_states: bool = False,
+    ) -> CausalLMOutput:
+        cfg = self.config
+        embed_tokens = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.hidden_size,
+            dtype=cfg.compute_jnp_dtype,
+            param_dtype=cfg.param_jnp_dtype,
+            embedding_init=nn.with_logical_partitioning(
+                nn.initializers.normal(cfg.initializer_range), ("vocab", "embed")
+            ),
+            name="embed_tokens",
+        )
+        if inputs_embeds is None:
+            if input_ids is None:
+                raise ValueError("one of input_ids / inputs_embeds is required")
+            inputs_embeds = embed_tokens(input_ids)
+        hidden = inputs_embeds
+        seq = hidden.shape[1]
+
+        if position_ids is None:
+            position_ids = jnp.arange(seq)[None, :]
+        inv_freq, attention_scaling = compute_rope_frequencies(
+            cfg.rope_config, seq_len=seq
+        )
+        cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
+
+        hidden = self._layers(hidden, segment_ids, cos, sin)
+        hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
+        hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        logits = None
+        if compute_logits:
+            if cfg.tie_word_embeddings:
+                logits = embed_tokens.attend(hidden)
+            else:
+                logits = _dense(cfg, cfg.vocab_size, ("embed", "vocab"), "lm_head", False)(hidden)
+            logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
+
+        return CausalLMOutput(
+            logits=logits,
+            last_hidden_states=hidden if return_last_hidden_states else None,
+        )
+
+    def get_input_embeddings_path(self) -> str:
+        return "embed_tokens/embedding"
+
+    def get_output_embeddings_path(self) -> str:
+        if self.config.tie_word_embeddings:
+            return "embed_tokens/embedding"
+        return "lm_head/kernel"
