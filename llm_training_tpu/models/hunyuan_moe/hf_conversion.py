@@ -34,7 +34,16 @@ _LAYER_PARAMS = [
     (("post_attention_layernorm", "weight"), "post_attention_layernorm.weight", False),
 ]
 
+_ATTN_BIASES = [
+    (("self_attn", proj, "bias"), f"self_attn.{proj}.bias", False)
+    for proj in ("q_proj", "k_proj", "v_proj", "o_proj")
+]
+
 _EXPERT_PROJS = ("gate_proj", "up_proj", "down_proj")
+
+
+def _layer_params(config: HunYuanMoeConfig) -> list:
+    return _LAYER_PARAMS + (_ATTN_BIASES if config.attention_bias else [])
 
 
 def _expert_stack(sd: Mapping, config: HunYuanMoeConfig, i: int, proj: str):
@@ -63,7 +72,7 @@ def params_from_hf(
         return value.T if transpose else value
 
     if config.scan_layers:
-        for path, hf_name, transpose in _LAYER_PARAMS:
+        for path, hf_name, transpose in _layer_params(config):
             put(("layers", "layer") + path, np.stack([
                 layer_value(i, hf_name, transpose)
                 for i in range(config.num_hidden_layers)
@@ -75,7 +84,7 @@ def params_from_hf(
             ]))
     else:
         for i in range(config.num_hidden_layers):
-            for path, hf_name, transpose in _LAYER_PARAMS:
+            for path, hf_name, transpose in _layer_params(config):
                 put((f"layers_{i}",) + path, layer_value(i, hf_name, transpose))
             for proj in _EXPERT_PROJS:
                 put((f"layers_{i}", "mlp", f"experts_{proj}"),
@@ -107,7 +116,7 @@ def params_to_hf(params: Mapping, config: HunYuanMoeConfig) -> dict[str, np.ndar
             g = lambda *path: fetch(path)[i]
         else:
             g = lambda *path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
-        for path, hf_name, transpose in _LAYER_PARAMS:
+        for path, hf_name, transpose in _layer_params(config):
             value = g(*path)
             out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
         for proj in _EXPERT_PROJS:

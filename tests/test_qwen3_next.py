@@ -111,3 +111,28 @@ def test_e2e_fit_decreases_loss():
         max_steps=20, lr=3e-3,
     )
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+@pytest.mark.slow
+def test_hf_causal_lm_loads_qwen3_next_checkpoint(tmp_path):
+    """End-to-end: HF checkpoint dir -> HFCausalLM router -> Qwen3Next
+    (hybrid) -> streamed weights -> logits parity."""
+    torch = pytest.importorskip("torch")
+    from llm_training_tpu.models import HFCausalLM, HFCausalLMConfig
+    from llm_training_tpu.models.hf_io import load_pretrained_params
+
+    hf_model, _ = _hf_tiny()
+    hf_model.save_pretrained(tmp_path / "q3n", safe_serialization=True)
+
+    model = HFCausalLM(HFCausalLMConfig(
+        hf_path=str(tmp_path / "q3n"), compute_dtype="float32",
+        moe_impl="dense",
+    ))
+    assert isinstance(model, Qwen3Next)
+    params = load_pretrained_params(model.config, tmp_path / "q3n")
+
+    ids = np.random.default_rng(71).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=4e-4, atol=4e-4)
