@@ -52,30 +52,56 @@ def main() -> None:
     from llm_training_tpu.trainer import Trainer, TrainerConfig
 
     on_tpu = jax.default_backend() == "tpu"
-    # ~700M-param Llama (largest that fits 16G HBM with fp32 Adam masters):
-    # hidden 2048 pushes arithmetic intensity toward the 8B north star —
-    # attention + elementwise cost shrinks relative to matmul FLOPs as hidden
-    # grows, worth +0.018 MFU over the 317M/hidden-1024 proxy (r3 sweep:
-    # 697M@B16 0.5665 > 697M@B20 0.5638 > 317M@B64 0.549; B24+ and an
-    # 824M/hidden-2560 variant OOM). head_dim 128 is the MXU-native
-    # contraction (22% faster than head_dim 64 at equal params, r1).
-    model_kwargs = dict(
-        vocab_size=32000,
-        hidden_size=2048,
-        intermediate_size=5632,
-        num_hidden_layers=12,
-        num_attention_heads=16,
-        num_key_value_heads=8,
-        head_dim=128,
-        max_position_embeddings=2048,
-        # full remat is mandatory on a 16G-HBM chip: no-remat needs 22G even
-        # at batch 8; selective (save flash_out+lse) compiles to 15.9-18.5G
-        # at batch 56-64 (r3 — XLA fragmentation varies non-monotonically
-        # with batch) vs the 15.75G budget. MFU ceiling under the
-        # no-recompute-credit convention is ~0.75 with full remat
-        enable_gradient_checkpointing=True,
-        recompute_granularity="full",
-    )
+    bench_model = os.environ.get("BENCH_MODEL", "8b-layer")
+    if bench_model == "8b-layer":
+        # north-star layer proxy (the DEFAULT bench): the EXACT Llama-3-8B
+        # per-layer shape (h4096, inter 14336, 32q+8kv heads, head_dim 128)
+        # at seq 8192 — few layers so params + fp32 Adam masters fit 16G HBM.
+        # This measures the matmul/attention mix the 8B runs, per layer;
+        # depth only amortizes the (already-small) embed/CE ends. r4 sweep:
+        # L2/B2 0.654-0.671 > L2/B4 0.632 > L3/B1 0.509 (L3/B2 OOMs); the
+        # h4096 shapes beat the 697M proxy (0.567) — bigger MXU tiles win.
+        model_kwargs = dict(
+            vocab_size=32000,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_hidden_layers=2,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            head_dim=128,
+            max_position_embeddings=8192,
+            enable_gradient_checkpointing=True,
+            recompute_granularity="full",
+        )
+        default_seq, default_batch = 8192, 2
+    elif bench_model == "697m":
+        # ~700M-param Llama (largest that fits 16G HBM with fp32 Adam masters):
+        # hidden 2048 pushes arithmetic intensity toward the 8B north star —
+        # attention + elementwise cost shrinks relative to matmul FLOPs as hidden
+        # grows, worth +0.018 MFU over the 317M/hidden-1024 proxy (r3 sweep:
+        # 697M@B16 0.5665 > 697M@B20 0.5638 > 317M@B64 0.549; B24+ and an
+        # 824M/hidden-2560 variant OOM). head_dim 128 is the MXU-native
+        # contraction (22% faster than head_dim 64 at equal params, r1).
+        model_kwargs = dict(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_hidden_layers=12,
+            num_attention_heads=16,
+            num_key_value_heads=8,
+            head_dim=128,
+            max_position_embeddings=2048,
+            # full remat is mandatory on a 16G-HBM chip: no-remat needs 22G even
+            # at batch 8; selective (save flash_out+lse) compiles to 15.9-18.5G
+            # at batch 56-64 (r3 — XLA fragmentation varies non-monotonically
+            # with batch) vs the 15.75G budget. MFU ceiling under the
+            # no-recompute-credit convention is ~0.75 with full remat
+            enable_gradient_checkpointing=True,
+            recompute_granularity="full",
+        )
+        default_seq, default_batch = 2048, 16
+    else:
+        raise SystemExit(f"unknown BENCH_MODEL {bench_model!r}; use 8b-layer or 697m")
     # sweep overrides (experiments only; defaults above are the recorded bench)
     remat = os.environ.get("BENCH_REMAT")
     if remat == "none":
@@ -95,8 +121,11 @@ def main() -> None:
                             num_attention_heads=4, num_key_value_heads=2, head_dim=None,
                             vocab_size=2048)
 
-    seq = int(os.environ.get("BENCH_SEQ", 2048))
-    batch = int(os.environ.get("BENCH_BATCH", 16)) if on_tpu else 4
+    seq = int(os.environ.get("BENCH_SEQ", default_seq if on_tpu else 2048))
+    batch = int(os.environ.get("BENCH_BATCH", default_batch)) if on_tpu else 4
+    model_kwargs["max_position_embeddings"] = max(
+        model_kwargs["max_position_embeddings"], seq
+    )
     steps = 10 if on_tpu else 3
     warmup = 2 if on_tpu else 1
 
@@ -199,6 +228,7 @@ def main() -> None:
         "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 1),
         "sec_per_step": round(sec_per_step, 4),
         "n_params": n_params,
+        "model": bench_model,
         "n_devices": n_dev,
         "backend": jax.default_backend(),
     }))

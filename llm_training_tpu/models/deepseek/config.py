@@ -66,21 +66,15 @@ class DeepseekConfig(BaseModelConfig):
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    # dense-prefix + MoE layer mix is non-uniform, so layers are looped
-    # (constant-compile scan would need a uniform body); kept as a field for
-    # config-surface compatibility but always False
-    scan_layers: bool = False
+    # the dense prefix is looped; the uniform MoE suffix (everything from
+    # first_k_dense_replace on) scans, keeping compile time ~flat in depth
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "DeepseekConfig":
         if self.attention_dropout != 0.0:
             raise ValueError("attention_dropout is not supported; set it to 0.0")
-        if self.scan_layers:
-            raise ValueError(
-                "deepseek layers are looped (dense prefix + MoE mix is "
-                "non-uniform); set scan_layers=False"
-            )
         if self.n_routed_experts is not None:
             if self.moe_intermediate_size is None:
                 raise ValueError("n_routed_experts requires moe_intermediate_size")
@@ -125,3 +119,12 @@ class DeepseekConfig(BaseModelConfig):
             self.n_routed_experts is not None
             and layer_idx >= self.first_k_dense_replace
         )
+
+    @property
+    def num_scanned_layers(self) -> int:
+        """Depth of the scanned uniform MoE suffix (0 = loop everything).
+        Dense-only configs loop: their uniform stack could scan too, but the
+        graph is Llama-shaped and tiny test configs are the only users."""
+        if not self.scan_layers or self.n_routed_experts is None:
+            return 0
+        return self.num_hidden_layers - self.first_k_dense_replace

@@ -2,9 +2,10 @@
 
 Capability parity: reference `hf_compat_model.py:96-119` applied to the
 DeepSeek family (which the reference reaches only through `HFCausalLM`'s
-torch wrapping, `hf_causal_lm.py:22`). Layers are looped (the dense-prefix +
-MoE mix is non-uniform), so the flax tree uses `layers_{i}` keys; per-expert
-HF weights stack into ONE [E, in, out] parameter per projection.
+torch wrapping, `hf_causal_lm.py:22`). The dense prefix is looped
+(`layers_{i}` keys); the uniform MoE suffix is scanned (`moe_layers/layer`
+keys with a leading depth axis). Per-expert HF weights stack into ONE
+[E, in, out] parameter per projection ([L_s, E, in, out] under the scan).
 """
 
 from __future__ import annotations
@@ -19,6 +20,7 @@ from llm_training_tpu.models.llama.hf_conversion import (
     _set_path,
     _to_numpy,
 )
+from llm_training_tpu.models.moe_scan_io import layers_from_hf, layers_to_hf
 
 _ATTN_COMMON = [
     (("self_attn", "kv_a_proj_with_mqa", "kernel"), "self_attn.kv_a_proj_with_mqa.weight", True),
@@ -93,19 +95,16 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            put((f"layers_{i}",) + path, value.T if transpose else value)
-        if config.layer_is_moe(i):
-            for proj in _EXPERT_PROJS:
-                put(
-                    (f"layers_{i}", "mlp", f"experts_{proj}"),
-                    np.stack([
-                        _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
-                        for e in range(config.n_routed_experts)
-                    ]),
-                )
+    def expert_parts(sd, i):
+        return {
+            ("mlp", f"experts_{proj}"): lambda proj=proj: np.stack([
+                _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
+                for e in range(config.n_routed_experts)
+            ])
+            for proj in _EXPERT_PROJS
+        }
+
+    layers_from_hf(sd, config, put, _layer_params, expert_parts)
     return {"params": params}
 
 
@@ -120,17 +119,13 @@ def params_to_hf(params: Mapping, config: DeepseekConfig) -> dict[str, np.ndarra
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
-        if config.layer_is_moe(i):
-            for proj in _EXPERT_PROJS:
-                stacked = np.asarray(
-                    _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}"))
-                )
-                for e in range(config.n_routed_experts):
-                    out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+    def expert_out(get, i, out):
+        for proj in _EXPERT_PROJS:
+            stacked = get(("mlp", f"experts_{proj}"))  # [E, in, out]
+            for e in range(config.n_routed_experts):
+                out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+
+    layers_to_hf(p, config, out, _layer_params, expert_out)
     return out
 
 

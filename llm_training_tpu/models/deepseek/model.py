@@ -17,8 +17,9 @@ Graph verified against HF `modeling_deepseek_v2.py` / `modeling_deepseek_v3.py`:
   `lax.ragged_dot` grouped matmuls over ONE stacked parameter per
   projection, always-on shared experts, routed_scaling_factor. No aux loss:
   v3 balances via the noaux bias; the HF v2 port computes none either.
-- dense prefix: layers [0, first_k_dense_replace) use the full-width MLP.
-  The layer mix is non-uniform, so layers are looped, not scanned.
+- dense prefix: layers [0, first_k_dense_replace) use the full-width MLP and
+  are looped; the uniform MoE suffix scans (`nn.scan`) so compile time stays
+  ~flat in depth.
 """
 
 from __future__ import annotations
@@ -246,6 +247,22 @@ class DeepseekDecoderLayer(nn.Module):
         return hidden + mlp_out
 
 
+class _MoEScanBody(nn.Module):
+    """Scan body: one MoE layer. The dense prefix is non-uniform with the
+    suffix, so it is looped; everything from `first_k_dense_replace` on is
+    the SAME graph and scans — compile time stays ~flat in depth (DeepSeek-V3
+    is 61 layers; a looped stack would compile 58 copies of this body)."""
+
+    config: DeepseekConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        hidden = DeepseekDecoderLayer(self.config, True, name="layer")(
+            hidden, segment_ids, cos, sin
+        )
+        return hidden, None
+
+
 class Deepseek(nn.Module):
     """DeepSeek V2/V3 causal LM with the `CausalLMProto` surface."""
 
@@ -291,13 +308,27 @@ class Deepseek(nn.Module):
             sin = jnp.repeat(sin[..., :half], 2, axis=-1)
 
         policy = _remat_policy(cfg)
-        for i in range(cfg.num_hidden_layers):
+        n_scanned = cfg.num_scanned_layers
+        for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = DeepseekDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(DeepseekDecoderLayer, policy=policy)
             hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
+        if n_scanned:
+            body = _MoEScanBody
+            if policy is not None:
+                body = nn.remat(_MoEScanBody, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=n_scanned,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="moe_layers")
+            hidden, _ = scanned(hidden, segment_ids, cos, sin)
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))

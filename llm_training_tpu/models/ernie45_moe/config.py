@@ -50,13 +50,14 @@ class Ernie45MoeConfig(BaseModelConfig):
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    scan_layers: bool = False  # dense prefix makes the stack non-uniform
+    # the dense prefix is looped; a uniform MoE suffix (interval 1 reaching
+    # the last layer — every released Ernie-4.5 MoE) scans so compile time
+    # stays ~flat in depth. Non-contiguous MoE patterns fall back to looping.
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "Ernie45MoeConfig":
-        if self.scan_layers:
-            raise ValueError("ernie4_5_moe layers are looped; set scan_layers=False")
         if self.num_attention_heads % self.num_key_value_heads:
             raise ValueError(
                 f"num_attention_heads ({self.num_attention_heads}) must be "
@@ -93,3 +94,19 @@ class Ernie45MoeConfig(BaseModelConfig):
             self.moe_layer_start_index <= layer_idx <= end
             and (layer_idx + 1) % self.moe_layer_interval == 0
         )
+
+    @property
+    def num_scanned_layers(self) -> int:
+        """Depth of the scanned uniform MoE suffix (0 = loop everything).
+        Scans only when every layer from moe_layer_start_index on is MoE —
+        interval != 1 or an early end index makes the suffix non-uniform."""
+        if not self.scan_layers or self.moe_layer_interval != 1:
+            return 0
+        end = (
+            self.moe_layer_end_index
+            if self.moe_layer_end_index >= 0
+            else self.num_hidden_layers - 1
+        )
+        if end != self.num_hidden_layers - 1:
+            return 0
+        return self.num_hidden_layers - self.moe_layer_start_index

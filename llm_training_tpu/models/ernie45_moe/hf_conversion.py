@@ -17,6 +17,7 @@ from llm_training_tpu.models.llama.hf_conversion import (
     _set_path,
     _to_numpy,
 )
+from llm_training_tpu.models.moe_scan_io import layers_from_hf, layers_to_hf
 
 _EXPERT_PROJS = ("gate_proj", "up_proj", "down_proj")
 
@@ -90,29 +91,31 @@ def params_from_hf(
     if config.use_bias:
         put(("lm_head_bias",), _to_numpy(sd["lm_head.bias"]))
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            if path[-1] == "e_score_correction_bias":
-                value = value.reshape(-1)  # HF stores [1, E]
-            put((f"layers_{i}",) + path, value.T if transpose else value)
-        if config.layer_is_moe(i):
-            for proj in _EXPERT_PROJS:
-                put(
-                    (f"layers_{i}", "mlp", f"experts_{proj}"),
-                    np.stack([
-                        _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
-                        for e in range(config.moe_num_experts)
-                    ]),
-                )
-                if config.use_bias:
-                    put(
-                        (f"layers_{i}", "mlp", f"experts_{proj}_bias"),
-                        np.stack([
-                            _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.bias"])
-                            for e in range(config.moe_num_experts)
-                        ]),
-                    )
+    def layer_value(sd, i, hf_name, transpose, path):
+        value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+        if path[-1] == "e_score_correction_bias":
+            value = value.reshape(-1)  # HF stores [1, E]
+        return value.T if transpose else value
+
+    def expert_parts(sd, i):
+        parts = {
+            ("mlp", f"experts_{proj}"): lambda proj=proj: np.stack([
+                _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
+                for e in range(config.moe_num_experts)
+            ])
+            for proj in _EXPERT_PROJS
+        }
+        if config.use_bias:
+            parts.update({
+                ("mlp", f"experts_{proj}_bias"): lambda proj=proj: np.stack([
+                    _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.bias"])
+                    for e in range(config.moe_num_experts)
+                ])
+                for proj in _EXPERT_PROJS
+            })
+        return parts
+
+    layers_from_hf(sd, config, put, _layer_params, expert_parts, layer_value)
     return {"params": params}
 
 
@@ -132,25 +135,22 @@ def params_to_hf(params: Mapping, config: Ernie45MoeConfig) -> dict[str, np.ndar
     if config.use_bias:
         out["lm_head.bias"] = np.asarray(_get_path(p, ("lm_head_bias",)))
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            if path[-1] == "e_score_correction_bias":
-                value = value.reshape(1, -1)  # HF stores [1, E]
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
-        if config.layer_is_moe(i):
-            for proj in _EXPERT_PROJS:
-                stacked = np.asarray(
-                    _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}"))
-                )
+    def value_out(value, transpose, path):
+        if path[-1] == "e_score_correction_bias":
+            value = value.reshape(1, -1)  # HF stores [1, E]
+        return value.T if transpose else value
+
+    def expert_out(get, i, out):
+        for proj in _EXPERT_PROJS:
+            stacked = get(("mlp", f"experts_{proj}"))  # [E, in, out]
+            for e in range(config.moe_num_experts):
+                out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+            if config.use_bias:
+                bias = get(("mlp", f"experts_{proj}_bias"))
                 for e in range(config.moe_num_experts):
-                    out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
-                if config.use_bias:
-                    bias = np.asarray(
-                        _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}_bias"))
-                    )
-                    for e in range(config.moe_num_experts):
-                        out[f"model.layers.{i}.mlp.experts.{e}.{proj}.bias"] = bias[e]
+                    out[f"model.layers.{i}.mlp.experts.{e}.{proj}.bias"] = bias[e]
+
+    layers_to_hf(p, config, out, _layer_params, expert_out, value_out)
     return out
 
 

@@ -54,15 +54,15 @@ class Glm4MoeConfig(BaseModelConfig):
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    scan_layers: bool = False  # dense prefix makes the stack non-uniform
+    # the dense prefix is looped; the uniform MoE suffix scans so compile
+    # time stays ~flat in depth
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "Glm4MoeConfig":
         if self.attention_dropout != 0.0:
             raise ValueError("attention_dropout is not supported; set it to 0.0")
-        if self.scan_layers:
-            raise ValueError("glm4_moe layers are looped; set scan_layers=False")
         if self.num_attention_heads % self.num_key_value_heads:
             raise ValueError(
                 f"num_attention_heads ({self.num_attention_heads}) must be "
@@ -93,3 +93,10 @@ class Glm4MoeConfig(BaseModelConfig):
 
     def layer_is_moe(self, layer_idx: int) -> bool:
         return layer_idx >= self.first_k_dense_replace
+
+    @property
+    def num_scanned_layers(self) -> int:
+        """Depth of the scanned uniform MoE suffix (0 = loop everything)."""
+        if not self.scan_layers:
+            return 0
+        return self.num_hidden_layers - self.first_k_dense_replace

@@ -18,6 +18,7 @@ from llm_training_tpu.models.llama.hf_conversion import (
     _set_path,
     _to_numpy,
 )
+from llm_training_tpu.models.moe_scan_io import layers_from_hf, layers_to_hf
 
 _ATTN = [
     (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
@@ -89,19 +90,16 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            put((f"layers_{i}",) + path, value.T if transpose else value)
-        if config.layer_is_moe(i):
-            for proj in _EXPERT_PROJS:
-                put(
-                    (f"layers_{i}", "mlp", f"experts_{proj}"),
-                    np.stack([
-                        _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
-                        for e in range(config.n_routed_experts)
-                    ]),
-                )
+    def expert_parts(sd, i):
+        return {
+            ("mlp", f"experts_{proj}"): lambda proj=proj: np.stack([
+                _to_numpy(sd[f"layers.{i}.mlp.experts.{e}.{proj}.weight"]).T
+                for e in range(config.n_routed_experts)
+            ])
+            for proj in _EXPERT_PROJS
+        }
+
+    layers_from_hf(sd, config, put, _layer_params, expert_parts)
     return {"params": params}
 
 
@@ -116,17 +114,13 @@ def params_to_hf(params: Mapping, config: Glm4MoeConfig) -> dict[str, np.ndarray
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
-        if config.layer_is_moe(i):
-            for proj in _EXPERT_PROJS:
-                stacked = np.asarray(
-                    _get_path(p, (f"layers_{i}", "mlp", f"experts_{proj}"))
-                )
-                for e in range(config.n_routed_experts):
-                    out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+    def expert_out(get, i, out):
+        for proj in _EXPERT_PROJS:
+            stacked = get(("mlp", f"experts_{proj}"))  # [E, in, out]
+            for e in range(config.n_routed_experts):
+                out[f"model.layers.{i}.mlp.experts.{e}.{proj}.weight"] = stacked[e].T
+
+    layers_to_hf(p, config, out, _layer_params, expert_out)
     return out
 
 

@@ -78,6 +78,20 @@ class Glm4MoeDecoderLayer(nn.Module):
         return hidden + mlp_out
 
 
+class _MoEScanBody(nn.Module):
+    """Scan body: one MoE layer (the uniform suffix after the dense prefix —
+    GLM-4.5 is 92 layers deep, so scanning is what keeps compile time flat)."""
+
+    config: Glm4MoeConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        hidden = Glm4MoeDecoderLayer(self.config, True, name="layer")(
+            hidden, segment_ids, cos, sin
+        )
+        return hidden, None
+
+
 class Glm4Moe(nn.Module):
     """GLM-4.5 causal LM with the `CausalLMProto` surface."""
 
@@ -119,13 +133,27 @@ class Glm4Moe(nn.Module):
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
         policy = _remat_policy(cfg)
-        for i in range(cfg.num_hidden_layers):
+        n_scanned = cfg.num_scanned_layers
+        for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = Glm4MoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(Glm4MoeDecoderLayer, policy=policy)
             hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
+        if n_scanned:
+            body = _MoEScanBody
+            if policy is not None:
+                body = nn.remat(_MoEScanBody, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=n_scanned,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="moe_layers")
+            hidden, _ = scanned(hidden, segment_ids, cos, sin)
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))

@@ -1,0 +1,116 @@
+"""Shared state-dict plumbing for dense-prefix + scanned-MoE-suffix stacks.
+
+The DeepSeek-layout MoE families (deepseek, glm4_moe, ernie45_moe) loop
+their dense prefix (`layers_{i}` flax keys) and scan the uniform MoE suffix
+(`moe_layers/layer` keys with a leading depth axis) — see
+`DeepseekConfig.num_scanned_layers`. This module holds the two traversal
+halves of the HF <-> flax conversion so each family only declares its key
+tables and per-value quirks. (hunyuan_moe is uniform end-to-end and scans
+ALL layers under `layers/layer` with its own conversion.)
+
+Capability parity: reference `hf_compat_model.py:96-119` (bidirectional
+state-dict conversion), extended to the stacked-suffix layout the reference
+never needs (torch loops modules; scan is a jax/XLA compile-time concern).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from llm_training_tpu.models.llama.hf_conversion import _get_path, _to_numpy
+
+LayerParamsFn = Callable[[Any, int], list]
+# expert_parts_fn(sd, i) -> {path_suffix: () -> stacked-[E, ...] array} for
+# layer i — thunks, so enumerating paths costs nothing and each stack is
+# materialized exactly once
+ExpertPartsFn = Callable[[Mapping, int], dict]
+# expert_out_fn(get, i, out): write HF expert keys for layer i, reading the
+# flax stacks through `get(path_suffix)`
+ExpertOutFn = Callable[[Callable, int, dict], None]
+
+
+def _default_value(sd: Mapping, i: int, hf_name: str, transpose: bool, path) -> np.ndarray:
+    value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
+    return value.T if transpose else value
+
+
+def layers_from_hf(
+    sd: Mapping,
+    config: Any,
+    put: Callable,
+    layer_params_fn: LayerParamsFn,
+    expert_parts_fn: ExpertPartsFn | None = None,
+    layer_value_fn: Callable = _default_value,
+) -> None:
+    """Populate layer params: looped prefix + one stacked tensor per path for
+    the scanned suffix (stacked one path at a time so a streaming `put` keeps
+    the host working set to a single tensor)."""
+    n_scanned = config.num_scanned_layers
+    prefix = config.num_hidden_layers - n_scanned
+    for i in range(prefix):
+        for path, hf_name, transpose in layer_params_fn(config, i):
+            put((f"layers_{i}",) + path, layer_value_fn(sd, i, hf_name, transpose, path))
+        if expert_parts_fn is not None and config.layer_is_moe(i):
+            for sub, thunk in expert_parts_fn(sd, i).items():
+                put((f"layers_{i}",) + sub, thunk())
+    if not n_scanned:
+        return
+    suffix = range(prefix, config.num_hidden_layers)
+    for path, hf_name, transpose in layer_params_fn(config, prefix):
+        put(
+            ("moe_layers", "layer") + path,
+            np.stack([layer_value_fn(sd, i, hf_name, transpose, path) for i in suffix]),
+        )
+    if expert_parts_fn is not None:
+        for sub in expert_parts_fn(sd, prefix):
+            put(
+                ("moe_layers", "layer") + sub,
+                np.stack([expert_parts_fn(sd, i)[sub]() for i in suffix]),
+            )
+
+
+def layers_to_hf(
+    p: Mapping,
+    config: Any,
+    out: dict,
+    layer_params_fn: LayerParamsFn,
+    expert_out_fn: ExpertOutFn | None = None,
+    value_out_fn: Callable | None = None,
+) -> None:
+    """Emit HF `model.layers.{i}.*` keys from the hybrid flax tree.
+
+    Stacked suffix tensors cross device->host ONCE per path and are sliced
+    per layer (a per-layer `np.asarray` would re-transfer the [L_s, ...]
+    stack L_s times — O(L^2) copies at real expert-weight sizes)."""
+    if value_out_fn is None:
+        value_out_fn = lambda value, transpose, path: value.T if transpose else value
+    n_scanned = config.num_scanned_layers
+    prefix = config.num_hidden_layers - n_scanned
+    for i in range(prefix):
+        for path, hf_name, transpose in layer_params_fn(config, i):
+            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+            out[f"model.layers.{i}.{hf_name}"] = value_out_fn(value, transpose, path)
+        if expert_out_fn is not None and config.layer_is_moe(i):
+            get = lambda sub, i=i: np.asarray(_get_path(p, (f"layers_{i}",) + sub))
+            expert_out_fn(get, i, out)
+    if not n_scanned:
+        return
+    cache: dict = {}
+
+    def fetch(path):
+        if path not in cache:
+            cache[path] = np.asarray(_get_path(p, ("moe_layers", "layer") + path))
+        return cache[path]
+
+    for path, hf_name, transpose in layer_params_fn(config, prefix):
+        stacked = fetch(path)
+        for s in range(n_scanned):
+            out[f"model.layers.{prefix + s}.{hf_name}"] = value_out_fn(
+                stacked[s], transpose, path
+            )
+    if expert_out_fn is not None:
+        for s in range(n_scanned):
+            get = lambda sub, s=s: fetch(sub)[s]
+            expert_out_fn(get, prefix + s, out)

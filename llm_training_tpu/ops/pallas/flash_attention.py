@@ -88,6 +88,23 @@ def _seg_mask(seg_q, seg_kv):
     return (seg_q[:, None] == seg_kv[None, :]) & (seg_q[:, None] > 0)
 
 
+def _seg_overlap(seg_q, seg_kv):
+    """Scalar predicate: the q tile's segment-id range intersects the kv
+    tile's, and the q tile is not all padding. Packed documents occupy
+    consecutive rows, so disjoint ranges ⇒ fully-masked tile ⇒ skip it —
+    this makes packed attention cost the sum of per-document squares instead
+    of the full quadratic (the varlen win of the reference's flash-attn
+    dispatch, `attention_op.py:538-654`, at tile granularity). Range
+    intersection is conservative (interleaved ids only cost a visit, never a
+    wrong skip), and padding zeros only widen the ranges."""
+    q_max = jnp.max(seg_q)
+    return (
+        (jnp.min(seg_q) <= jnp.max(seg_kv))
+        & (jnp.min(seg_kv) <= q_max)
+        & (q_max > 0)
+    )
+
+
 def _seg_uniform(seg_q, seg_kv):
     """Scalar predicate: both blocks hold one identical non-padding segment,
     so the segment mask is all-True and can be skipped. Four cheap vector
@@ -189,7 +206,8 @@ def _scores(q, k, scale: float, logits_soft_cap: float | None):
     s = lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-    s = s * scale
+    if scale != 1.0:  # callers fold scale into q; this is the generic path
+        s = s * scale
     if logits_soft_cap is not None:
         s = logits_soft_cap * jnp.tanh(s / logits_soft_cap)
     return s
@@ -274,7 +292,9 @@ def _fwd_kernel(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
-    visit = _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    visit = _should_visit(
+        i, j, block_q, block_k, q_offset, causal, sliding_window
+    ) & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
     uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     _masked_dispatch(visit, interior, uniform, _visit)
@@ -344,12 +364,15 @@ def _dq_kernel(
         ds = p * (dp - delta)
         if logits_soft_cap is not None:
             ds = ds * (1.0 - (s / logits_soft_cap) ** 2)
-        ds = ds * scale
+        if scale != 1.0:
+            ds = ds * scale
         dq_scr[:] += jnp.dot(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
-    visit = _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    visit = _should_visit(
+        i, j, block_q, block_k, q_offset, causal, sliding_window
+    ) & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
     uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     _masked_dispatch(visit, interior, uniform, _visit)
@@ -421,13 +444,16 @@ def _dkv_kernel(
         ds = p * (dp - delta)
         if logits_soft_cap is not None:
             ds = ds * (1.0 - (s / logits_soft_cap) ** 2)
-        ds = ds * scale
+        if scale != 1.0:
+            ds = ds * scale
         dk_scr[:] += lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    visit = _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+    visit = _should_visit(
+        i, j, block_q, block_k, q_offset, causal, sliding_window
+    ) & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
     uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     _masked_dispatch(visit, interior, uniform, _visit)
@@ -722,6 +748,16 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     orig_dtype = q.dtype
+    # fold the softmax scale into q: one multiply per q element replaces one
+    # per SCORE element in every kernel (fwd + both bwd recomputes) — the
+    # kernels are VPU-bound, so per-score passes are the scarce resource.
+    # Gradients stay exact: autodiff chains dq through this multiply, and
+    # dk = ds_unscaled · (q·scale) == (ds_unscaled·scale) · q inside the
+    # kernel. The tiny bf16 rounding shift is the standard pre-scaled-q
+    # formulation (flash-attn does the same).
+    if scale != 1.0:
+        q = q * jnp.asarray(scale, q.dtype)
+        scale = 1.0
 
     if q_segment_ids is None:
         if segment_ids is not None and q_len != kv_len:

@@ -208,6 +208,14 @@ def ring_attention(
         raise NotImplementedError("ring attention currently requires causal=True")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    # fold scale into q (see ops/pallas/flash_attention.py: the kernels are
+    # VPU-bound, and the chunk kernels run once per ring step — folding pays
+    # once per q chunk instead of once per score per step). Autodiff chains
+    # dq through this multiply; dk inside uses q·scale which cancels against
+    # the kernels' unscaled ds.
+    if scale != 1.0:
+        q = q * jnp.asarray(scale, q.dtype)
+        scale = 1.0
     if segment_ids is None:
         segment_ids = jnp.ones(q.shape[:2], jnp.int32)
     segment_ids = segment_ids.astype(jnp.int32)
