@@ -100,8 +100,31 @@ def main() -> None:
             recompute_granularity="full",
         )
         default_seq, default_batch = 2048, 16
+    elif bench_model == "moe":
+        # MoE proxy at the 697M-class shape (VERDICT r3 #2): 8 experts,
+        # top-2, expert width sized so TOTAL expert params/layer match the
+        # 697M dense MLP (8·3·h·704 == 3·h·5632) — measures the dropless
+        # sort/ragged_dot/scatter dispatch against the same memory budget.
+        model_kwargs = dict(
+            vocab_size=32000,
+            hidden_size=2048,
+            intermediate_size=5632,
+            num_hidden_layers=12,
+            num_attention_heads=16,
+            num_key_value_heads=8,
+            head_dim=128,
+            max_position_embeddings=2048,
+            num_experts=8,
+            num_experts_per_tok=2,
+            moe_intermediate_size=704,
+            enable_gradient_checkpointing=True,
+            recompute_granularity="full",
+        )
+        default_seq, default_batch = 2048, 16
     else:
-        raise SystemExit(f"unknown BENCH_MODEL {bench_model!r}; use 8b-layer or 697m")
+        raise SystemExit(
+            f"unknown BENCH_MODEL {bench_model!r}; use 8b-layer, 697m or moe"
+        )
     # sweep overrides (experiments only; defaults above are the recorded bench)
     remat = os.environ.get("BENCH_REMAT")
     if remat == "none":
@@ -187,7 +210,13 @@ def main() -> None:
             trace_dir=os.environ["BENCH_PROFILE"], start_step=4, num_steps=2,
         )))
     trainer = Trainer(
-        TrainerConfig(max_steps=steps, log_every_n_steps=steps, mesh=MeshConfig()),
+        TrainerConfig(
+            max_steps=steps, log_every_n_steps=steps, mesh=MeshConfig(),
+            # BENCH_OFFLOAD=1 parks fp32 mu/nu in pinned host memory (XLA
+            # host offloading) — frees 8 bytes/param of HBM for bigger
+            # models at a per-step transfer cost (recorded in BASELINE.md)
+            offload_optimizer_state=bool(os.environ.get("BENCH_OFFLOAD")),
+        ),
         callbacks=callbacks,
     )
     trainer.fit(objective, datamodule)
@@ -203,21 +232,37 @@ def main() -> None:
     tokens_per_sec_chip = tokens_per_sec / max(1, n_dev)
 
     cfg = objective.model.config
-    n_params = (
-        cfg.vocab_size * cfg.hidden_size * 2
-        + cfg.num_hidden_layers
-        * (
-            cfg.hidden_size * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
-            * cfg.resolved_head_dim
-            + cfg.num_attention_heads * cfg.resolved_head_dim * cfg.hidden_size
-            + 3 * cfg.hidden_size * cfg.intermediate_size
-            + 2 * cfg.hidden_size
-        )
+    attn_params = (
+        cfg.hidden_size * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads)
+        * cfg.resolved_head_dim
+        + cfg.num_attention_heads * cfg.resolved_head_dim * cfg.hidden_size
+        + 2 * cfg.hidden_size
     )
+    if cfg.num_experts:
+        expert_mlp = 3 * cfg.hidden_size * cfg.moe_intermediate_size
+        router = cfg.hidden_size * cfg.num_experts
+        n_params = (
+            cfg.vocab_size * cfg.hidden_size * 2
+            + cfg.num_hidden_layers
+            * (attn_params + router + cfg.num_experts * expert_mlp)
+        )
+        # MoE MFU credits ACTIVATED params only (top-k experts per token) —
+        # the standard sparse-model convention; total params still reported
+        n_active = (
+            cfg.vocab_size * cfg.hidden_size * 2
+            + cfg.num_hidden_layers
+            * (attn_params + router + cfg.num_experts_per_tok * expert_mlp)
+        )
+    else:
+        n_params = n_active = (
+            cfg.vocab_size * cfg.hidden_size * 2
+            + cfg.num_hidden_layers
+            * (attn_params + 3 * cfg.hidden_size * cfg.intermediate_size)
+        )
     # standard MFU convention (PaLM appendix B): model FLOPs only — 6N per
     # token fwd+bwd plus the attention quadratic 12·L·h·S; rematerialization
     # is NOT credited (it is overhead, not useful work)
-    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_active + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
     mfu = tokens_per_sec_chip * flops_per_token / _detect_peak()
 
     print(json.dumps({

@@ -90,6 +90,9 @@ class WandbLoggerConfig(BaseModel):
     name: str | None = None
     entity: str | None = None
     mode: str = "offline"  # zero-egress default; 'online' where permitted
+    # upload the resolved run config YAML + a snapshot of the framework's
+    # .py sources to the run (reference save_config_callback.py:15-41)
+    log_code: bool = True
 
 
 class WandbLogger:
@@ -119,6 +122,26 @@ class WandbLogger:
             config=run_config,
             resume="allow",
         )
+        if cfg.log_code:
+            # resolved config as a run file + the package's .py sources as a
+            # code artifact — the reference's `experiment.save(config_path)`
+            # + `log_code` pair (save_config_callback.py:38-41), so a run is
+            # reproducible from its W&B page alone
+            import yaml
+
+            if run_config is not None:
+                config_path = save_dir / "config.yaml"
+                with open(config_path, "w") as f:
+                    yaml.safe_dump(run_config, f, sort_keys=False)
+                self._run.save(str(config_path), base_path=str(save_dir), policy="now")
+            import llm_training_tpu
+
+            root = Path(llm_training_tpu.__file__).parent
+            self._run.log_code(
+                root=str(root),
+                name=f"source-{cfg.project}",
+                include_fn=lambda p: p.endswith(".py"),
+            )
 
     def on_step_end(self, trainer, step, metrics) -> None:
         if self._run is not None:

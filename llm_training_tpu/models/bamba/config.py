@@ -48,18 +48,21 @@ class BambaConfig(BaseModelConfig):
     mamba_conv_bias: bool = True
     mamba_proj_bias: bool = False
     mamba_chunk_size: int = 256
+    # opt-in: reset the SSD state at packed-document boundaries (HF leaks
+    # state across documents; see mamba2_ssd)
+    segment_state_reset: bool = False
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    scan_layers: bool = False  # mamba/attention mix is non-uniform
+    # a periodic mamba/attention pattern scans as one body per period;
+    # non-periodic attn_layer_indices (the released Bamba-9B placement) loop
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "BambaConfig":
         if self.attention_dropout != 0.0:
             raise ValueError("attention_dropout is not supported; set it to 0.0")
-        if self.scan_layers:
-            raise ValueError("bamba layers are looped; set scan_layers=False")
         if self.mamba_n_heads * self.mamba_d_head != self.mamba_intermediate:
             raise ValueError(
                 "mamba_n_heads * mamba_d_head must equal "
@@ -104,3 +107,14 @@ class BambaConfig(BaseModelConfig):
 
     def layer_is_attention(self, layer_idx: int) -> bool:
         return bool(self.attn_layer_indices) and layer_idx in self.attn_layer_indices
+
+    @property
+    def scan_period(self) -> int:
+        """Scan-body depth (0 = loop), from the mamba/attention repetition."""
+        if not self.scan_layers:
+            return 0
+        from llm_training_tpu.models.moe_scan_io import detect_period
+
+        return detect_period(
+            [self.layer_is_attention(i) for i in range(self.num_hidden_layers)]
+        )

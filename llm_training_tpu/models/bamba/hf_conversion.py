@@ -18,6 +18,10 @@ from llm_training_tpu.models.llama.hf_conversion import (
     _set_path,
     _to_numpy,
 )
+from llm_training_tpu.models.moe_scan_io import (
+    periodic_layers_from_hf,
+    periodic_layers_to_hf,
+)
 
 _ATTN = [
     (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
@@ -82,13 +86,17 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            put((f"layers_{i}",) + path, value.T if transpose else value)
-        if not config.layer_is_attention(i):
-            conv = _to_numpy(sd[f"layers.{i}.mamba.conv1d.weight"])
-            put((f"layers_{i}", "mamba", "conv_kernel"), conv[:, 0, :].T)
+    def extras(sd, i):
+        if config.layer_is_attention(i):
+            return {}
+        # HF depthwise conv [C, 1, K] -> our [K, C]
+        return {
+            ("mamba", "conv_kernel"): lambda: _to_numpy(
+                sd[f"layers.{i}.mamba.conv1d.weight"]
+            )[:, 0, :].T
+        }
+
+    periodic_layers_from_hf(sd, config, put, _layer_params, extras_fn=extras)
     return {"params": params}
 
 
@@ -103,13 +111,12 @@ def params_to_hf(params: Mapping, config: BambaConfig) -> dict[str, np.ndarray]:
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    def extras_out(get, i, out):
         if not config.layer_is_attention(i):
-            conv = np.asarray(_get_path(p, (f"layers_{i}", "mamba", "conv_kernel")))
+            conv = get(("mamba", "conv_kernel"))
             out[f"model.layers.{i}.mamba.conv1d.weight"] = conv.T[:, None, :]
+
+    periodic_layers_to_hf(p, config, out, _layer_params, extras_out_fn=extras_out)
     return out
 
 

@@ -20,7 +20,9 @@ Graph verified against HF `modeling_bamba.py` (`BambaMixer.torch_forward`):
 
 Padding mirrors HF `apply_mask_to_padding_states`: padded tokens zero at
 the mixer input and after the conv, but the SSM state decays THROUGH
-padding and across packed documents (no boundary reset — same as HF).
+padding and across packed documents by default (HF parity).
+`segment_state_reset=True` (opt-in) resets the SSD state and confines the
+causal conv at packed-document boundaries (see `mamba2_ssd`).
 """
 
 from __future__ import annotations
@@ -66,8 +68,15 @@ def mamba2_ssd(
     b_mat: jnp.ndarray,  # [B, S, H, N]
     c_mat: jnp.ndarray,  # [B, S, H, N]
     chunk_size: int,
+    reset_decay: jnp.ndarray | None = None,  # [B, S]; see qwen3_next
 ) -> jnp.ndarray:
-    """Chunked Mamba-2 SSD (HF torch_forward's 'ssd naive' branch), fp32."""
+    """Chunked Mamba-2 SSD (HF torch_forward's 'ssd naive' branch), fp32.
+
+    `reset_decay` (from `qwen3_next.model.segment_reset_decay`) adds -1e4 to
+    the log-decay at document starts: every cross-boundary factor — the
+    intra-chunk L matrix, chunk-state writes, the carried-state decay, and
+    the inter-chunk reads — then underflows to exactly zero, resetting the
+    SSD state per packed document."""
     in_dtype = x.dtype
     x = x.astype(jnp.float32)
     dt = dt.astype(jnp.float32)
@@ -77,6 +86,8 @@ def mamba2_ssd(
     batch, seq, heads, p = x.shape
     xbar = x * dt[..., None]
     abar = a.astype(jnp.float32)[None, None, :] * dt  # [B, S, H]
+    if reset_decay is not None:
+        abar = abar + reset_decay.astype(jnp.float32)[..., None]
 
     pad = (-seq) % chunk_size
     if pad:
@@ -130,7 +141,7 @@ class BambaMixer(nn.Module):
     config: BambaConfig
 
     @nn.compact
-    def __call__(self, hidden, pad_mask):
+    def __call__(self, hidden, pad_mask, segment_ids=None):
         cfg = self.config
         batch, seq, _ = hidden.shape
         inter = cfg.mamba_intermediate
@@ -159,9 +170,24 @@ class BambaMixer(nn.Module):
             cfg.param_jnp_dtype,
         ).astype(xbc.dtype)
         padded = jnp.pad(xbc, ((0, 0), (cfg.mamba_d_conv - 1, 0), (0, 0)))
-        conv = sum(
-            padded[:, i:i + seq] * conv_w[i] for i in range(cfg.mamba_d_conv)
+        reset_on = (
+            getattr(cfg, "segment_state_reset", False) and segment_ids is not None
         )
+        if reset_on:
+            # keep the causal conv window inside the document (see
+            # qwen3_next.GatedDeltaNet): cross-segment taps become the zeros
+            # a standalone run's left-padding would supply
+            seg_p = jnp.pad(segment_ids, ((0, 0), (cfg.mamba_d_conv - 1, 0)))
+            conv = sum(
+                padded[:, i:i + seq]
+                * conv_w[i]
+                * (seg_p[:, i:i + seq] == segment_ids)[..., None]
+                for i in range(cfg.mamba_d_conv)
+            )
+        else:
+            conv = sum(
+                padded[:, i:i + seq] * conv_w[i] for i in range(cfg.mamba_d_conv)
+            )
         if cfg.mamba_conv_bias:
             conv_b = self.param(
                 "conv_bias",
@@ -201,7 +227,14 @@ class BambaMixer(nn.Module):
         dt = jax.nn.softplus(dt.astype(jnp.float32) + dt_bias)
         a = -jnp.exp(a_log)
 
-        y = mamba2_ssd(x, dt, a, b_mat, c_mat, cfg.mamba_chunk_size)
+        reset = None
+        if getattr(cfg, "segment_state_reset", False) and segment_ids is not None:
+            from llm_training_tpu.models.qwen3_next.model import segment_reset_decay
+
+            reset = segment_reset_decay(segment_ids)
+        y = mamba2_ssd(
+            x, dt, a, b_mat, c_mat, cfg.mamba_chunk_size, reset_decay=reset
+        )
         y = y + (d_skip[None, None, :, None] * x.astype(jnp.float32)).astype(y.dtype)
         y = y.reshape(batch, seq, inter)
         y = GatedRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(y, gate)
@@ -256,11 +289,26 @@ class BambaDecoderLayer(nn.Module):
         if self.is_attention:
             block = BambaAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
         else:
-            block = BambaMixer(cfg, name="mamba")(normed, pad_mask)
+            block = BambaMixer(cfg, name="mamba")(normed, pad_mask, segment_ids)
         hidden = hidden + block
 
         normed = norm("pre_ff_layernorm")(hidden)
         return hidden + LlamaMLP(cfg, name="feed_forward")(normed)
+
+
+class _PeriodicBody(nn.Module):
+    """Scan body: one period of the mamba/attention pattern."""
+
+    config: BambaConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        for j in range(cfg.scan_period):
+            hidden = BambaDecoderLayer(
+                cfg, cfg.layer_is_attention(j), name=f"slot{j}"
+            )(hidden, segment_ids, cos, sin)
+        return hidden, None
 
 
 class Bamba(nn.Module):
@@ -304,13 +352,28 @@ class Bamba(nn.Module):
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
         policy = _remat_policy(cfg)
-        for i in range(cfg.num_hidden_layers):
-            layer_cls = BambaDecoderLayer
+        period = cfg.scan_period
+        if period:
+            body = _PeriodicBody
             if policy is not None:
-                layer_cls = nn.remat(BambaDecoderLayer, policy=policy)
-            hidden = layer_cls(cfg, cfg.layer_is_attention(i), name=f"layers_{i}")(
-                hidden, segment_ids, cos, sin
-            )
+                body = nn.remat(_PeriodicBody, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers // period,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            hidden, _ = scanned(hidden, segment_ids, cos, sin)
+        else:
+            for i in range(cfg.num_hidden_layers):
+                layer_cls = BambaDecoderLayer
+                if policy is not None:
+                    layer_cls = nn.remat(BambaDecoderLayer, policy=policy)
+                hidden = layer_cls(
+                    cfg, cfg.layer_is_attention(i), name=f"layers_{i}"
+                )(hidden, segment_ids, cos, sin)
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="final_layernorm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))

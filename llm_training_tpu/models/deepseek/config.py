@@ -63,6 +63,10 @@ class DeepseekConfig(BaseModelConfig):
     topk_method: Literal["greedy", "group_limited_greedy"] = "greedy"
     # 'ragged' = dropless grouped matmul; 'dense' = exact every-expert path
     moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # per-rank buffer slack for the expert-parallel dispatch: capacity =
+    # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
+    # dropped, so raise this if EP training shows imbalance-driven drops
+    ep_capacity_factor: float = 2.0
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"

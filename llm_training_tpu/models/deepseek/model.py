@@ -206,16 +206,19 @@ class DeepseekMoE(nn.Module):
             up = jnp.einsum("th,ehi->tei", xc, w_up)
             return jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
 
-        def ragged_fn(xs, group_sizes, expert_order):
-            gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-            up = jax.lax.ragged_dot(xs, w_up, group_sizes)
-            return jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
+        def ragged_fn(xs, group_sizes, expert_order, w):
+            wg, wu, wd = w
+            gate = jax.lax.ragged_dot(xs, wg, group_sizes)
+            up = jax.lax.ragged_dot(xs, wu, group_sizes)
+            return jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
 
         from llm_training_tpu.models.moe import dropless_moe_apply
 
         out = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
+            weights=(w_gate, w_up, w_down),
+            ep_capacity_factor=getattr(cfg, "ep_capacity_factor", 2.0),
         )
         out = out.reshape(batch, seq, embed).astype(hidden.dtype)
         shared = DeepseekMLP(

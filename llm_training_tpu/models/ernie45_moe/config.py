@@ -47,6 +47,10 @@ class Ernie45MoeConfig(BaseModelConfig):
     moe_layer_interval: int = 1
     moe_norm_min: float = 1e-12
     moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # per-rank buffer slack for the expert-parallel dispatch: capacity =
+    # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
+    # dropped, so raise this if EP training shows imbalance-driven drops
+    ep_capacity_factor: float = 2.0
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"

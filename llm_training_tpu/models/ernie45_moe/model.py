@@ -149,18 +149,28 @@ class Ernie45MoeBlock(nn.Module):
             out = jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
             return out + b_down[None] if cfg.use_bias else out
 
-        def ragged_fn(xs, group_sizes, expert_order):
-            gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-            up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+        def ragged_fn(xs, group_sizes, expert_order, w):
             if cfg.use_bias:
-                gate = gate + b_gate[expert_order]
-                up = up + b_up[expert_order]
-            out = jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
-            return out + b_down[expert_order] if cfg.use_bias else out
+                wg, wu, wd, bg, bu, bd = w
+            else:
+                wg, wu, wd = w
+            gate = jax.lax.ragged_dot(xs, wg, group_sizes)
+            up = jax.lax.ragged_dot(xs, wu, group_sizes)
+            if cfg.use_bias:
+                gate = gate + bg[expert_order]
+                up = up + bu[expert_order]
+            out = jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
+            return out + bd[expert_order] if cfg.use_bias else out
 
         out = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
+            weights=(
+                (w_gate, w_up, w_down, b_gate, b_up, b_down)
+                if cfg.use_bias
+                else (w_gate, w_up, w_down)
+            ),
+            ep_capacity_factor=getattr(cfg, "ep_capacity_factor", 2.0),
         )
         out = out.reshape(batch, seq, embed).astype(hidden.dtype)
         if cfg.moe_num_shared_experts:

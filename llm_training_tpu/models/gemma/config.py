@@ -60,6 +60,9 @@ class GemmaConfig(BaseModelConfig):
     recompute_granularity: Literal["full", "selective"] = "full"
     scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+    # context parallelism: shard the sequence axis and run ring attention
+    # (sliding windows and sinks compose; see parallel/ring_attention.py)
+    ring_attention: bool = False
 
     @model_validator(mode="after")
     def _validate(self) -> "GemmaConfig":

@@ -93,15 +93,29 @@ class GemmaAttention(nn.Module):
             q = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
             k = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
         q, k = apply_rope(q, k, cos, sin)
-        out = dot_product_attention(
-            q, k, v,
-            segment_ids=segment_ids,
-            causal=True,
-            sliding_window=self.sliding_window,
-            logits_soft_cap=cfg.attn_logit_softcapping,
-            scale=cfg.attention_scale,
-            impl=cfg.attention_impl,
-        )
+        out = None
+        if getattr(cfg, "ring_attention", False):
+            from llm_training_tpu.parallel.ring_attention import (
+                dispatch_ring_attention,
+            )
+
+            out = dispatch_ring_attention(
+                q, k, v, segment_ids,
+                sliding_window=self.sliding_window,
+                logits_soft_cap=cfg.attn_logit_softcapping,
+                scale=cfg.attention_scale,
+                impl=cfg.attention_impl,
+            )
+        if out is None:
+            out = dot_product_attention(
+                q, k, v,
+                segment_ids=segment_ids,
+                causal=True,
+                sliding_window=self.sliding_window,
+                logits_soft_cap=cfg.attn_logit_softcapping,
+                scale=cfg.attention_scale,
+                impl=cfg.attention_impl,
+            )
         out = out.astype(hidden.dtype).reshape(batch, seq, cfg.num_attention_heads * cfg.head_dim)
         return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj")(out)
 

@@ -46,19 +46,25 @@ class GptOssConfig(BaseModelConfig):
     router_aux_loss_coef: float = 0.9
     # 'ragged' = dropless grouped matmul; 'dense' = exact every-expert path
     moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # per-rank buffer slack for the expert-parallel dispatch: capacity =
+    # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
+    # dropped, so raise this if EP training shows imbalance-driven drops
+    ep_capacity_factor: float = 2.0
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    # sliding/full alternation makes the layer body non-uniform; looped
-    scan_layers: bool = False
+    # the sliding/full alternation scans as a (sliding, full) PAIR body —
+    # `scan_period` detects the repetition; non-periodic layer_types loop
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
+    # context parallelism: shard the sequence axis and run ring attention
+    # (sliding windows and sinks compose; see parallel/ring_attention.py)
+    ring_attention: bool = False
 
     @model_validator(mode="after")
     def _validate(self) -> "GptOssConfig":
         if self.attention_dropout != 0.0:
             raise ValueError("attention_dropout is not supported; set it to 0.0")
-        if self.scan_layers:
-            raise ValueError("gpt-oss layers are looped; set scan_layers=False")
         if self.layer_types is not None and len(self.layer_types) != self.num_hidden_layers:
             raise ValueError(
                 f"layer_types has {len(self.layer_types)} entries for "
@@ -92,3 +98,15 @@ class GptOssConfig(BaseModelConfig):
             else ("sliding_attention" if layer_idx % 2 == 0 else "full_attention")
         )
         return self.sliding_window if kind == "sliding_attention" else None
+
+    @property
+    def scan_period(self) -> int:
+        """Scan-body depth (0 = loop): 2 for the stock sliding/full
+        alternation, 1 when every layer shares one window kind."""
+        if not self.scan_layers:
+            return 0
+        from llm_training_tpu.models.moe_scan_io import detect_period
+
+        return detect_period(
+            [self.layer_sliding_window(i) for i in range(self.num_hidden_layers)]
+        )

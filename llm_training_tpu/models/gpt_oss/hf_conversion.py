@@ -18,6 +18,10 @@ from llm_training_tpu.models.llama.hf_conversion import (
     _set_path,
     _to_numpy,
 )
+from llm_training_tpu.models.moe_scan_io import (
+    periodic_layers_from_hf,
+    periodic_layers_to_hf,
+)
 
 _LAYER_PARAMS = [
     (("self_attn", "q_proj", "kernel"), "self_attn.q_proj.weight", True),
@@ -54,10 +58,7 @@ def params_from_hf(
     put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
     put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _LAYER_PARAMS:
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            put((f"layers_{i}",) + path, value.T if transpose else value)
+    periodic_layers_from_hf(sd, config, put, lambda config, i: _LAYER_PARAMS)
     return {"params": params}
 
 
@@ -71,10 +72,7 @@ def params_to_hf(params: Mapping, config: GptOssConfig) -> dict[str, np.ndarray]
     out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
     out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _LAYER_PARAMS:
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    periodic_layers_to_hf(p, config, out, lambda config, i: _LAYER_PARAMS)
     return out
 
 

@@ -62,14 +62,27 @@ class GptOssAttention(nn.Module):
             (cfg.num_attention_heads,),
             cfg.param_jnp_dtype,
         )
-        out = dot_product_attention(
-            q, k, v,
-            segment_ids=segment_ids,
-            causal=True,
-            sliding_window=self.sliding_window,
-            sinks=sinks.astype(jnp.float32),
-            impl=cfg.attention_impl,
-        )
+        out = None
+        if getattr(cfg, "ring_attention", False):
+            from llm_training_tpu.parallel.ring_attention import (
+                dispatch_ring_attention,
+            )
+
+            out = dispatch_ring_attention(
+                q, k, v, segment_ids,
+                sliding_window=self.sliding_window,
+                sinks=sinks.astype(jnp.float32),
+                impl=cfg.attention_impl,
+            )
+        if out is None:
+            out = dot_product_attention(
+                q, k, v,
+                segment_ids=segment_ids,
+                causal=True,
+                sliding_window=self.sliding_window,
+                sinks=sinks.astype(jnp.float32),
+                impl=cfg.attention_impl,
+            )
         out = out.astype(hidden.dtype).reshape(
             batch, seq, cfg.num_attention_heads * cfg.head_dim
         )
@@ -149,19 +162,22 @@ class GptOssMoE(nn.Module):
                 "tei,eih->teh", _expert_act(fused[..., ::2], fused[..., 1::2]), w_down
             ) + b_down[None]
 
-        def ragged_fn(xs, group_sizes, expert_order):
-            fused = jax.lax.ragged_dot(xs, w_gate_up, group_sizes)
-            fused = fused + b_gate_up[expert_order]
+        def ragged_fn(xs, group_sizes, expert_order, w):
+            wgu, bgu, wd, bd = w
+            fused = jax.lax.ragged_dot(xs, wgu, group_sizes)
+            fused = fused + bgu[expert_order]
             ys = jax.lax.ragged_dot(
-                _expert_act(fused[..., ::2], fused[..., 1::2]), w_down, group_sizes
+                _expert_act(fused[..., ::2], fused[..., 1::2]), wd, group_sizes
             )
-            return ys + b_down[expert_order]
+            return ys + bd[expert_order]
 
         from llm_training_tpu.models.moe import dropless_moe_apply
 
         out = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
+            weights=(w_gate_up, b_gate_up, w_down, b_down),
+            ep_capacity_factor=getattr(cfg, "ep_capacity_factor", 2.0),
         )
 
         # router statistics for the aux loss (HF load_balancing_loss_func
@@ -201,6 +217,25 @@ class GptOssDecoderLayer(nn.Module):
         pad_mask = None if segment_ids is None else segment_ids > 0
         mlp_out, stats = GptOssMoE(cfg, name="mlp")(normed, pad_mask)
         return hidden + mlp_out, stats
+
+
+class _PeriodicBody(nn.Module):
+    """Scan body: one period of the sliding/full pattern (`scan_period`
+    layers). The per-layer router stats come out as the scan's stacked
+    output, [cycles, period, E] after the scan."""
+
+    config: GptOssConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        stats = []
+        for j in range(cfg.scan_period):
+            hidden, layer_stats = GptOssDecoderLayer(
+                cfg, cfg.layer_sliding_window(j), name=f"slot{j}"
+            )(hidden, segment_ids, cos, sin)
+            stats.append(layer_stats)
+        return hidden, jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
 
 
 class GptOss(nn.Module):
@@ -244,20 +279,38 @@ class GptOss(nn.Module):
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
         policy = _remat_policy(cfg)
-        stats = []
-        for i in range(cfg.num_hidden_layers):
-            layer_cls = GptOssDecoderLayer
+        period = cfg.scan_period
+        if period:
+            body = _PeriodicBody
             if policy is not None:
-                layer_cls = nn.remat(GptOssDecoderLayer, policy=policy)
-            hidden, layer_stats = layer_cls(
-                cfg, cfg.layer_sliding_window(i), name=f"layers_{i}"
-            )(hidden, segment_ids, cos, sin)
-            stats.append(layer_stats)
+                body = nn.remat(_PeriodicBody, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers // period,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            hidden, (sel_frac, mean_prob) = scanned(hidden, segment_ids, cos, sin)
+            # [cycles, period, E] -> [L, E]; depth order is irrelevant to the
+            # mean-pooled aux loss below
+            sel_frac = sel_frac.reshape(-1, sel_frac.shape[-1])
+            mean_prob = mean_prob.reshape(-1, mean_prob.shape[-1])
+        else:
+            stats = []
+            for i in range(cfg.num_hidden_layers):
+                layer_cls = GptOssDecoderLayer
+                if policy is not None:
+                    layer_cls = nn.remat(GptOssDecoderLayer, policy=policy)
+                hidden, layer_stats = layer_cls(
+                    cfg, cfg.layer_sliding_window(i), name=f"layers_{i}"
+                )(hidden, segment_ids, cos, sin)
+                stats.append(layer_stats)
+            sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
-
-        sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
         aux_loss = cfg.num_local_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )

@@ -43,18 +43,23 @@ class HFCausalLMConfig(BaseModel):
 
     hf_path: str
     load_hf_weights: bool = True
+    # route UNKNOWN model_types to the Llama family (renamed llama-graph
+    # forks); the conversion still fails loudly on layout mismatches
+    assume_llama_layout: bool = False
 
 
 def resolve_hf_model(config: HFCausalLMConfig) -> Any:
     hf_config = load_hf_config(config.hf_path)
-    model_cls = import_class(model_class_for_hf(hf_config))
+    model_cls = import_class(
+        model_class_for_hf(hf_config, config.assume_llama_layout)
+    )
     conversion = importlib.import_module(
         model_cls.__module__.rsplit(".", 1)[0] + ".hf_conversion"
     )
 
     overrides = {
         k: v for k, v in config.model_dump().items()
-        if k not in ("hf_path", "load_hf_weights")
+        if k not in ("hf_path", "load_hf_weights", "assume_llama_layout")
     }
     if config.load_hf_weights:
         overrides.setdefault("pre_trained_weights", config.hf_path)

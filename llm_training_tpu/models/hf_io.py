@@ -17,12 +17,15 @@ expects.
 from __future__ import annotations
 
 import json
+import logging
 from collections.abc import Mapping
 from pathlib import Path
 from typing import Any, Iterator
 
 import jax
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 _SAFE_INDEX = "model.safetensors.index.json"
 _SAFE_SINGLE = "model.safetensors"
@@ -269,14 +272,28 @@ _ARCH_TO_FAMILY = {
 }
 
 
-def model_class_for_hf(hf_config: dict) -> str:
+def model_class_for_hf(hf_config: dict, assume_llama_layout: bool = False) -> str:
     """HF `config.json` -> our model class path (the `HFCausalLM` analogue,
     reference `models/hf_causal_lm/hf_causal_lm.py:22`, for architectures
-    whose computation graph one of our TPU modules reproduces)."""
+    whose computation graph one of our TPU modules reproduces).
+
+    `assume_llama_layout=True` routes UNKNOWN model_types to the Llama
+    family: many fine-tune forks only rename a llama-graph architecture, and
+    the llama conversion fails loudly on any state-dict key or hparam it
+    does not recognize, so a wrong assumption cannot load silently."""
     model_type = hf_config.get("model_type")
     if model_type not in _ARCH_TO_FAMILY:
+        if assume_llama_layout:
+            logger.warning(
+                "unknown HF model_type %r routed to the Llama family "
+                "(assume_llama_layout=True): correctness depends on the "
+                "checkpoint really using the llama graph/key layout",
+                model_type,
+            )
+            return "llm_training_tpu.models.Llama"
         raise ValueError(
             f"unsupported HF model_type {model_type!r}; supported: "
-            f"{sorted(_ARCH_TO_FAMILY)}"
+            f"{sorted(_ARCH_TO_FAMILY)}. If the architecture is a renamed "
+            "llama-layout fork, set assume_llama_layout=true on HFCausalLM"
         )
     return _ARCH_TO_FAMILY[model_type]

@@ -44,6 +44,10 @@ class HunYuanMoeConfig(BaseModelConfig):
     scan_layers: bool = True  # every layer is identical -> loop also fine
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
     moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # per-rank buffer slack for the expert-parallel dispatch: capacity =
+    # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
+    # dropped, so raise this if EP training shows imbalance-driven drops
+    ep_capacity_factor: float = 2.0
 
     @model_validator(mode="after")
     def _validate(self) -> "HunYuanMoeConfig":

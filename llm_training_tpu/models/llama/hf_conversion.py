@@ -200,6 +200,18 @@ _MOE_EXPERT_NAMES = {
 _MOE_SHARED = ("gate_proj", "up_proj", "down_proj")
 
 
+def _moe_key_set(config: LlamaConfig) -> list:
+    """The in-layer paths `_moe_layer_parts` produces, without reading any
+    weights — key enumeration for lazy (thunk-based) conversion callers."""
+    prefix, names = _MOE_EXPERT_NAMES[config.moe_style]
+    keys = [("mlp", "gate", "kernel")]
+    keys += [("mlp", f"experts_{ours}") for ours in names]
+    if config.shared_expert_intermediate_size:
+        keys += [("mlp", f"shared_{ours}") for ours in _MOE_SHARED]
+        keys.append(("mlp", "shared_expert_gate"))
+    return keys
+
+
 def _moe_layer_parts(sd: Mapping, config: LlamaConfig, i: int) -> dict:
     """HF keys for layer i's MoE block -> {our in-layer path: array}."""
     prefix, names = _MOE_EXPERT_NAMES[config.moe_style]

@@ -239,58 +239,24 @@ class LlamaAttention(nn.Module):
         otherwise the single-device flash/XLA path (GSPMD handles any other
         sharding by inserting collectives itself)."""
         cfg = self.config
-        if getattr(cfg, "ring_attention", False):
-            from llm_training_tpu.parallel.mesh import (
-                DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS, active_mesh,
-            )
-
-            mesh = active_mesh()
-            if mesh is not None and mesh.shape.get(SEQUENCE_AXIS, 1) > 1:
-                if getattr(cfg, "sliding_window", None):
-                    raise ValueError(
-                        "ring attention does not support sliding_window"
-                    )
-                from functools import partial
-
-                from jax.sharding import PartitionSpec as P
-
-                from llm_training_tpu.parallel.ring_attention import ring_attention
-
-                if segment_ids is None:
-                    segment_ids = jnp.ones(q.shape[:2], jnp.int32)
-                # degrade to replication on axes the shapes can't fill — the
-                # init trace runs with batch 1, and tiny-head configs may not
-                # divide the tensor axis
-                dp_ways = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
-                batch_axes = (
-                    (DATA_AXIS, FSDP_AXIS) if q.shape[0] % dp_ways == 0 else None
-                )
-                tp = mesh.shape[TENSOR_AXIS]
-                head_axis = (
-                    TENSOR_AXIS
-                    if q.shape[2] % tp == 0 and k.shape[2] % tp == 0
-                    else None
-                )
-                spec_qkv = P(batch_axes, SEQUENCE_AXIS, head_axis, None)
-                spec_seg = P(batch_axes, SEQUENCE_AXIS)
-                return jax.shard_map(
-                    partial(
-                        ring_attention,
-                        axis_name=SEQUENCE_AXIS,
-                        causal=True,
-                        scale=getattr(cfg, "attention_multiplier", None),
-                        impl=cfg.attention_impl,
-                    ),
-                    mesh=mesh,
-                    in_specs=(spec_qkv, spec_qkv, spec_qkv, spec_seg),
-                    out_specs=spec_qkv,
-                    check_vma=False,
-                )(q, k, v, segment_ids)
         window = (
             getattr(cfg, "sliding_window", None)
             if self.sliding_window_override == "unset"
             else self.sliding_window_override
         )
+        if getattr(cfg, "ring_attention", False):
+            from llm_training_tpu.parallel.ring_attention import (
+                dispatch_ring_attention,
+            )
+
+            out = dispatch_ring_attention(
+                q, k, v, segment_ids,
+                sliding_window=window,
+                scale=getattr(cfg, "attention_multiplier", None),
+                impl=cfg.attention_impl,
+            )
+            if out is not None:
+                return out
         return dot_product_attention(
             q, k, v,
             segment_ids=segment_ids,

@@ -60,19 +60,23 @@ class MiniMaxConfig(BaseModelConfig):
     router_aux_loss_coef: float = 0.001
     moe_style: str = "mixtral"
     moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # per-rank buffer slack for the expert-parallel dispatch: capacity =
+    # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
+    # dropped, so raise this if EP training shows imbalance-driven drops
+    ep_capacity_factor: float = 2.0
     mlp_bias: bool = False
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    scan_layers: bool = False  # linear/full mix is non-uniform
+    # a periodic lightning/full pattern scans as one body per period (slope
+    # rates ride the scan as per-cycle inputs); non-periodic layer_types loop
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "MiniMaxConfig":
         if self.attention_dropout != 0.0:
             raise ValueError("attention_dropout is not supported; set it to 0.0")
-        if self.scan_layers:
-            raise ValueError("minimax layers are looped; set scan_layers=False")
         if self.layer_types is None:
             raise ValueError(
                 "layer_types is required (HF MiniMax configs always carry the "
@@ -129,3 +133,12 @@ class MiniMaxConfig(BaseModelConfig):
 
     def layer_is_linear(self, layer_idx: int) -> bool:
         return self.layer_types[layer_idx] == "linear_attention"
+
+    @property
+    def scan_period(self) -> int:
+        """Scan-body depth (0 = loop), from the layer_types repetition."""
+        if not self.scan_layers:
+            return 0
+        from llm_training_tpu.models.moe_scan_io import detect_period
+
+        return detect_period(self.layer_types)

@@ -12,12 +12,19 @@ from typing import Any, Mapping
 
 import numpy as np
 
+import functools
+
 from llm_training_tpu.models.llama.hf_conversion import (
     _get_path,
+    _moe_key_set,
     _moe_layer_out,
     _moe_layer_parts,
     _set_path,
     _to_numpy,
+)
+from llm_training_tpu.models.moe_scan_io import (
+    periodic_layers_from_hf,
+    periodic_layers_to_hf,
 )
 from llm_training_tpu.models.minimax.config import MiniMaxConfig
 from llm_training_tpu.models.minimax.model import _slope_rate
@@ -79,14 +86,22 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            put((f"layers_{i}",) + path, value.T if transpose else value)
+    def extras(sd, i):
         # our module name matches HF's block_sparse_moe, but the shared
         # helper emits the path under 'mlp' — rename on the way in
-        for path, value in _moe_layer_parts(sd, config, i).items():
-            put((f"layers_{i}", "block_sparse_moe") + path[1:], value)
+        memo: dict = {}
+
+        def moe(sub):
+            if not memo:
+                memo.update(_moe_layer_parts(sd, config, i))
+            return memo[sub]
+
+        return {
+            ("block_sparse_moe",) + sub[1:]: functools.partial(moe, sub)
+            for sub in _moe_key_set(config)
+        }
+
+    periodic_layers_from_hf(sd, config, put, _layer_params, extras_fn=extras)
     return {"params": params}
 
 
@@ -101,17 +116,15 @@ def params_to_hf(params: Mapping, config: MiniMaxConfig) -> dict[str, np.ndarray
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    def extras_out(get, i, out):
         if config.layer_is_linear(i):
             for name, value in _decay_buffers(config, i).items():
                 out[f"model.layers.{i}.self_attn.{name}"] = value
-        get = lambda path: np.asarray(
-            _get_path(p, (f"layers_{i}", "block_sparse_moe") + path[1:])
+        _moe_layer_out(
+            lambda path: get(("block_sparse_moe",) + path[1:]), config, i, out
         )
-        _moe_layer_out(get, config, i, out)
+
+    periodic_layers_to_hf(p, config, out, _layer_params, extras_out_fn=extras_out)
     return out
 
 

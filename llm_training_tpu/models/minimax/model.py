@@ -97,11 +97,14 @@ def lightning_attention(
 
 
 class LightningAttention(nn.Module):
+    """`slope` [H] fp32 is passed in (not derived here): it depends on the
+    ABSOLUTE layer index, which a scanned body does not have — the scan
+    feeds each cycle its precomputed slope rows."""
+
     config: MiniMaxConfig
-    layer_idx: int
 
     @nn.compact
-    def __call__(self, hidden, pad_mask):
+    def __call__(self, hidden, pad_mask, slope):
         cfg = self.config
         batch, seq, _ = hidden.shape
         heads, d = cfg.num_attention_heads, cfg.resolved_head_dim
@@ -115,9 +118,6 @@ class LightningAttention(nn.Module):
             # padded positions write nothing into the running state
             v = v * pad_mask[..., None, None].astype(v.dtype)
 
-        slope = jnp.asarray(
-            _slope_rate(heads, self.layer_idx, cfg.num_hidden_layers)
-        )
         out = lightning_attention(q, k, v, slope, cfg.block_size)
         out = out.reshape(batch, seq, heads * d)
         # HF hardcodes this norm's eps at the MiniMaxRMSNorm default (1e-6),
@@ -159,21 +159,21 @@ class MiniMaxAttention(nn.Module):
 
 class MiniMaxDecoderLayer(nn.Module):
     config: MiniMaxConfig
-    layer_idx: int
+    is_linear: bool
 
     @nn.compact
-    def __call__(self, hidden, segment_ids, cos, sin):
+    def __call__(self, hidden, segment_ids, cos, sin, slope):
         cfg = self.config
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
         norm = lambda name: RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
         pad_mask = None if segment_ids is None else segment_ids > 0
-        linear = cfg.layer_is_linear(self.layer_idx)
+        linear = self.is_linear
 
         # MiniMax residual scheme: the NORMED input is also the residual
         hidden = norm("input_layernorm")(hidden)
         if linear:
-            attn = LightningAttention(cfg, self.layer_idx, name="self_attn")(
-                hidden, pad_mask
+            attn = LightningAttention(cfg, name="self_attn")(
+                hidden, pad_mask, slope
             )
             alpha, beta = cfg.linear_attn_alpha_factor, cfg.linear_attn_beta_factor
         else:
@@ -185,6 +185,25 @@ class MiniMaxDecoderLayer(nn.Module):
         mlp_out, stats = MoEMLP(cfg, name="block_sparse_moe")(hidden, pad_mask)
         hidden = hidden * cfg.mlp_alpha_factor + mlp_out * cfg.mlp_beta_factor
         return hidden, stats
+
+
+class _PeriodicBody(nn.Module):
+    """Scan body: one period of the lightning/full pattern. `slopes`
+    [period, H] is the scanned-per-cycle input carrying each layer's
+    absolute-index-dependent decay rate."""
+
+    config: MiniMaxConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin, slopes):
+        cfg = self.config
+        stats = []
+        for j in range(cfg.scan_period):
+            hidden, layer_stats = MiniMaxDecoderLayer(
+                cfg, cfg.layer_is_linear(j), name=f"slot{j}"
+            )(hidden, segment_ids, cos, sin, slopes[j])
+            stats.append(layer_stats)
+        return hidden, jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
 
 
 class MiniMax(nn.Module):
@@ -228,20 +247,46 @@ class MiniMax(nn.Module):
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
         policy = _remat_policy(cfg)
-        stats = []
-        for i in range(cfg.num_hidden_layers):
-            layer_cls = MiniMaxDecoderLayer
+        heads = cfg.num_attention_heads
+        all_slopes = jnp.asarray(np.stack([
+            _slope_rate(heads, i, cfg.num_hidden_layers)
+            for i in range(cfg.num_hidden_layers)
+        ]))  # [L, H]
+        period = cfg.scan_period
+        if period:
+            body = _PeriodicBody
             if policy is not None:
-                layer_cls = nn.remat(MiniMaxDecoderLayer, policy=policy)
-            hidden, layer_stats = layer_cls(cfg, i, name=f"layers_{i}")(
-                hidden, segment_ids, cos, sin
+                body = nn.remat(_PeriodicBody, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, 0),
+                length=cfg.num_hidden_layers // period,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            hidden, (sel_frac, mean_prob) = scanned(
+                hidden, segment_ids, cos, sin,
+                all_slopes.reshape(-1, period, heads),
             )
-            stats.append(layer_stats)
+            # [cycles, period, E] -> [L, E]; depth order is irrelevant to the
+            # mean-pooled aux loss below
+            sel_frac = sel_frac.reshape(-1, sel_frac.shape[-1])
+            mean_prob = mean_prob.reshape(-1, mean_prob.shape[-1])
+        else:
+            stats = []
+            for i in range(cfg.num_hidden_layers):
+                layer_cls = MiniMaxDecoderLayer
+                if policy is not None:
+                    layer_cls = nn.remat(MiniMaxDecoderLayer, policy=policy)
+                hidden, layer_stats = layer_cls(
+                    cfg, cfg.layer_is_linear(i), name=f"layers_{i}"
+                )(hidden, segment_ids, cos, sin, all_slopes[i])
+                stats.append(layer_stats)
+            sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
-
-        sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
         aux_loss = cfg.num_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )

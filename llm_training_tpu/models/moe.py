@@ -27,9 +27,117 @@ Qwen2/3-MoE — only through `HFCausalLM`'s torch wrapping,
 
 from __future__ import annotations
 
+import math
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from llm_training_tpu.parallel.mesh import EXPERT_AXIS, active_mesh
+
+
+def _ep_group_size() -> int:
+    """Size of the expert-parallel axis on the active mesh (1 = no EP)."""
+    mesh = active_mesh()
+    if mesh is None or EXPERT_AXIS not in mesh.shape:
+        return 1
+    return mesh.shape[EXPERT_AXIS]
+
+
+def _ep_ragged_apply(
+    x, topk_idx, topk_weights, num_experts, ragged_fn, weights,
+    ep: int, capacity_factor: float,
+):
+    """Expert-parallel dropless-ish dispatch under `shard_map` (manual over
+    the expert axis only; data/fsdp/tensor/sequence stay GSPMD-auto).
+
+    Each EP rank owns E/ep experts (stacks sharded on their leading dim by
+    the `expert` rule). Tokens are batch-sharded across EP ranks, so the
+    dispatch is: all-gather the EP group's tokens + routing, pick the rows
+    routed to local experts into a STATIC per-rank capacity buffer
+    (ceil(T_group·K/ep · capacity_factor) rows — overflow beyond the buffer
+    is dropped, which the factor makes vanishingly rare for balanced
+    routing), run the grouped matmuls on the local stacks, scatter-add the
+    weighted outputs into the group buffer, and reduce-scatter every rank's
+    combined tokens back home. Per-rank compute is capacity rows — true
+    EP scaling — at 2 collectives (gather fwd, scatter fwd ⇒ mirrored in
+    the backward) per MoE layer, riding ICI on the `expert` axis.
+    """
+    mesh = active_mesh()
+    e_local = num_experts // ep
+    hidden = x.shape[-1]
+    top_k = topk_idx.shape[-1]
+    t_all = x.shape[0]
+    # a factor > ep would exceed the total row count; sel below slices
+    # exactly `capacity` rows, so clamp to keep shapes consistent
+    capacity = min(
+        math.ceil(t_all * top_k / ep * capacity_factor), t_all * top_k
+    )
+
+    w_leaves, w_def = jax.tree.flatten(weights)
+    # XLA:CPU cannot compile bf16 crossing this partial-auto shard_map
+    # boundary ("invalid binary instruction opcode copy" compiler CHECK, jax
+    # 0.9.0) — tests and the multichip dryrun run the EP math in f32 there;
+    # the TPU backend keeps the compute dtype.
+    out_dtype = x.dtype
+    if jax.default_backend() == "cpu":
+        as_f32 = lambda a: (
+            a.astype(jnp.float32) if jnp.issubdtype(a.dtype, jnp.floating) else a
+        )
+        x, topk_weights = as_f32(x), as_f32(topk_weights)
+        w_leaves = [as_f32(leaf) for leaf in w_leaves]
+
+    def body(x_all, idx_all, wts_all, *w_leaves):
+        # token/routing arrays arrive replicated over the expert axis — the
+        # in_spec makes GSPMD insert the all-gather as an auto collective.
+        # (A manual lax.all_gather of bf16 inside partial-auto shard_map
+        # crashes the XLA CPU backend — "invalid binary instruction opcode
+        # copy" — while the auto gather and the manual psum_scatter below
+        # compile everywhere.)
+        w_local = jax.tree.unflatten(w_def, w_leaves)
+        lo = lax.axis_index(EXPERT_AXIS) * e_local
+
+        flat_e = idx_all.reshape(-1)
+        flat_w = wts_all.reshape(-1)
+        flat_tok = jnp.arange(t_all * top_k) // top_k
+        rel = flat_e - lo
+        local = (rel >= 0) & (rel < e_local)
+        # local rows first (sorted by expert), non-local rows pushed last
+        order = jnp.argsort(jnp.where(local, rel, e_local))
+        sel = order[:capacity]
+        sel_tok = flat_tok[sel]
+
+        counts = jnp.bincount(
+            jnp.where(local, rel, e_local), length=e_local + 1
+        )[:e_local]
+        start = jnp.cumsum(counts) - counts
+        # rows are expert-sorted, so clipping to the buffer drops exactly
+        # the rows that did not fit
+        gs = jnp.clip(jnp.minimum(counts, capacity - start), 0)
+        total = gs.sum()
+
+        ys = ragged_fn(
+            x_all[sel_tok],
+            gs.astype(jnp.int32),
+            jnp.clip(rel[sel], 0, e_local - 1),
+            w_local,
+        )
+        valid = jnp.arange(capacity) < total  # local rows sort first
+        ys = ys * (flat_w[sel] * valid).astype(ys.dtype)[:, None]
+        out_all = jnp.zeros((t_all, hidden), ys.dtype).at[sel_tok].add(ys)
+        return lax.psum_scatter(out_all, EXPERT_AXIS, scatter_dimension=0, tiled=True)
+
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(), P()) + tuple(P(EXPERT_AXIS) for _ in w_leaves),
+        out_specs=P(EXPERT_AXIS),
+        axis_names={EXPERT_AXIS},
+        check_vma=False,
+    )(x, topk_idx, topk_weights, *w_leaves)
+    return out.astype(out_dtype)
 
 
 def dropless_moe_apply(
@@ -40,14 +148,19 @@ def dropless_moe_apply(
     impl: str,
     dense_fn,
     ragged_fn,
+    weights=None,
+    ep_capacity_factor: float = 2.0,
 ) -> jnp.ndarray:
     """Shared dropless dispatch/combine for every MoE family.
 
     x: [T, H] compute-dtype tokens; topk_idx/topk_weights: [T, K].
     dense_fn(x) -> [T, E, H] (every expert on every token — exact path);
-    ragged_fn(xs, group_sizes, expert_order) -> [T*K, H] where xs are the
-    (token, slot) rows sorted by expert and expert_order the matching
-    expert id per row (for per-expert bias lookups).
+    ragged_fn(xs, group_sizes, expert_order, weights) -> [rows, H] where xs
+    are the (token, slot) rows sorted by expert and expert_order the
+    matching (stack-relative) expert id per row (for per-expert bias
+    lookups). `weights` is the pytree of stacked expert parameters (leading
+    dim E) that ragged_fn consumes — passed explicitly so the
+    expert-parallel path can hand each rank its local slice.
     """
     n_tokens, top_k = topk_idx.shape
     if impl == "auto":
@@ -59,13 +172,24 @@ def dropless_moe_apply(
             jnp.arange(n_tokens)[:, None], topk_idx
         ].set(topk_weights)
         return jnp.einsum("teh,te->th", y, combine)
+    ep = _ep_group_size()
+    if ep > 1:
+        if num_experts % ep:
+            raise ValueError(
+                f"num_experts ({num_experts}) must divide by the expert mesh "
+                f"axis ({ep})"
+            )
+        return _ep_ragged_apply(
+            x, topk_idx, topk_weights, num_experts, ragged_fn, weights,
+            ep, ep_capacity_factor,
+        )
     flat_expert = topk_idx.reshape(-1)
     flat_weight = topk_weights.reshape(-1)
     flat_token = jnp.arange(n_tokens * top_k) // top_k
     order = jnp.argsort(flat_expert)  # stable
     token_order = flat_token[order]
     group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
-    ys = ragged_fn(x[token_order], group_sizes, flat_expert[order])
+    ys = ragged_fn(x[token_order], group_sizes, flat_expert[order], weights)
     ys = ys * flat_weight[order][:, None]
     return jnp.zeros((n_tokens, x.shape[-1]), x.dtype).at[token_order].add(ys)
 
@@ -145,14 +269,17 @@ class MoEMLP(nn.Module):
             up = jnp.einsum("th,ehi->tei", xc, w_up)
             return jnp.einsum("tei,eih->teh", nn.silu(gate) * up, w_down)
 
-        def ragged_fn(xs, group_sizes, expert_order):
-            gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-            up = jax.lax.ragged_dot(xs, w_up, group_sizes)
-            return jax.lax.ragged_dot(nn.silu(gate) * up, w_down, group_sizes)
+        def ragged_fn(xs, group_sizes, expert_order, w):
+            wg, wu, wd = w
+            gate = jax.lax.ragged_dot(xs, wg, group_sizes)
+            up = jax.lax.ragged_dot(xs, wu, group_sizes)
+            return jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
 
         out = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_probs, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
+            weights=(w_gate, w_up, w_down),
+            ep_capacity_factor=getattr(cfg, "ep_capacity_factor", 2.0),
         )
 
         # ---- shared expert (Qwen2-MoE): dense SwiGLU + per-token sigmoid gate

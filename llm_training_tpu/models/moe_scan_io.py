@@ -1,12 +1,19 @@
-"""Shared state-dict plumbing for dense-prefix + scanned-MoE-suffix stacks.
+"""Shared state-dict plumbing for scanned non-uniform layer stacks.
 
-The DeepSeek-layout MoE families (deepseek, glm4_moe, ernie45_moe) loop
-their dense prefix (`layers_{i}` flax keys) and scan the uniform MoE suffix
-(`moe_layers/layer` keys with a leading depth axis) — see
-`DeepseekConfig.num_scanned_layers`. This module holds the two traversal
-halves of the HF <-> flax conversion so each family only declares its key
-tables and per-value quirks. (hunyuan_moe is uniform end-to-end and scans
-ALL layers under `layers/layer` with its own conversion.)
+Two layouts (VERDICT r3 #3 — compile time ~flat in depth):
+
+- dense prefix + scanned MoE suffix (deepseek, glm4_moe, ernie45_moe): the
+  prefix loops (`layers_{i}` flax keys), the uniform suffix scans
+  (`moe_layers/layer` keys with a leading depth axis) — see
+  `DeepseekConfig.num_scanned_layers`. `layers_from_hf` / `layers_to_hf`.
+- periodic hybrid pattern (gpt-oss sliding/full pairs, qwen3-next
+  3×linear+full, minimax, bamba): a p-layer body (`layers/slot{j}` keys)
+  scans over depth/p cycles — see `detect_period`.
+  `periodic_layers_from_hf` / `periodic_layers_to_hf`.
+
+Each family only declares its key tables and per-value quirks.
+(hunyuan_moe is uniform end-to-end and scans ALL layers under
+`layers/layer` with its own conversion.)
 
 Capability parity: reference `hf_compat_model.py:96-119` (bidirectional
 state-dict conversion), extended to the stacked-suffix layout the reference
@@ -34,6 +41,113 @@ ExpertOutFn = Callable[[Callable, int, dict], None]
 def _default_value(sd: Mapping, i: int, hf_name: str, transpose: bool, path) -> np.ndarray:
     value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
     return value.T if transpose else value
+
+
+def detect_period(kinds) -> int:
+    """Smallest proper period p < len(kinds) such that kind(i) == kind(i % p)
+    and p divides the depth, or 0 when the sequence does not repeat. The
+    periodic hybrid families (gpt-oss sliding/full, qwen3-next 3×linear+full,
+    minimax lightning/full, bamba mamba/attention) scan a p-layer body over
+    depth/p cycles when this returns nonzero."""
+    n = len(kinds)
+    for p in range(1, n // 2 + 1):
+        if n % p == 0 and all(kinds[i] == kinds[i % p] for i in range(n)):
+            return p
+    return 0
+
+
+def periodic_layers_from_hf(
+    sd: Mapping,
+    config: Any,
+    put: Callable,
+    layer_params_fn: LayerParamsFn,
+    layer_value_fn: Callable = _default_value,
+    extras_fn: ExpertPartsFn | None = None,
+) -> None:
+    """Populate layer params for a periodic scanned stack: HF layer i maps to
+    flax `("layers", f"slot{i % p}") + path` at stack index i // p. Falls
+    back to the looped `layers_{i}` layout when `config.scan_period` is 0.
+    `extras_fn(sd, i) -> {path_suffix: thunk}` covers pieces outside the
+    table (expert stacks, reshaped conv kernels); its key set must depend
+    only on i % p."""
+    period = config.scan_period
+    n = config.num_hidden_layers
+    if not period:
+        for i in range(n):
+            for path, hf_name, transpose in layer_params_fn(config, i):
+                put(
+                    (f"layers_{i}",) + path,
+                    layer_value_fn(sd, i, hf_name, transpose, path),
+                )
+            if extras_fn is not None:
+                for sub, thunk in extras_fn(sd, i).items():
+                    put((f"layers_{i}",) + sub, thunk())
+        return
+    for j in range(period):
+        for path, hf_name, transpose in layer_params_fn(config, j):
+            put(
+                ("layers", f"slot{j}") + path,
+                np.stack([
+                    layer_value_fn(sd, i, hf_name, transpose, path)
+                    for i in range(j, n, period)
+                ]),
+            )
+        if extras_fn is not None:
+            # one thunk-dict per layer; thunks stay lazy so each stacked
+            # tensor is the only materialized extra at a time
+            layer_extras = [extras_fn(sd, i) for i in range(j, n, period)]
+            for sub in layer_extras[0]:
+                put(
+                    ("layers", f"slot{j}") + sub,
+                    np.stack([extras[sub]() for extras in layer_extras]),
+                )
+
+
+def periodic_layers_to_hf(
+    p: Mapping,
+    config: Any,
+    out: dict,
+    layer_params_fn: LayerParamsFn,
+    value_out_fn: Callable | None = None,
+    extras_out_fn: ExpertOutFn | None = None,
+) -> None:
+    """Emit HF `model.layers.{i}.*` keys from a periodic scanned flax tree
+    (or the looped layout when `config.scan_period` is 0). `extras_out_fn`
+    mirrors `layers_to_hf`'s expert_out_fn, reading through `get(suffix)`."""
+    if value_out_fn is None:
+        value_out_fn = lambda value, transpose, path: value.T if transpose else value
+    period = config.scan_period
+    n = config.num_hidden_layers
+    if not period:
+        for i in range(n):
+            for path, hf_name, transpose in layer_params_fn(config, i):
+                value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
+                out[f"model.layers.{i}.{hf_name}"] = value_out_fn(value, transpose, path)
+            if extras_out_fn is not None:
+                get = lambda sub, i=i: np.asarray(_get_path(p, (f"layers_{i}",) + sub))
+                extras_out_fn(get, i, out)
+        return
+    cache: dict = {}
+
+    def fetch(j, sub):
+        if sub not in cache:
+            cache[sub] = np.asarray(_get_path(p, ("layers", f"slot{j}") + sub))
+        return cache[sub]
+
+    for j in range(period):
+        for path, hf_name, transpose in layer_params_fn(config, j):
+            stacked = fetch(j, path)
+            for s, i in enumerate(range(j, n, period)):
+                out[f"model.layers.{i}.{hf_name}"] = value_out_fn(
+                    stacked[s], transpose, path
+                )
+        if extras_out_fn is not None:
+            for s, i in enumerate(range(j, n, period)):
+                get = lambda sub, j=j, s=s: fetch(j, sub)[s]
+                extras_out_fn(get, i, out)
+        # each slot's stacks are only read within its own iteration; evict
+        # so peak host memory stays one slot's tensors, not all of them
+        cache.clear()
 
 
 def layers_from_hf(

@@ -49,6 +49,9 @@ class Qwen3NextConfig(BaseModelConfig):
     linear_value_head_dim: int = 128
     linear_conv_kernel_dim: int = 4
     delta_chunk_size: int = 64  # chunked delta-rule block length
+    # opt-in: reset the DeltaNet fast-weight state at packed-document
+    # boundaries (HF leaks state across documents; see model docstring)
+    segment_state_reset: bool = False
 
     # --- MoE (qwen-style: softmax top-k + shared expert with sigmoid gate);
     # field names match what models.moe.MoEMLP reads from its config
@@ -59,19 +62,22 @@ class Qwen3NextConfig(BaseModelConfig):
     shared_expert_intermediate_size: int | None = None
     router_aux_loss_coef: float = 0.001
     moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # per-rank buffer slack for the expert-parallel dispatch: capacity =
+    # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
+    # dropped, so raise this if EP training shows imbalance-driven drops
+    ep_capacity_factor: float = 2.0
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"
-    # linear/full alternation makes the layer body non-uniform; looped
-    scan_layers: bool = False
+    # the 3×linear+full period scans as a 4-layer body — `scan_period`
+    # detects the repetition; non-periodic layer_types loop
+    scan_layers: bool = True
     attention_impl: Literal["auto", "xla", "pallas"] = "auto"
 
     @model_validator(mode="after")
     def _validate(self) -> "Qwen3NextConfig":
         if self.attention_dropout != 0.0:
             raise ValueError("attention_dropout is not supported; set it to 0.0")
-        if self.scan_layers:
-            raise ValueError("qwen3-next layers are looped; set scan_layers=False")
         if self.layer_types is not None and len(self.layer_types) != self.num_hidden_layers:
             raise ValueError(
                 f"layer_types has {len(self.layer_types)} entries for "
@@ -107,3 +113,15 @@ class Qwen3NextConfig(BaseModelConfig):
             else ("full_attention" if layer_idx % 4 == 3 else "linear_attention")
         )
         return kind == "linear_attention"
+
+    @property
+    def scan_period(self) -> int:
+        """Scan-body depth (0 = loop): 4 for the stock 3×linear+full
+        pattern."""
+        if not self.scan_layers:
+            return 0
+        from llm_training_tpu.models.moe_scan_io import detect_period
+
+        return detect_period(
+            [self.layer_is_linear(i) for i in range(self.num_hidden_layers)]
+        )

@@ -13,12 +13,19 @@ from typing import Any, Mapping
 
 import numpy as np
 
+import functools
+
 from llm_training_tpu.models.llama.hf_conversion import (
     _get_path,
+    _moe_key_set,
     _moe_layer_out,
     _moe_layer_parts,
     _set_path,
     _to_numpy,
+)
+from llm_training_tpu.models.moe_scan_io import (
+    periodic_layers_from_hf,
+    periodic_layers_to_hf,
 )
 from llm_training_tpu.models.qwen3_next.config import Qwen3NextConfig
 
@@ -64,17 +71,26 @@ def params_from_hf(
     if not config.tie_word_embeddings:
         put(("lm_head", "kernel"), _to_numpy(sd["lm_head.weight"]).T)
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = _to_numpy(sd[f"layers.{i}.{hf_name}"])
-            put((f"layers_{i}",) + path, value.T if transpose else value)
+    def extras(sd, i):
+        parts = {}
         if config.layer_is_linear(i):
             # HF depthwise conv [C, 1, K] -> our [K, C]
-            conv = _to_numpy(sd[f"layers.{i}.linear_attn.conv1d.weight"])
-            put((f"layers_{i}", "linear_attn", "conv_kernel"), conv[:, 0, :].T)
+            parts[("linear_attn", "conv_kernel")] = lambda: _to_numpy(
+                sd[f"layers.{i}.linear_attn.conv1d.weight"]
+            )[:, 0, :].T
         if config.num_experts:
-            for path, value in _moe_layer_parts(sd, config, i).items():
-                put((f"layers_{i}",) + path, value)
+            memo: dict = {}
+
+            def moe(sub):
+                if not memo:
+                    memo.update(_moe_layer_parts(sd, config, i))
+                return memo[sub]
+
+            for sub in _moe_key_set(config):
+                parts[sub] = functools.partial(moe, sub)
+        return parts
+
+    periodic_layers_from_hf(sd, config, put, _layer_params, extras_fn=extras)
     return {"params": params}
 
 
@@ -89,16 +105,14 @@ def params_to_hf(params: Mapping, config: Qwen3NextConfig) -> dict[str, np.ndarr
     if not config.tie_word_embeddings:
         out["lm_head.weight"] = np.asarray(_get_path(p, ("lm_head", "kernel"))).T
 
-    for i in range(config.num_hidden_layers):
-        for path, hf_name, transpose in _layer_params(config, i):
-            value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
-            out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    def extras_out(get, i, out):
         if config.layer_is_linear(i):
-            conv = np.asarray(_get_path(p, (f"layers_{i}", "linear_attn", "conv_kernel")))
+            conv = get(("linear_attn", "conv_kernel"))
             out[f"model.layers.{i}.linear_attn.conv1d.weight"] = conv.T[:, None, :]
         if config.num_experts:
-            get = lambda path: np.asarray(_get_path(p, (f"layers_{i}",) + path))
             _moe_layer_out(get, config, i, out)
+
+    periodic_layers_to_hf(p, config, out, _layer_params, extras_out_fn=extras_out)
     return out
 
 

@@ -21,8 +21,12 @@ Graph verified against HF `modeling_qwen3_next.py`:
   gated variant (norm(x) * w * silu(z)).
 
 Padding semantics mirror HF: padded tokens are zeroed at the layer input,
-but the recurrent state still decays THROUGH padding (and across packed
-documents — the delta rule has no boundary reset; same limitation as HF).
+but the recurrent state still decays THROUGH padding and across packed
+documents by default (HF parity). `segment_state_reset=True` (opt-in)
+resets the fast-weight state at document boundaries via the log-decay
+trick (`segment_reset_decay`) — packing is this framework's default
+pre-training mode, so the no-cross-contamination guarantee can extend to
+the recurrence where HF cannot offer it.
 """
 
 from __future__ import annotations
@@ -91,6 +95,25 @@ def _l2norm(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
     return x * jax.lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + eps)
 
 
+_RESET_LOG_DECAY = -1e4  # exp() underflows to exactly 0.0 in fp32
+
+
+def segment_reset_decay(segment_ids: jnp.ndarray) -> jnp.ndarray:
+    """[B, S] extra log-decay: `_RESET_LOG_DECAY` at each document START.
+
+    Adding this to a recurrence's log-decay sequence makes every cross-
+    boundary decay product underflow to zero (a k-boundary gap accumulates
+    k·(-1e4)) while within-document terms are untouched — an EXACT state
+    reset that needs no change to the chunked scan structure. Packing is
+    this framework's default pre-training mode, so opt-in resets extend the
+    no-cross-contamination guarantee (ops/attention.py) to the recurrent
+    families, which HF faithfully leaks across documents."""
+    prev = jnp.concatenate(
+        [segment_ids[:, :1], segment_ids[:, :-1]], axis=1
+    )
+    return jnp.where(segment_ids != prev, _RESET_LOG_DECAY, 0.0)
+
+
 def chunk_gated_delta_rule(
     q: jnp.ndarray,  # [B, S, H, dk]
     k: jnp.ndarray,  # [B, S, H, dk]
@@ -98,6 +121,7 @@ def chunk_gated_delta_rule(
     g: jnp.ndarray,  # [B, S, H] log-decay (negative)
     beta: jnp.ndarray,  # [B, S, H] write strength in (0, 1)
     chunk_size: int = 64,
+    reset_decay: jnp.ndarray | None = None,  # [B, S] from segment_reset_decay
 ) -> jnp.ndarray:
     """Chunked gated delta rule (HF `torch_chunk_gated_delta_rule`), fp32.
 
@@ -111,6 +135,8 @@ def chunk_gated_delta_rule(
     v = v.astype(jnp.float32)
     g = g.astype(jnp.float32)
     beta = beta.astype(jnp.float32)
+    if reset_decay is not None:
+        g = g + reset_decay.astype(jnp.float32)[..., None]
 
     batch, seq, heads, dk = q.shape
     dv = v.shape[-1]
@@ -194,7 +220,7 @@ class GatedDeltaNet(nn.Module):
     config: Qwen3NextConfig
 
     @nn.compact
-    def __call__(self, hidden, pad_mask):
+    def __call__(self, hidden, pad_mask, segment_ids=None):
         cfg = self.config
         batch, seq, _ = hidden.shape
         kh, vh = cfg.linear_num_key_heads, cfg.linear_num_value_heads
@@ -235,11 +261,24 @@ class GatedDeltaNet(nn.Module):
             (cfg.linear_conv_kernel_dim, mixed.shape[-1]),
             cfg.param_jnp_dtype,
         ).astype(mixed.dtype)
-        padded = jnp.pad(mixed, ((0, 0), (cfg.linear_conv_kernel_dim - 1, 0), (0, 0)))
-        conv = sum(
-            padded[:, i:i + seq] * conv_w[i]
-            for i in range(cfg.linear_conv_kernel_dim)
+        k_conv = cfg.linear_conv_kernel_dim
+        padded = jnp.pad(mixed, ((0, 0), (k_conv - 1, 0), (0, 0)))
+        reset_on = (
+            getattr(cfg, "segment_state_reset", False) and segment_ids is not None
         )
+        if reset_on:
+            # the causal conv window must not cross document boundaries: a
+            # cross-segment tap is replaced by the zero a standalone run's
+            # left-padding would supply
+            seg_p = jnp.pad(segment_ids, ((0, 0), (k_conv - 1, 0)))
+            conv = sum(
+                padded[:, i:i + seq]
+                * conv_w[i]
+                * (seg_p[:, i:i + seq] == segment_ids)[..., None]
+                for i in range(k_conv)
+            )
+        else:
+            conv = sum(padded[:, i:i + seq] * conv_w[i] for i in range(k_conv))
         mixed = jax.nn.silu(conv)
 
         qh = mixed[..., :key_dim].reshape(batch, seq, kh, dk)
@@ -265,8 +304,12 @@ class GatedDeltaNet(nn.Module):
         qh = jnp.repeat(qh, group, axis=2)
         khd = jnp.repeat(khd, group, axis=2)
 
+        reset = None
+        if getattr(cfg, "segment_state_reset", False) and segment_ids is not None:
+            reset = segment_reset_decay(segment_ids)
         out = chunk_gated_delta_rule(
-            qh, khd, vhd, g, beta, chunk_size=cfg.delta_chunk_size
+            qh, khd, vhd, g, beta, chunk_size=cfg.delta_chunk_size,
+            reset_decay=reset,
         )
         out = GatedRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(out, z)
         out = out.reshape(batch, seq, value_dim)
@@ -330,7 +373,9 @@ class Qwen3NextDecoderLayer(nn.Module):
 
         normed = norm("input_layernorm")(hidden)
         if self.is_linear:
-            attn = GatedDeltaNet(cfg, name="linear_attn")(normed, pad_mask)
+            attn = GatedDeltaNet(cfg, name="linear_attn")(
+                normed, pad_mask, segment_ids
+            )
         else:
             attn = GatedAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
         hidden = hidden + attn
@@ -343,6 +388,24 @@ class Qwen3NextDecoderLayer(nn.Module):
 
             mlp_out, stats = LlamaMLP(cfg, name="mlp")(normed), jnp.float32(0.0)
         return hidden + mlp_out, stats
+
+
+class _PeriodicBody(nn.Module):
+    """Scan body: one period of the linear/full pattern (`scan_period`
+    layers, stock Qwen3-Next: linear, linear, linear, full)."""
+
+    config: Qwen3NextConfig
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        stats = []
+        for j in range(cfg.scan_period):
+            hidden, layer_stats = Qwen3NextDecoderLayer(
+                cfg, cfg.layer_is_linear(j), name=f"slot{j}"
+            )(hidden, segment_ids, cos, sin)
+            stats.append(layer_stats)
+        return hidden, jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
 
 
 class Qwen3Next(nn.Module):
@@ -386,15 +449,36 @@ class Qwen3Next(nn.Module):
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
         policy = _remat_policy(cfg)
-        stats = []
-        for i in range(cfg.num_hidden_layers):
-            layer_cls = Qwen3NextDecoderLayer
+        period = cfg.scan_period
+        if period:
+            body = _PeriodicBody
             if policy is not None:
-                layer_cls = nn.remat(Qwen3NextDecoderLayer, policy=policy)
-            hidden, layer_stats = layer_cls(
-                cfg, cfg.layer_is_linear(i), name=f"layers_{i}"
-            )(hidden, segment_ids, cos, sin)
-            stats.append(layer_stats)
+                body = nn.remat(_PeriodicBody, policy=policy, prevent_cse=False)
+            scanned = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.num_hidden_layers // period,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layers")
+            hidden, stacked_stats = scanned(hidden, segment_ids, cos, sin)
+            # [cycles, period, ...] -> [L, ...]; depth order is irrelevant to
+            # the mean-pooled aux loss below
+            pooled = jax.tree.map(
+                lambda x: x.reshape(-1, *x.shape[2:]), stacked_stats
+            )
+        else:
+            stats = []
+            for i in range(cfg.num_hidden_layers):
+                layer_cls = Qwen3NextDecoderLayer
+                if policy is not None:
+                    layer_cls = nn.remat(Qwen3NextDecoderLayer, policy=policy)
+                hidden, layer_stats = layer_cls(
+                    cfg, cfg.layer_is_linear(i), name=f"layers_{i}"
+                )(hidden, segment_ids, cos, sin)
+                stats.append(layer_stats)
+            pooled = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
 
         hidden = ZeroCenteredRMSNorm(
             cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm"
@@ -403,7 +487,7 @@ class Qwen3Next(nn.Module):
 
         aux_loss = None
         if cfg.num_experts:
-            sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+            sel_frac, mean_prob = pooled
             aux_loss = cfg.num_experts * jnp.sum(
                 sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
             )

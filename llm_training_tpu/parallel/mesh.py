@@ -21,10 +21,11 @@ logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+EXPERT_AXIS = "expert"
 TENSOR_AXIS = "tensor"
 SEQUENCE_AXIS = "sequence"
 
-MESH_AXIS_NAMES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
+MESH_AXIS_NAMES = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
 
 
 class MeshConfig(BaseModel):
@@ -32,13 +33,18 @@ class MeshConfig(BaseModel):
     remaining devices' (the reference's `'auto'`, `fsdp2_strategy.py:181-189`).
 
     Defaults give pure ZeRO-3-style FSDP over all devices, the reference's
-    default strategy posture.
+    default strategy posture. `expert_parallel_size` carves EP groups out of
+    the batch dimension: activations treat the expert axis as extra data
+    parallelism, expert stacks shard their leading E dim over it, and the
+    MoE dispatch switches to the shard_map all-gather/reduce-scatter EP path
+    (`models/moe.py`).
     """
 
     model_config = ConfigDict(extra="forbid")
 
     data_parallel_size: int = 1
     fsdp_size: int = -1
+    expert_parallel_size: int = 1
     tensor_parallel_size: int = 1
     sequence_parallel_size: int = 1
 
@@ -46,6 +52,7 @@ class MeshConfig(BaseModel):
         return {
             DATA_AXIS: self.data_parallel_size,
             FSDP_AXIS: self.fsdp_size,
+            EXPERT_AXIS: self.expert_parallel_size,
             TENSOR_AXIS: self.tensor_parallel_size,
             SEQUENCE_AXIS: self.sequence_parallel_size,
         }
@@ -78,11 +85,12 @@ def build_mesh(
     config: MeshConfig | None = None,
     devices: list | None = None,
 ) -> Mesh:
-    """Build the 4-axis mesh.
+    """Build the 5-axis mesh.
 
-    Axis order is (data, fsdp, tensor, sequence) — innermost axes get
-    physically-adjacent devices, so tensor/sequence collectives (the
-    latency-sensitive ones) ride the fastest ICI links.
+    Axis order is (data, fsdp, expert, tensor, sequence) — innermost axes
+    get physically-adjacent devices, so tensor/sequence collectives (the
+    latency-sensitive ones) ride the fastest ICI links; EP's per-MoE-layer
+    gather/scatter sits just outside them.
     """
     config = config or MeshConfig()
     devices = devices if devices is not None else jax.devices()

@@ -24,6 +24,12 @@ with their chunk and arrive home after a full rotation.
 
 Packing composes for free: segment ids are global document ids, so the
 chunk-pair mask `seg_q == seg_kv` is correct across chunk boundaries.
+
+Sliding windows compose (r4): the window mask applies inside partially-
+covered chunk pairs (static q_offset = step·chunk), and the ring stops
+rotating once every remaining pair is outside the window — compute AND
+communication are O(window). Attention sinks (gpt-oss) compose by seeding
+each owner chunk's running logsumexp with the sink logit.
 """
 
 from __future__ import annotations
@@ -37,6 +43,74 @@ from jax import lax
 from llm_training_tpu.parallel.mesh import SEQUENCE_AXIS
 
 
+def dispatch_ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    segment_ids: jnp.ndarray | None,
+    *,
+    sliding_window: int | None = None,
+    sinks: jnp.ndarray | None = None,
+    logits_soft_cap: float | None = None,
+    scale: float | None = None,
+    impl: str = "auto",
+):
+    """shard_map `ring_attention` over the active mesh's sequence axis, or
+    return None when no sequence-sharded mesh is active (callers fall back
+    to the single-device flash/XLA path; GSPMD handles any other sharding by
+    inserting collectives itself).
+
+    Shared dispatch for every family with a `ring_attention` config flag
+    (llama/OLMo sliding windows, gemma-2/3 windows + softcap, gpt-oss
+    windows + sinks)."""
+    from jax.sharding import PartitionSpec as P
+
+    from llm_training_tpu.parallel.mesh import (
+        DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, active_mesh,
+    )
+
+    mesh = active_mesh()
+    if mesh is None or mesh.shape.get(SEQUENCE_AXIS, 1) <= 1:
+        return None
+    if segment_ids is None:
+        segment_ids = jnp.ones(q.shape[:2], jnp.int32)
+    # degrade to replication on axes the shapes can't fill — the init trace
+    # runs with batch 1, and tiny-head configs may not divide the tensor axis
+    dp_ways = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    batch_axes = (DATA_AXIS, FSDP_AXIS) if q.shape[0] % dp_ways == 0 else None
+    tp = mesh.shape[TENSOR_AXIS]
+    head_axis = (
+        TENSOR_AXIS if q.shape[2] % tp == 0 and k.shape[2] % tp == 0 else None
+    )
+    spec_qkv = P(batch_axes, SEQUENCE_AXIS, head_axis, None)
+    spec_seg = P(batch_axes, SEQUENCE_AXIS)
+    in_specs = [spec_qkv, spec_qkv, spec_qkv, spec_seg]
+    args = [q, k, v, segment_ids]
+    if sinks is not None:
+        in_specs.append(P(head_axis))
+        args.append(sinks)
+
+    def run(q, k, v, seg, *maybe_sinks):
+        return ring_attention(
+            q, k, v, seg,
+            axis_name=SEQUENCE_AXIS,
+            causal=True,
+            logits_soft_cap=logits_soft_cap,
+            scale=scale,
+            impl=impl,
+            sliding_window=sliding_window,
+            sinks=maybe_sinks[0] if maybe_sinks else None,
+        )
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=spec_qkv,
+        check_vma=False,
+    )(*args)
+
+
 def _safe_weight(lse: jnp.ndarray, lse_total: jnp.ndarray) -> jnp.ndarray:
     """exp(lse - lse_total) with fully-masked rows (-inf) mapping to weight 0
     without producing NaN in either branch (NaN in an untaken `where` branch
@@ -45,7 +119,22 @@ def _safe_weight(lse: jnp.ndarray, lse_total: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(lse - finite_total))
 
 
-def _chunk_fwd_xla(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap):
+def _pos_mask(c_q, c_kv, q_offset, causal, sliding_window):
+    """[C_q, C_kv] bool position mask for a chunk pair whose q chunk starts
+    `q_offset` positions after the kv chunk (static int)."""
+    q_pos = q_offset + jnp.arange(c_q)[:, None]
+    k_pos = jnp.arange(c_kv)[None, :]
+    mask = jnp.ones((c_q, c_kv), jnp.bool_)
+    if causal:
+        mask &= k_pos <= q_pos
+    if sliding_window is not None:
+        mask &= q_pos - k_pos < sliding_window
+    return mask
+
+
+def _chunk_fwd_xla(
+    q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap, sliding_window, q_offset
+):
     """(o, lse) for one chunk pair. q [B,C,Hq,D]; k/v [B,C,Hkv,D];
     lse [B,Hq,C] fp32; o is fp32 (combined then cast by the caller)."""
     batch, c_q, hq, d = q.shape
@@ -60,10 +149,9 @@ def _chunk_fwd_xla(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap):
     mask = (seg_q[:, None, None, :, None] == seg_kv[:, None, None, None, :]) & (
         seg_q[:, None, None, :, None] > 0
     )
-    if causal:
-        c_kv = k.shape[1]
-        mask = mask & (
-            jnp.arange(c_kv)[None, :] <= jnp.arange(c_q)[:, None]
+    if causal or sliding_window is not None:
+        mask = mask & _pos_mask(
+            c_q, k.shape[1], q_offset, causal, sliding_window
         )[None, None, None]
 
     s = jnp.where(mask, s, -jnp.inf)
@@ -77,7 +165,10 @@ def _chunk_fwd_xla(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap):
     return o.reshape(batch, c_q, hq, d), lse.reshape(batch, hq, c_q)
 
 
-def _chunk_bwd_xla(q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap):
+def _chunk_bwd_xla(
+    q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap,
+    sliding_window, q_offset,
+):
     """Chunk-pair gradients given the GLOBAL lse/delta ([B,Hq,C] fp32)."""
     batch, c_q, hq, d = q.shape
     hkv = k.shape[2]
@@ -92,10 +183,9 @@ def _chunk_bwd_xla(q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits
     mask = (seg_q[:, None, None, :, None] == seg_kv[:, None, None, None, :]) & (
         seg_q[:, None, None, :, None] > 0
     )
-    if causal:
-        c_kv = k.shape[1]
-        mask = mask & (
-            jnp.arange(c_kv)[None, :] <= jnp.arange(c_q)[:, None]
+    if causal or sliding_window is not None:
+        mask = mask & _pos_mask(
+            c_q, k.shape[1], q_offset, causal, sliding_window
         )[None, None, None]
 
     lse_g = lse.reshape(batch, hkv, group, c_q)[..., None]  # [b,hkv,g,q,1]
@@ -146,7 +236,10 @@ def _pallas_ok(q, k) -> bool:
     )
 
 
-def _chunk_fwd(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap, impl):
+def _chunk_fwd(
+    q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap, impl,
+    sliding_window=None, q_offset=0,
+):
     if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
         from llm_training_tpu.ops.pallas.flash_attention import flash_fwd_flat
 
@@ -156,14 +249,21 @@ def _chunk_fwd(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap, impl):
             _to_flat(q), _to_flat(k), _to_flat(v), seg_q, seg_kv,
             num_q_heads=hq, num_kv_heads=hkv, scale=scale, causal=causal,
             logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window, q_offset=q_offset,
             block_q=_ring_block(q.shape[1]), block_k=_ring_block(k.shape[1]),
             interpret=jax.default_backend() != "tpu",
         )
         return _from_flat(o, batch).astype(jnp.float32), lse.reshape(batch, hq, -1)
-    return _chunk_fwd_xla(q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap)
+    return _chunk_fwd_xla(
+        q, k, v, seg_q, seg_kv, causal, scale, logits_soft_cap,
+        sliding_window, q_offset,
+    )
 
 
-def _chunk_bwd(q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap, impl):
+def _chunk_bwd(
+    q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap, impl,
+    sliding_window=None, q_offset=0,
+):
     if impl == "pallas" or (impl == "auto" and _pallas_ok(q, k)):
         from llm_training_tpu.ops.pallas.flash_attention import flash_bwd_flat
 
@@ -175,12 +275,14 @@ def _chunk_bwd(q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_sof
             _to_flat(do), flat(lse), flat(delta),
             num_q_heads=hq, num_kv_heads=hkv, scale=scale, causal=causal,
             logits_soft_cap=logits_soft_cap,
+            sliding_window=sliding_window, q_offset=q_offset,
             block_q=_ring_block(q.shape[1]), block_k=_ring_block(k.shape[1]),
             interpret=jax.default_backend() != "tpu",
         )
         return _from_flat(dq, batch), _from_flat(dk, batch), _from_flat(dv, batch)
     return _chunk_bwd_xla(
-        q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap
+        q, k, v, seg_q, seg_kv, do, lse, delta, causal, scale, logits_soft_cap,
+        sliding_window, q_offset,
     )
 
 
@@ -194,15 +296,24 @@ def ring_attention(
     logits_soft_cap: float | None = None,
     scale: float | None = None,
     impl: str = "auto",
+    sliding_window: int | None = None,
+    sinks: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Causal ring attention over sequence-sharded chunks.
 
     Must be called inside `shard_map` (or any context where `axis_name` is a
     bound SPMD axis). Arguments are the per-device chunks:
     q/k/v [B, C, H, D], segment_ids [B, C] with GLOBAL document ids.
-    Sliding-window is not supported under the ring (the window would have to
-    cut inside rotated chunks); the reference has no context parallelism at
-    all, so there is no parity constraint here.
+
+    `sliding_window` composes with the ring: rotated chunks wholly outside
+    the window are never computed, and — since a window of w needs only the
+    last ceil-ish w positions — the ring stops rotating after
+    (w + c - 2)//c + 1 steps, so both compute AND communication are
+    O(window), not O(sequence).
+
+    `sinks` ([H_local] fp32, gpt-oss) seed each owner chunk's running
+    logsumexp, so the sink mass joins every softmax denominator exactly once
+    and the combine stays exact.
     """
     if not causal:
         raise NotImplementedError("ring attention currently requires causal=True")
@@ -225,12 +336,23 @@ def ring_attention(
         scale=scale,
         logits_soft_cap=logits_soft_cap,
         impl=impl,
+        sliding_window=sliding_window,
+        has_sinks=sinks is not None,
     )
-    return ring(q, k, v, segment_ids)
+    # sinks=None flows through the custom_vjp as an empty pytree leaf
+    return ring(q, k, v, segment_ids, sinks)
 
 
 @functools.cache
-def _make_ring(*, axis_name: str, scale: float, logits_soft_cap: float | None, impl: str):
+def _make_ring(
+    *,
+    axis_name: str,
+    scale: float,
+    logits_soft_cap: float | None,
+    impl: str,
+    sliding_window: int | None,
+    has_sinks: bool,
+):
     chunk_fwd = functools.partial(
         _chunk_fwd, scale=scale, logits_soft_cap=logits_soft_cap, impl=impl
     )
@@ -243,36 +365,58 @@ def _make_ring(*, axis_name: str, scale: float, logits_soft_cap: float | None, i
         perm = [(i, (i + 1) % n) for i in range(n)]
         return jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), tree)
 
-    def _fwd(q, k, v, seg_q):
+    def _num_steps(n: int, c: int) -> int:
+        """Ring steps with any in-window pair: step s pairs q positions with
+        kv positions s·c older at chunk granularity; beyond the window the
+        mask is all-False, so the ring stops early (static bound)."""
+        if sliding_window is None:
+            return n
+        return min(n, (sliding_window + c - 2) // c + 1)
+
+    def _fwd(q, k, v, seg_q, sinks):
         n = lax.axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         batch, c, hq, d = q.shape
 
         o_acc = jnp.zeros((batch, c, hq, d), jnp.float32)
-        lse_acc = jnp.full((batch, hq, c), -jnp.inf, jnp.float32)
+        if has_sinks:
+            # seed the combine at the owner chunk: the running softmax
+            # denominator starts holding the sink mass (zero value), and
+            # every later combine rescales it exactly
+            lse_acc = jnp.broadcast_to(
+                sinks.astype(jnp.float32)[None, :, None], (batch, hq, c)
+            )
+        else:
+            lse_acc = jnp.full((batch, hq, c), -jnp.inf, jnp.float32)
         k_cur, v_cur, seg_cur = k, v, seg_q
-        for s in range(n):
-            src = (idx - s) % n
-            # 0: diagonal (causal), 1: src earlier (full), 2: src later (skip)
-            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
-            o_s, lse_s = lax.switch(
-                branch,
-                [
-                    lambda args: chunk_fwd(*args, causal=True),
-                    lambda args: chunk_fwd(*args, causal=False),
+        steps = _num_steps(n, c)
+        for s in range(steps):
+            if s == 0:
+                o_s, lse_s = chunk_fwd(
+                    q, k_cur, v_cur, seg_q, seg_cur, causal=True,
+                    sliding_window=sliding_window, q_offset=0,
+                )
+            else:
+                # non-wrapped sources sit exactly s chunks earlier (static
+                # offset s·c); wrapped sources are in the future -> skip
+                o_s, lse_s = lax.cond(
+                    idx >= s,
+                    lambda args: chunk_fwd(
+                        *args, causal=False,
+                        sliding_window=sliding_window, q_offset=s * c,
+                    ),
                     lambda args: (
                         jnp.zeros((batch, c, hq, d), jnp.float32),
                         jnp.full((batch, hq, c), -jnp.inf, jnp.float32),
                     ),
-                ],
-                (q, k_cur, v_cur, seg_q, seg_cur),
-            )
+                    (q, k_cur, v_cur, seg_q, seg_cur),
+                )
             lse_new = jnp.logaddexp(lse_acc, lse_s)
             w_acc = _safe_weight(lse_acc, lse_new)[..., None].swapaxes(1, 2)
             w_s = _safe_weight(lse_s, lse_new)[..., None].swapaxes(1, 2)
             o_acc = o_acc * w_acc + o_s * w_s
             lse_acc = lse_new
-            if s < n - 1:
+            if s < steps - 1:
                 k_cur, v_cur, seg_cur = _rotate((k_cur, v_cur, seg_cur))
         return o_acc.astype(q.dtype), lse_acc
 
@@ -292,41 +436,65 @@ def _make_ring(*, axis_name: str, scale: float, logits_soft_cap: float | None, i
         zeros = lambda: (
             jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v)
         )
-        for s in range(n):
-            src = (idx - s) % n
-            branch = jnp.where(src == idx, 0, jnp.where(src < idx, 1, 2))
-            dq_s, dk_s, dv_s = lax.switch(
-                branch,
-                [
-                    lambda args: chunk_bwd(*args, causal=True),
-                    lambda args: chunk_bwd(*args, causal=False),
+        steps = _num_steps(n, c)
+        for s in range(steps):
+            if s == 0:
+                dq_s, dk_s, dv_s = chunk_bwd(
+                    q, k_cur, v_cur, seg_q, seg_cur, do, lse, delta,
+                    causal=True, sliding_window=sliding_window, q_offset=0,
+                )
+            else:
+                dq_s, dk_s, dv_s = lax.cond(
+                    idx >= s,
+                    lambda args: chunk_bwd(
+                        *args, causal=False,
+                        sliding_window=sliding_window, q_offset=s * c,
+                    ),
                     lambda args: zeros(),
-                ],
-                (q, k_cur, v_cur, seg_q, seg_cur, do, lse, delta),
-            )
+                    (q, k_cur, v_cur, seg_q, seg_cur, do, lse, delta),
+                )
             dq_acc = dq_acc + dq_s.astype(jnp.float32)
             dk_cur = dk_cur + dk_s.astype(jnp.float32)
             dv_cur = dv_cur + dv_s.astype(jnp.float32)
-            # rotate the kv chunk together with its gradient accumulators;
-            # after the final (n-th) rotation each dk/dv is home at its owner
+            # rotate the kv chunk together with its gradient accumulators
             k_cur, v_cur, seg_cur, dk_cur, dv_cur = _rotate(
                 (k_cur, v_cur, seg_cur, dk_cur, dv_cur)
+            )
+        if steps < n:
+            # the window cut the ring short: jump each dk/dv accumulator the
+            # remaining n - steps hops straight home in ONE ppermute
+            perm = [(i, (i + (n - steps)) % n) for i in range(n)]
+            dk_cur, dv_cur = (
+                lax.ppermute(dk_cur, axis_name, perm),
+                lax.ppermute(dv_cur, axis_name, perm),
             )
         return dq_acc.astype(q.dtype), dk_cur.astype(k.dtype), dv_cur.astype(v.dtype)
 
     @jax.custom_vjp
-    def ring(q, k, v, seg_q):
-        o, _ = _fwd(q, k, v, seg_q)
+    def ring(q, k, v, seg_q, sinks):
+        o, _ = _fwd(q, k, v, seg_q, sinks)
         return o
 
-    def ring_fwd(q, k, v, seg_q):
-        o, lse = _fwd(q, k, v, seg_q)
-        return o, (q, k, v, seg_q, o, lse)
+    def ring_fwd(q, k, v, seg_q, sinks):
+        o, lse = _fwd(q, k, v, seg_q, sinks)
+        return o, (q, k, v, seg_q, sinks, o, lse)
 
     def ring_bwd(res, do):
-        q, k, v, seg_q, o, lse = res
+        q, k, v, seg_q, sinks, o, lse = res
         dq, dk, dv = _bwd_ring(q, k, v, seg_q, o, lse, do)
-        return dq, dk, dv, None
+        if has_sinks:
+            # d/dsink of the sink-seeded softmax: -p_sink · delta per row,
+            # summed over this device's (batch, chunk); replicated-axis
+            # cotangent summation (sequence/batch) is the enclosing
+            # shard_map transpose's job
+            delta = jnp.sum(
+                do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+            ).transpose(0, 2, 1)  # [B, Hq, C]
+            p_sink = jnp.exp(sinks.astype(jnp.float32)[None, :, None] - lse)
+            d_sinks = -(p_sink * delta).sum(axis=(0, 2)).astype(sinks.dtype)
+        else:
+            d_sinks = None
+        return dq, dk, dv, None, d_sinks
 
     ring.defvjp(ring_fwd, ring_bwd)
     return ring

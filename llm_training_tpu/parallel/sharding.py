@@ -22,26 +22,34 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from llm_training_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS
+from llm_training_tpu.parallel.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    FSDP_AXIS,
+    SEQUENCE_AXIS,
+    TENSOR_AXIS,
+)
 
 # (logical axis name, mesh axis / axes / None=replicated)
 LogicalAxisRules = Sequence[tuple[str, str | Sequence[str] | None]]
 
 DEFAULT_LOGICAL_AXIS_RULES: LogicalAxisRules = (
-    # --- activations
-    ("batch", (DATA_AXIS, FSDP_AXIS)),
+    # --- activations; the expert axis is extra data parallelism for the
+    # dense parts of the model — EP groups are subsets of DP ranks
+    ("batch", (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS)),
     ("act_seq", SEQUENCE_AXIS),
     ("act_embed", None),
     ("act_heads", TENSOR_AXIS),
     ("act_vocab", TENSOR_AXIS),
-    # --- parameters
+    # --- parameters; expert stacks shard E over the expert axis (their
+    # embed/mlp dims additionally shard over fsdp/tensor like dense params)
     ("embed", FSDP_AXIS),
     ("heads", TENSOR_AXIS),
     ("kv_heads", TENSOR_AXIS),
     ("mlp", TENSOR_AXIS),
     ("vocab", TENSOR_AXIS),
     ("norm", None),
-    ("expert", None),
+    ("expert", EXPERT_AXIS),
 )
 
 

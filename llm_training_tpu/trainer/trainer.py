@@ -50,6 +50,17 @@ class TrainerConfig(BaseModel):
     # batches placed on device ahead of the step loop by a worker thread
     # (the reference's pin_memory/prefetch_factor analogue); 0 disables
     prefetch_batches: int = 2
+    # park optimizer state (fp32 mu/nu — 8 bytes/param) in host memory
+    # (`pinned_host`), copying it through HBM around each update — the
+    # reference's DeepSpeed CPU-offload lever (`deepspeed_strategy.py:23-37`)
+    # as XLA host offloading. Buys ~8 bytes/param of HBM for one
+    # host<->device round trip of the optimizer state per step; with
+    # gradient accumulation the MultiSteps accumulators ride along, so
+    # prefer accumulate_grad_batches=1 when enabling this. NOTE: the
+    # multi-device CPU backend cannot compile memory-kind annotations (XLA
+    # CPU SPMD "Side-effect HLO must have sharding"); TPU meshes and
+    # single-device runs are the supported surfaces
+    offload_optimizer_state: bool = False
     mesh: MeshConfig = MeshConfig()
 
 
@@ -70,10 +81,12 @@ class Trainer:
         config: TrainerConfig,
         callbacks: list[Any] | None = None,
         checkpointer: Any | None = None,
+        devices: list | None = None,
     ):
         self.config = config
         self.callbacks = callbacks or []
         self.checkpointer = checkpointer
+        self.devices = devices  # None = all (tests pin subsets)
         self.mesh: Mesh | None = None
         self.state_shardings = None
         # host-side persistent counters (reference metrics/consumed_*.py);
@@ -115,13 +128,42 @@ class Trainer:
                 spec = PartitionSpec()
             return NamedSharding(self.mesh, spec)
 
-        return jax.tree.map(
+        shardings = jax.tree.map(
             leaf_sharding,
             abstract_state,
             is_leaf=lambda x: isinstance(x, nn.Partitioned),
         )
+        if self.config.offload_optimizer_state:
+            def maybe_host(sharding, leaf):
+                # only real arrays (mu/nu) move to host; rank-0 counters stay
+                # on device — the SPMD partitioner rejects host placement of
+                # side-effect scalars ("Side-effect HLO must have sharding")
+                shape = leaf.value.shape if isinstance(leaf, nn.Partitioned) else leaf.shape
+                if len(shape) == 0:
+                    return sharding
+                return sharding.with_memory_kind("pinned_host")
+
+            shardings = shardings.replace(
+                opt_state=jax.tree.map(
+                    maybe_host,
+                    shardings.opt_state,
+                    abstract_state.opt_state,
+                    is_leaf=lambda x: isinstance(x, (NamedSharding, nn.Partitioned)),
+                )
+            )
+        return shardings
 
     def _build_step(self, objective, tx) -> Callable:
+        offload = self.config.offload_optimizer_state
+        if offload:
+            # device-resident twins of the (pinned_host) opt-state shardings:
+            # the update math runs in HBM, bracketed by explicit copies
+            opt_device = jax.tree.map(
+                lambda s: s.with_memory_kind("device"),
+                self.state_shardings.opt_state,
+            )
+            opt_host = self.state_shardings.opt_state
+
         def train_step(state: TrainState, batch: dict[str, jnp.ndarray]):
             step_rng = jax.random.fold_in(state.rng, state.step)
 
@@ -129,7 +171,12 @@ class Trainer:
                 return objective.loss_and_metrics(params, batch, rng=step_rng, train=True)
 
             grads, metrics = jax.grad(loss_fn, has_aux=True)(state.params)
-            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            opt_state = state.opt_state
+            if offload:
+                opt_state = jax.tree.map(jax.device_put, opt_state, opt_device)
+            updates, opt_state = tx.update(grads, opt_state, state.params)
+            if offload:
+                opt_state = jax.tree.map(jax.device_put, opt_state, opt_host)
             params = optax.apply_updates(state.params, updates)
             metrics["grad_norm"] = optax.global_norm(grads)
             new_state = state.replace(
@@ -160,7 +207,7 @@ class Trainer:
         state: TrainState | None = None,
     ) -> TrainState:
         cfg = self.config
-        self.mesh = build_mesh(cfg.mesh)
+        self.mesh = build_mesh(cfg.mesh, self.devices)
         datamodule.setup()
 
         try:
@@ -216,6 +263,14 @@ class Trainer:
             if hasattr(objective, "pretrained_source")
             else None
         )
+        # init jits emit all-device buffers; offloaded (pinned_host) leaves
+        # move EAGERLY afterwards — a mixed-memory-kind out_shardings would
+        # annotate every output, which some partitioners reject
+        init_shardings = self.state_shardings
+        if cfg.offload_optimizer_state:
+            init_shardings = jax.tree.map(
+                lambda s: s.with_memory_kind("device"), self.state_shardings
+            )
         if state is None and pre_trained and objective.config.load_weights:
             # stream HF weights straight into sharded buffers (reference
             # rank-0-load + broadcast, base_lm.py:175-193)
@@ -223,7 +278,7 @@ class Trainer:
             dtypes = jax.tree.map(lambda leaf: leaf.dtype, abstract_state.params)
             params = objective.pretrained_params(self.state_shardings.params, dtypes)
             opt_state = jax.jit(
-                tx.init, out_shardings=self.state_shardings.opt_state
+                tx.init, out_shardings=init_shardings.opt_state
             )(params)
             state = jax.device_put(
                 TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1)),
@@ -239,9 +294,11 @@ class Trainer:
                     TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1))
                 )
 
-            state = jax.jit(make_state, out_shardings=self.state_shardings)(
+            state = jax.jit(make_state, out_shardings=init_shardings)(
                 jax.random.key(cfg.seed)
             )
+            if cfg.offload_optimizer_state:
+                state = jax.device_put(state, self.state_shardings)
 
         train_step = jax.jit(
             self._build_step(objective, tx),
@@ -432,7 +489,7 @@ class Trainer:
         if self.checkpointer is None:
             raise ValueError("validate_from_checkpoint requires a checkpointer")
         cfg = self.config
-        self.mesh = build_mesh(cfg.mesh)
+        self.mesh = build_mesh(cfg.mesh, self.devices)
         datamodule.setup()
         with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
             sample_batch = next(datamodule.train_batches())
@@ -471,7 +528,7 @@ class Trainer:
 
     def validate(self, objective, datamodule, state: TrainState) -> dict[str, float]:
         datamodule.setup()
-        mesh = self.mesh or build_mesh(self.config.mesh)
+        mesh = self.mesh or build_mesh(self.config.mesh, self.devices)
         # same sharding discipline as fit/validate_from_checkpoint: explicit
         # in_shardings (state shardings from fit if available, else the live
         # arrays' own shardings)

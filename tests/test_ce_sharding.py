@@ -1,0 +1,103 @@
+"""HLO-level proof of the vocab-sharded fused CE (VERDICT r3 #4).
+
+`lms/clm.py` claims the chunked `fused_linear_cross_entropy` lowers to a
+vocab-sharded lm-head matmul + psum under tensor parallelism — i.e. the
+reference's `loss_parallel` semantics without a dedicated code path. These
+tests compile the op on a tensor-sharded mesh and inspect the partitioned
+HLO: no full-vocab logits buffer may materialize per device, and the head
+must never be all-gathered. They FAIL if the sharding regresses (e.g. a
+future change constrains the logits to replicated).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_training_tpu.ops.cross_entropy import fused_linear_cross_entropy
+from llm_training_tpu.parallel.mesh import MeshConfig, build_mesh
+
+TOKENS, HIDDEN, VOCAB, CHUNK = 4096, 256, 32000, 1024
+TP = 8
+
+
+@pytest.fixture()
+def tp_mesh(devices):
+    return build_mesh(MeshConfig(fsdp_size=1, tensor_parallel_size=TP))
+
+
+def _compile(tp_mesh, grad: bool):
+    hidden_sh = NamedSharding(tp_mesh, P(None, None))
+    head_sh = NamedSharding(tp_mesh, P(None, "tensor"))  # vocab-sharded
+    labels_sh = NamedSharding(tp_mesh, P(None))
+
+    def loss(hidden, head, labels):
+        total, count = fused_linear_cross_entropy(
+            hidden, head, labels, chunk_size=CHUNK
+        )
+        return total / jnp.maximum(count, 1).astype(jnp.float32)
+
+    fn = jax.grad(loss, argnums=(0, 1)) if grad else loss
+    return (
+        jax.jit(fn)
+        .lower(
+            jax.ShapeDtypeStruct((TOKENS, HIDDEN), jnp.bfloat16, sharding=hidden_sh),
+            jax.ShapeDtypeStruct((HIDDEN, VOCAB), jnp.bfloat16, sharding=head_sh),
+            jax.ShapeDtypeStruct((TOKENS,), jnp.int32, sharding=labels_sh),
+        )
+        .compile()
+    )
+
+
+def _shapes_in(txt: str) -> set[tuple[int, ...]]:
+    return {
+        tuple(int(d) for d in m.group(1).split(",") if d)
+        for m in re.finditer(r"\w+\[([\d,]+)\]", txt)
+    }
+
+
+@pytest.mark.parametrize("grad", [False, True], ids=["fwd", "fwd+bwd"])
+def test_ce_stays_vocab_sharded(tp_mesh, grad):
+    compiled = _compile(tp_mesh, grad)
+    txt = compiled.as_text()
+    shapes = _shapes_in(txt)
+
+    # 1. no full-vocab logits chunk on any device: [CHUNK, VOCAB] must not
+    #    appear (the per-device chunk is [CHUNK, VOCAB/TP])
+    assert (CHUNK, VOCAB) not in shapes, "full logits chunk materialized"
+    assert (CHUNK, VOCAB // TP) in shapes, "expected vocab-sharded chunk missing"
+
+    # 2. the lm_head is never all-gathered: no instruction produces a
+    #    full [HIDDEN, VOCAB] tensor (each device keeps [HIDDEN, VOCAB/TP])
+    assert (HIDDEN, VOCAB) not in shapes, "lm_head all-gathered"
+
+    # 3. the cross-shard softmax reduction exists (psum over tensor ranks)
+    assert "all-reduce" in txt
+
+    # 4. nothing full-vocab anywhere: the largest vocab-dim buffer is the
+    #    sharded one
+    assert not any(s and s[-1] == VOCAB for s in shapes), (
+        "some buffer materialized the full vocab axis"
+    )
+
+
+def test_ce_sharded_numerics_match_replicated(tp_mesh):
+    """The vocab-sharded compile must produce the same loss as a plain
+    single-device evaluation."""
+    rng = np.random.default_rng(0)
+    hidden = jnp.asarray(rng.standard_normal((TOKENS, HIDDEN)) * 0.02, jnp.bfloat16)
+    head = jnp.asarray(rng.standard_normal((HIDDEN, VOCAB)) * 0.02, jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, VOCAB, (TOKENS,)), jnp.int32)
+
+    compiled = _compile(tp_mesh, grad=False)
+    sharded = compiled(
+        jax.device_put(hidden, NamedSharding(tp_mesh, P(None, None))),
+        jax.device_put(head, NamedSharding(tp_mesh, P(None, "tensor"))),
+        jax.device_put(labels, NamedSharding(tp_mesh, P(None))),
+    )
+    total, count = fused_linear_cross_entropy(hidden, head, labels, chunk_size=CHUNK)
+    expected = total / jnp.maximum(count, 1).astype(jnp.float32)
+    np.testing.assert_allclose(float(sharded), float(expected), rtol=1e-5)

@@ -89,6 +89,67 @@ def test_save_resume_matches_uninterrupted(devices, tmp_path):
 
 
 @pytest.mark.slow
+def test_cross_topology_resume(devices, tmp_path):
+    """VERDICT r3 #8 (reference DCP restore, fsdp2_strategy.py:395-409):
+    a checkpoint written on a {fsdp:4, tensor:2} mesh must restore onto a
+    pure {fsdp:8} mesh — orbax reshards against the new target shardings —
+    and continue EXACTLY like a same-topology resume."""
+    from llm_training_tpu.parallel import MeshConfig
+
+    ckpt_dir = str(tmp_path / "xtopo")
+    mesh_a = MeshConfig(fsdp_size=4, tensor_parallel_size=2)
+    t1 = Trainer(
+        TrainerConfig(max_steps=5, log_every_n_steps=1,
+                      checkpoint_every_n_steps=5, mesh=mesh_a),
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=ckpt_dir, async_save=False)
+        ),
+    )
+    t1.fit(_objective(), _data())
+
+    # each resume gets its own COPY of the step-5 checkpoint so neither
+    # run's later saves can shadow the restore point of the other
+    import shutil
+
+    dir_same, dir_x = str(tmp_path / "same"), str(tmp_path / "cross")
+    shutil.copytree(ckpt_dir, dir_same)
+    shutil.copytree(ckpt_dir, dir_x)
+
+    # reference run: same topology throughout
+    rec_same = _Rec()
+    t_same = Trainer(
+        TrainerConfig(max_steps=10, log_every_n_steps=1, mesh=mesh_a),
+        callbacks=[rec_same],
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=dir_same, async_save=False)
+        ),
+    )
+    t_same.fit(_objective(), _data())
+
+    # cross-topology resume: restore the same step-5 checkpoint on fsdp:8
+    rec_x = _Rec()
+    t2 = Trainer(
+        TrainerConfig(max_steps=10, log_every_n_steps=1,
+                      mesh=MeshConfig(fsdp_size=8)),
+        callbacks=[rec_x],
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=dir_x, async_save=False)
+        ),
+    )
+    state = t2.fit(_objective(), _data())
+
+    assert int(jax.device_get(state.step)) == 10
+    for step in range(6, 11):
+        np.testing.assert_allclose(
+            rec_x.losses[step], rec_same.losses[step], rtol=1e-6,
+            err_msg=f"step {step}",
+        )
+    # and the restored params really live on the new mesh
+    leaf = jax.tree.leaves(state.params)[0]
+    assert leaf.sharding.mesh.shape["fsdp"] == 8
+
+
+@pytest.mark.slow
 def test_validate_from_checkpoint(devices, tmp_path):
     ckpt_dir = str(tmp_path / "v")
     trainer = Trainer(

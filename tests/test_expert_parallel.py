@@ -1,0 +1,91 @@
+"""Expert parallelism: shard_map EP dispatch vs the exact dense path, and
+the expert-axis sharding rules.
+
+VERDICT r3 #2: the `expert` mesh axis (parallel/mesh.py) shards the stacked
+expert parameters' leading dim and switches `dropless_moe_apply` to the
+all-gather + local-ragged + reduce-scatter EP path (models/moe.py). The
+reference has no MoE training path at all, so the correctness bar is
+internal: EP output == dense-every-expert output on the same weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_training_tpu.models import Llama, LlamaConfig
+from llm_training_tpu.parallel.mesh import EXPERT_AXIS, MeshConfig, build_mesh
+from llm_training_tpu.parallel.sharding import (
+    DEFAULT_LOGICAL_AXIS_RULES,
+    logical_to_spec,
+)
+from tests.test_moe import TINY_MOE
+
+
+@pytest.fixture()
+def ep_mesh(devices):
+    return build_mesh(
+        MeshConfig(fsdp_size=2, expert_parallel_size=2, tensor_parallel_size=2)
+    )
+
+
+def test_expert_rule_maps_to_expert_axis():
+    spec = logical_to_spec(("expert", "embed", "mlp"), DEFAULT_LOGICAL_AXIS_RULES)
+    assert spec == jax.sharding.PartitionSpec("expert", "fsdp", "tensor")
+    # batch gains the expert axis as extra data parallelism
+    batch_spec = logical_to_spec(("batch", "act_seq"), DEFAULT_LOGICAL_AXIS_RULES)
+    assert "expert" in batch_spec[0]
+
+
+def test_ep_dispatch_matches_dense(ep_mesh):
+    """Same weights through the EP shard_map path (expert axis 2) and the
+    exact every-expert dense path must agree: at ep=2 the default capacity
+    factor 2.0 sizes each rank's buffer to ALL T·K rows, so drops are
+    impossible and the comparison is exact."""
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (4, 16)))
+    cfg_r = LlamaConfig(**TINY_MOE, moe_impl="ragged")
+    cfg_d = LlamaConfig(**TINY_MOE, moe_impl="dense")
+    model_r, model_d = Llama(cfg_r), Llama(cfg_d)
+    params = model_d.init(jax.random.key(0), ids)
+
+    out_d = model_d.apply(params, ids)  # no mesh: plain dense reference
+    with ep_mesh:
+        out_ep = jax.jit(lambda p, x: model_r.apply(p, x).logits)(params, ids)
+    np.testing.assert_allclose(
+        np.asarray(out_ep), np.asarray(out_d.logits), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_ep_grads_match_dense(ep_mesh):
+    """The EP dispatch is fully differentiable (gather/scatter transpose);
+    gradients must match the dense path's."""
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 128, (2, 16)))
+    cfg_r = LlamaConfig(**TINY_MOE, moe_impl="ragged")
+    cfg_d = LlamaConfig(**TINY_MOE, moe_impl="dense")
+    model_r, model_d = Llama(cfg_r), Llama(cfg_d)
+    params = model_d.init(jax.random.key(1), ids)
+
+    def loss(model):
+        def f(p):
+            return jnp.mean(model.apply(p, ids).logits.astype(jnp.float32) ** 2)
+        return f
+
+    g_d = jax.grad(loss(model_d))(params)
+    with ep_mesh:
+        g_ep = jax.jit(jax.grad(loss(model_r)))(params)
+    flat_d, flat_ep = jax.tree.leaves(g_d), jax.tree.leaves(g_ep)
+    for a, b in zip(flat_d, flat_ep):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-5
+        )
+
+
+def test_ep_requires_divisible_experts(ep_mesh):
+    cfg = LlamaConfig(**{**TINY_MOE, "num_experts": 3, "num_experts_per_tok": 2},
+                      moe_impl="ragged")
+    model = Llama(cfg)
+    ids = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.key(0), ids)
+    with ep_mesh:
+        with pytest.raises(ValueError, match="divide"):
+            jax.jit(lambda p, x: model.apply(p, x).logits)(params, ids)

@@ -125,6 +125,16 @@ def test_model_class_for_hf():
     assert model_class_for_hf({"model_type": "llama"}).endswith("Llama")
     assert model_class_for_hf({"model_type": "mistral"}).endswith("Llama")
     assert model_class_for_hf({"model_type": "phi3"}).endswith("Phi3")
+
+
+def test_unknown_model_type_llama_fallback():
+    """Unknown model_types fail loudly by default; the opt-in routes them
+    to the Llama family (renamed llama-layout forks)."""
+    with pytest.raises(ValueError, match="assume_llama_layout"):
+        model_class_for_hf({"model_type": "somebodys_llama_fork"})
+    assert model_class_for_hf(
+        {"model_type": "somebodys_llama_fork"}, assume_llama_layout=True
+    ).endswith("Llama")
     with pytest.raises(ValueError):
         model_class_for_hf({"model_type": "mamba"})
 

@@ -23,8 +23,8 @@ from llm_training_tpu.parallel.ring_attention import ring_attention
 
 
 def _ring_mesh(n=4):
-    return Mesh(np.asarray(jax.devices()[:n]).reshape(1, 1, 1, n),
-                ("data", "fsdp", "tensor", "sequence"))
+    return Mesh(np.asarray(jax.devices()[:n]).reshape(1, 1, 1, 1, n),
+                ("data", "fsdp", "expert", "tensor", "sequence"))
 
 
 def _shard_mapped_ring(mesh, **kw):
@@ -121,6 +121,111 @@ def test_ring_pallas_non_block_multiple_chunks():
     got = _shard_mapped_ring(mesh, impl="pallas")(q, k, v, seg)
     assert not np.any(np.isnan(np.asarray(got)))
     np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_ring_sliding_window():
+    """Window smaller than a chunk AND window spanning several chunks, both
+    with packed segments: the ring must cut compute/steps yet match the
+    global windowed attention exactly."""
+    rng = np.random.default_rng(8)
+    q, k, v, seg = _data(rng)
+    mesh = _ring_mesh(4)
+    for window in (7, 16, 37):
+        expected = dot_product_attention(
+            q, k, v, segment_ids=seg, sliding_window=window, impl="xla"
+        )
+        got = _shard_mapped_ring(mesh, sliding_window=window)(q, k, v, seg)
+        np.testing.assert_allclose(
+            got, expected, rtol=1e-4, atol=1e-5, err_msg=f"window={window}"
+        )
+
+
+def test_ring_sliding_window_gradients():
+    rng = np.random.default_rng(9)
+    q, k, v, seg = _data(rng)
+    mesh = _ring_mesh(4)
+    cot = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    ring = _shard_mapped_ring(mesh, sliding_window=20)
+    g_ring = jax.grad(
+        lambda q, k, v: (ring(q, k, v, seg) * cot).sum(), argnums=(0, 1, 2)
+    )(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: (
+            dot_product_attention(
+                q, k, v, segment_ids=seg, sliding_window=20, impl="xla"
+            ) * cot
+        ).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, err_msg=f"d{name}")
+
+
+def _shard_mapped_ring_sinks(mesh, **kw):
+    spec = P(None, "sequence", None, None)
+    seg_spec = P(None, "sequence")
+    def run(q, k, v, seg, sinks):
+        return ring_attention(
+            q, k, v, seg, axis_name="sequence", sinks=sinks, **kw
+        )
+
+    return jax.shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, seg_spec, P(None)),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+
+def test_ring_sinks():
+    """gpt-oss attention sinks: the owner chunk seeds the combine, so the
+    sink mass joins every row's denominator exactly once across the ring."""
+    rng = np.random.default_rng(10)
+    q, k, v, seg = _data(rng)
+    sinks = jnp.asarray(rng.standard_normal(q.shape[2]), jnp.float32)
+    mesh = _ring_mesh(4)
+    expected = dot_product_attention(
+        q, k, v, segment_ids=seg, sinks=sinks, impl="xla"
+    )
+    got = _shard_mapped_ring_sinks(mesh)(q, k, v, seg, sinks)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_sinks_gradients():
+    """d_sinks flows through the seeded combine; the shard_map transpose
+    sums the per-device contributions over the sequence axis."""
+    rng = np.random.default_rng(11)
+    q, k, v, seg = _data(rng)
+    sinks = jnp.asarray(rng.standard_normal(q.shape[2]), jnp.float32)
+    mesh = _ring_mesh(4)
+    cot = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+    ring = _shard_mapped_ring_sinks(mesh)
+    g_ring = jax.grad(
+        lambda q, k, v, s: (ring(q, k, v, seg, s) * cot).sum(),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, sinks)
+    g_ref = jax.grad(
+        lambda q, k, v, s: (
+            dot_product_attention(q, k, v, segment_ids=seg, sinks=s, impl="xla")
+            * cot
+        ).sum(),
+        argnums=(0, 1, 2, 3),
+    )(q, k, v, sinks)
+    for a, b, name in zip(g_ring, g_ref, ("dq", "dk", "dv", "dsinks")):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_ring_window_and_sinks_compose():
+    rng = np.random.default_rng(12)
+    q, k, v, seg = _data(rng)
+    sinks = jnp.asarray(rng.standard_normal(q.shape[2]), jnp.float32)
+    mesh = _ring_mesh(4)
+    expected = dot_product_attention(
+        q, k, v, segment_ids=seg, sliding_window=20, sinks=sinks, impl="xla"
+    )
+    got = _shard_mapped_ring_sinks(mesh, sliding_window=20)(q, k, v, seg, sinks)
+    np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
 
 
 def test_ring_inside_jit_under_mesh():

@@ -34,7 +34,38 @@ def _ernie45_moe():
     return (*_hf_tiny(num_hidden_layers=3), "ernie45_moe")
 
 
-@pytest.mark.parametrize("build", [_deepseek, _glm4_moe, _ernie45_moe])
+def _gpt_oss():
+    from tests.test_gpt_oss import _hf_tiny
+
+    # 4 layers = 2 cycles of the (sliding, full) pair — the scan needs a
+    # proper repetition (detect_period returns 0 at 2 layers)
+    return (*_hf_tiny(num_hidden_layers=4), "gpt_oss")
+
+
+def _qwen3_next():
+    from tests.test_qwen3_next import _hf_tiny
+
+    # 8 layers = 2 cycles of the 3×linear+full period
+    return (*_hf_tiny(num_hidden_layers=8), "qwen3_next")
+
+
+def _minimax():
+    from tests.test_minimax import _hf_tiny
+
+    return (*_hf_tiny(), "minimax")  # 4 layers alternating = period 2
+
+
+def _bamba():
+    from tests.test_bamba import _hf_tiny
+
+    # (mamba, attention) × 2 — slope-free periodic hybrid
+    return (*_hf_tiny(num_hidden_layers=4, attn_layer_indices=[1, 3]), "bamba")
+
+
+@pytest.mark.parametrize(
+    "build",
+    [_deepseek, _glm4_moe, _ernie45_moe, _gpt_oss, _qwen3_next, _minimax, _bamba],
+)
 def test_loop_vs_scan_parity(build):
     torch = pytest.importorskip("torch")
     hf_model, hf_config, family = build()
@@ -50,11 +81,12 @@ def test_loop_vs_scan_parity(build):
     sd = hf_model.state_dict()
     outs, cfgs, trees = [], [], []
     for scan in (True, False):
-        cfg = conv.config_from_hf(
-            hf_config, compute_dtype="float32", moe_impl="dense",
-            scan_layers=scan,
-        )
-        assert (cfg.num_scanned_layers > 0) == scan
+        overrides = {"compute_dtype": "float32", "scan_layers": scan}
+        if "moe_impl" in type(conv.config_from_hf(hf_config)).model_fields:
+            overrides["moe_impl"] = "dense"
+        cfg = conv.config_from_hf(hf_config, **overrides)
+        active = getattr(cfg, "num_scanned_layers", 0) or getattr(cfg, "scan_period", 0)
+        assert bool(active) == scan
         params = conv.params_from_hf(sd, cfg)
         ids = np.random.default_rng(7).integers(0, cfg.vocab_size, (2, 16))
         outs.append(np.asarray(model_cls(cfg).apply(params, jnp.asarray(ids)).logits))
