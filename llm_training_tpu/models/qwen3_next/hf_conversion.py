@@ -84,7 +84,9 @@ def params_from_hf(
             def moe(sub):
                 if not memo:
                     memo.update(_moe_layer_parts(sd, config, i))
-                return memo[sub]
+                # each key is read exactly once per layer: pop so the memo
+                # drains and host memory stays one stacked tensor at a time
+                return memo.pop(sub)
 
             for sub in _moe_key_set(config):
                 parts[sub] = functools.partial(moe, sub)

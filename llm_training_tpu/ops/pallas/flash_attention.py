@@ -72,6 +72,67 @@ def _q_bh_map(num_q_heads: int, num_kv_heads: int):
     return q_bh
 
 
+def _kv_clamp(
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    causal: bool,
+    sliding_window: int | None,
+    num_kv_blocks: int,
+):
+    """j -> clamped kv-block index for q-block i: position-skipped tiles map
+    to the nearest VISITED kv block, so their BlockSpec index repeats and
+    Pallas elides the k/v DMA entirely (the tile still dispatches, but
+    `pl.when` skips its compute). At long causal sequences ~half the grid is
+    skipped tiles; without the clamp each still streamed a k/v block."""
+    if not causal and sliding_window is None:
+        return lambda i, j: j
+
+    def clamp(i, j):
+        lo, hi = 0, num_kv_blocks - 1  # unset bounds stay array-wide
+        if causal:
+            # visit needs k_lo <= q_hi: j <= (q_hi) // block_k
+            hi = (i * block_q + q_offset + block_q - 1) // block_k
+        if sliding_window is not None:
+            # visit needs q_lo - k_hi < w: j*bk + bk - 1 > q_lo - w
+            lo = (
+                i * block_q + q_offset - sliding_window - block_k + 1
+            ) // block_k + 1
+        # rows with an empty visited range (or a range outside the array)
+        # may point anywhere in bounds — their compute is skipped regardless
+        return jnp.clip(jnp.clip(j, lo, hi), 0, num_kv_blocks - 1)
+
+    return clamp
+
+
+def _q_clamp(
+    block_q: int,
+    block_k: int,
+    q_offset: int,
+    causal: bool,
+    sliding_window: int | None,
+    num_q_blocks: int,
+):
+    """i -> clamped q-block index for kv-block j (the dkv kernel's mirror of
+    `_kv_clamp`)."""
+    if not causal and sliding_window is None:
+        return lambda j, i: i
+
+    def clamp(j, i):
+        lo, hi = 0, num_q_blocks - 1  # unset bounds stay array-wide
+        if causal:
+            # visit needs q_hi >= k_lo: i >= ceil((j*bk - off - bq + 1)/bq)
+            lo = -((q_offset + block_q - 1 - j * block_k) // block_q)
+        if sliding_window is not None:
+            # visit needs q_lo - k_hi < w: i <= (k_hi + w - 1 - off) // bq
+            hi = (
+                j * block_k + block_k - 2 + sliding_window - q_offset
+            ) // block_q
+        return jnp.clip(jnp.clip(i, lo, hi), 0, num_q_blocks - 1)
+
+    return clamp
+
+
 def _check_block_divisibility(sq: int, skv: int, block_q: int, block_k: int) -> None:
     # the kernels floor the grid; a non-dividing block would silently drop
     # trailing rows/columns (callers pad — the public wrapper and ring both do)
@@ -501,13 +562,14 @@ def flash_fwd_flat(
         has_sinks=sinks is not None,
     )
     kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
+    kv_c = _kv_clamp(block_q, block_k, q_offset, causal, sliding_window, nk)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, kv_c(i, j))),
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
     ]
     inputs = [seg_q[:, None], seg_kv[:, None], q, k, v]
     if sinks is not None:
@@ -589,16 +651,18 @@ def flash_bwd_flat(
     )
     kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
     q_bh = _q_bh_map(num_q_heads, num_kv_heads)
+    kv_c = _kv_clamp(block_q, block_k, q_offset, causal, sliding_window, nk)
+    q_c = _q_clamp(block_q, block_k, q_offset, causal, sliding_window, nq)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **hyper),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, j)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, kv_c(i, j))),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -619,17 +683,18 @@ def flash_bwd_flat(
         grid=(bh_kv, nk, group, nq),
         in_specs=[
             pl.BlockSpec(
-                (1, 1, block_q), lambda b, j, g, i: (b // num_kv_heads, 0, i)
+                (1, 1, block_q),
+                lambda b, j, g, i: (b // num_kv_heads, 0, q_c(j, i)),
             ),
             pl.BlockSpec(
                 (1, 1, block_k), lambda b, j, g, i: (b // num_kv_heads, 0, j)
             ),
-            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), q_c(j, i), 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), q_c(j, i), 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, q_c(j, i))),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, q_c(j, i))),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),

@@ -66,7 +66,7 @@ def dispatch_ring_attention(
     from jax.sharding import PartitionSpec as P
 
     from llm_training_tpu.parallel.mesh import (
-        DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, active_mesh,
+        DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, TENSOR_AXIS, active_mesh,
     )
 
     mesh = active_mesh()
@@ -75,9 +75,18 @@ def dispatch_ring_attention(
     if segment_ids is None:
         segment_ids = jnp.ones(q.shape[:2], jnp.int32)
     # degrade to replication on axes the shapes can't fill — the init trace
-    # runs with batch 1, and tiny-head configs may not divide the tensor axis
-    dp_ways = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
-    batch_axes = (DATA_AXIS, FSDP_AXIS) if q.shape[0] % dp_ways == 0 else None
+    # runs with batch 1, and tiny-head configs may not divide the tensor
+    # axis. The expert axis joins the batch factors (the batch sharding rule
+    # treats EP groups as extra data parallelism), else EP+ring runs would
+    # all-gather and redundantly recompute attention across EP ranks.
+    dp_ways = (
+        mesh.shape[DATA_AXIS]
+        * mesh.shape[FSDP_AXIS]
+        * mesh.shape.get(EXPERT_AXIS, 1)
+    )
+    batch_axes = (
+        (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS) if q.shape[0] % dp_ways == 0 else None
+    )
     tp = mesh.shape[TENSOR_AXIS]
     head_axis = (
         TENSOR_AXIS if q.shape[2] % tp == 0 and k.shape[2] % tp == 0 else None
