@@ -59,8 +59,13 @@ def main() -> None:
         # at seq 8192 — few layers so params + fp32 Adam masters fit 16G HBM.
         # This measures the matmul/attention mix the 8B runs, per layer;
         # depth only amortizes the (already-small) embed/CE ends. r4 sweep:
-        # L2/B2 0.654-0.671 > L2/B4 0.632 > L3/B1 0.509 (L3/B2 OOMs); the
-        # h4096 shapes beat the 697M proxy (0.567) — bigger MXU tiles win.
+        # SELECTIVE remat (save flash_out+lse — attention never recomputes)
+        # at batch 3 wins: 0.716-0.721 > B3/full 0.69-0.70 > B4/selective
+        # 0.681 > B2/selective 0.674 ≈ B2/full 0.654-0.673 > B4/full 0.632
+        # > L3/B1 0.509 (B6/selective and L3/B2 OOM; batch response is
+        # non-monotone — XLA scheduling). The h4096 shapes beat the 697M
+        # proxy (0.567): bigger MXU tiles win, and selective remat breaks
+        # the ~0.75 full-remat convention ceiling.
         model_kwargs = dict(
             vocab_size=32000,
             hidden_size=4096,
@@ -71,9 +76,9 @@ def main() -> None:
             head_dim=128,
             max_position_embeddings=8192,
             enable_gradient_checkpointing=True,
-            recompute_granularity="full",
+            recompute_granularity="selective",
         )
-        default_seq, default_batch = 8192, 2
+        default_seq, default_batch = 8192, 3
     elif bench_model == "697m":
         # ~700M-param Llama (largest that fits 16G HBM with fp32 Adam masters):
         # hidden 2048 pushes arithmetic intensity toward the 8B north star —

@@ -84,9 +84,13 @@ def dispatch_ring_attention(
         * mesh.shape[FSDP_AXIS]
         * mesh.shape.get(EXPERT_AXIS, 1)
     )
-    batch_axes = (
-        (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS) if q.shape[0] % dp_ways == 0 else None
-    )
+    if q.shape[0] % dp_ways == 0:
+        batch_axes = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS)
+    elif q.shape[0] % (mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]) == 0:
+        # degrade only the expert factor, keeping data/fsdp sharding
+        batch_axes = (DATA_AXIS, FSDP_AXIS)
+    else:
+        batch_axes = None
     tp = mesh.shape[TENSOR_AXIS]
     head_axis = (
         TENSOR_AXIS if q.shape[2] % tp == 0 and k.shape[2] % tp == 0 else None
