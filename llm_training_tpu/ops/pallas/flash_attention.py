@@ -133,6 +133,38 @@ def _q_clamp(
     return clamp
 
 
+def _segment_block_bounds(
+    seg_q: jnp.ndarray, seg_kv: jnp.ndarray, block_q: int, block_k: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, nq] int32 (lo, hi): the kv-block index range whose segment ids can
+    intersect each q block. Feeds the kernels as SCALAR-PREFETCH operands so
+    the BlockSpec index maps can clamp segment-skipped tiles onto an
+    already-resident kv block — extending the DMA elision from
+    position-skipped tiles to runtime packing. [min, max] of the
+    intersecting set is a superset for ANY id pattern (conservative: a
+    wrongly-included tile only streams, never mis-computes; the in-kernel
+    masks stay authoritative). Blocks that are all padding (id 0) are
+    treated as intersecting nothing."""
+    batch = seg_q.shape[0]
+    big = jnp.int32(2**30)
+    qb = seg_q.reshape(batch, -1, block_q)
+    kb = seg_kv.reshape(batch, -1, block_k)
+    qmin = jnp.where(qb == 0, big, qb).min(-1)
+    qmax = qb.max(-1)
+    kmin = jnp.where(kb == 0, big, kb).min(-1)
+    kmax = jnp.where(kb.max(-1) == 0, -1, kb.max(-1))
+    nk = kb.shape[1]
+    inter = (
+        (qmin[..., None] <= kmax[:, None, :])
+        & (kmin[:, None, :] <= qmax[..., None])
+        & (qmax[..., None] > 0)
+    )  # [B, nq, nk]
+    any_j = inter.any(-1)
+    lo = jnp.where(any_j, jnp.argmax(inter, axis=-1), 0)
+    hi = jnp.where(any_j, nk - 1 - jnp.argmax(inter[..., ::-1], axis=-1), 0)
+    return lo.astype(jnp.int32), hi.astype(jnp.int32)
+
+
 def _check_block_divisibility(sq: int, skv: int, block_q: int, block_k: int) -> None:
     # the kernels floor the grid; a non-dividing block would silently drop
     # trailing rows/columns (callers pad — the public wrapper and ring both do)
@@ -275,6 +307,8 @@ def _scores(q, k, scale: float, logits_soft_cap: float | None):
 
 
 def _fwd_kernel(
+    seg_lo_ref,  # scalar-prefetch [B, nq]; consumed by the index maps only
+    seg_hi_ref,
     q_seg_ref,
     kv_seg_ref,
     q_ref,
@@ -371,6 +405,8 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
+    seg_lo_ref,  # scalar-prefetch [B, nq]; consumed by the index maps only
+    seg_hi_ref,
     q_seg_ref,
     kv_seg_ref,
     q_ref,
@@ -444,6 +480,8 @@ def _dq_kernel(
 
 
 def _dkv_kernel(
+    seg_lo_ref,  # scalar-prefetch [B, nk] (q-block bounds per KV block)
+    seg_hi_ref,
     q_seg_ref,
     kv_seg_ref,
     q_ref,
@@ -563,20 +601,37 @@ def flash_fwd_flat(
     )
     kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
     kv_c = _kv_clamp(block_q, block_k, q_offset, causal, sliding_window, nk)
+    seg_lo, seg_hi = _segment_block_bounds(seg_q, seg_kv, block_q, block_k)
+
+    def kv_idx(b, i, j, lo, hi):
+        # static position clamp, then the runtime segment clamp — visited
+        # tiles are inside both ranges, so their index stays the identity
+        jj = kv_c(i, j)
+        batch_i = b // num_q_heads
+        return jnp.clip(jj, lo[batch_i, i], jnp.maximum(hi[batch_i, i], lo[batch_i, i]))
 
     in_specs = [
-        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-        pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, kv_c(i, j))),
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j, lo, hi: (b // num_q_heads, 0, i)),
+        pl.BlockSpec(
+            (1, 1, block_k),
+            lambda b, i, j, lo, hi: (b // num_q_heads, 0, kv_idx(b, i, j, lo, hi)),
+        ),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j, lo, hi: (b, i, 0)),
+        pl.BlockSpec(
+            (1, block_k, d),
+            lambda b, i, j, lo, hi: (kv_bh(b), kv_idx(b, i, j, lo, hi), 0),
+        ),
+        pl.BlockSpec(
+            (1, block_k, d),
+            lambda b, i, j, lo, hi: (kv_bh(b), kv_idx(b, i, j, lo, hi), 0),
+        ),
     ]
     inputs = [seg_q[:, None], seg_kv[:, None], q, k, v]
     if sinks is not None:
         # one lane-width row per head; the index map picks this program's
         # head so the kernel reads a STATIC [0, 0, 0] scalar
         in_specs.append(
-            pl.BlockSpec((1, 1, _LANES), lambda b, i, j: (b % num_q_heads, 0, 0))
+            pl.BlockSpec((1, 1, _LANES), lambda b, i, j, lo, hi: (b % num_q_heads, 0, 0))
         )
         inputs.append(jnp.broadcast_to(
             sinks.astype(jnp.float32)[:, None, None], (num_q_heads, 1, _LANES)
@@ -584,26 +639,29 @@ def flash_fwd_flat(
 
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, **hyper),
-        grid=(bh, nq, nk),
-        in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nq, nk),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, lo, hi: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j, lo, hi: (b, 0, i)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, _LANES), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(*inputs)
+    )(seg_lo, seg_hi, *inputs)
     # remat tags: under `recompute_granularity='selective'` the model policy
     # saves exactly these two (save_only_these_names), so the backward pass
     # reads O/LSE instead of re-running this kernel — attention is the one
