@@ -165,6 +165,23 @@ def _segment_block_bounds(
     return lo.astype(jnp.int32), hi.astype(jnp.int32)
 
 
+def _bounded_idx(pos_clamp, heads_divisor: int):
+    """Shared BlockSpec index clamp: static position clamp (`pos_clamp`),
+    then the runtime segment clamp from the prefetched [B, n] bounds — tiles
+    the kernel will visit are inside both ranges, so their index stays the
+    identity; skipped tiles repeat an already-resident block and Pallas
+    elides the DMA. `max(hi, lo)` guards the empty-range rows (their compute
+    is skipped regardless). Args at call time: (b, a, x, lo, hi) where `a`
+    indexes the bounds row and `x` is the streamed-axis grid index."""
+
+    def idx(b, a, x, lo, hi):
+        xx = pos_clamp(a, x)
+        batch_i = b // heads_divisor
+        return jnp.clip(xx, lo[batch_i, a], jnp.maximum(hi[batch_i, a], lo[batch_i, a]))
+
+    return idx
+
+
 def _check_block_divisibility(sq: int, skv: int, block_q: int, block_k: int) -> None:
     # the kernels floor the grid; a non-dividing block would silently drop
     # trailing rows/columns (callers pad — the public wrapper and ring both do)
@@ -307,7 +324,7 @@ def _scores(q, k, scale: float, logits_soft_cap: float | None):
 
 
 def _fwd_kernel(
-    seg_lo_ref,  # scalar-prefetch [B, nq]; consumed by the index maps only
+    seg_lo_ref,  # scalar-prefetch [B, nq]: kv-block bounds per q block
     seg_hi_ref,
     q_seg_ref,
     kv_seg_ref,
@@ -322,6 +339,7 @@ def _fwd_kernel(
     q_offset: int,
     block_q: int,
     block_k: int,
+    num_q_heads: int,
     has_sinks: bool = False,
 ):
     if has_sinks:
@@ -387,9 +405,19 @@ def _fwd_kernel(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32
         )
 
-    visit = _should_visit(
-        i, j, block_q, block_k, q_offset, causal, sliding_window
-    ) & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    # the kv BlockSpec index map redirects segment-skipped tiles onto an
+    # already-resident kv block (DMA elision), so the STREAMED seg block may
+    # not be block j's. The skip decision must therefore come from the
+    # ORIGINAL grid index: j inside the prefetched bounds ⇔ no redirection
+    # happened ⇔ the streamed data is block j's and _seg_overlap/_seg_uniform
+    # are evaluated on the right ids.
+    batch_i = pl.program_id(0) // num_q_heads
+    in_bounds = (j >= seg_lo_ref[batch_i, i]) & (j <= seg_hi_ref[batch_i, i])
+    visit = (
+        _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+        & in_bounds
+        & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    )
     interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
     uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     _masked_dispatch(visit, interior, uniform, _visit)
@@ -405,7 +433,7 @@ def _fwd_kernel(
 
 
 def _dq_kernel(
-    seg_lo_ref,  # scalar-prefetch [B, nq]; consumed by the index maps only
+    seg_lo_ref,  # scalar-prefetch [B, nq]: kv-block bounds per q block
     seg_hi_ref,
     q_seg_ref,
     kv_seg_ref,
@@ -425,6 +453,7 @@ def _dq_kernel(
     q_offset: int,
     block_q: int,
     block_k: int,
+    num_q_heads: int,
 ):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -467,9 +496,15 @@ def _dq_kernel(
             ds.astype(k.dtype), k, preferred_element_type=jnp.float32
         )
 
-    visit = _should_visit(
-        i, j, block_q, block_k, q_offset, causal, sliding_window
-    ) & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    # see _fwd_kernel: skip decisions must come from the ORIGINAL grid index,
+    # not from the streamed (possibly redirected) seg block
+    batch_i = pl.program_id(0) // num_q_heads
+    in_bounds = (j >= seg_lo_ref[batch_i, i]) & (j <= seg_hi_ref[batch_i, i])
+    visit = (
+        _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+        & in_bounds
+        & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    )
     interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
     uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     _masked_dispatch(visit, interior, uniform, _visit)
@@ -502,6 +537,7 @@ def _dkv_kernel(
     q_offset: int,
     block_q: int,
     block_k: int,
+    num_kv_heads: int,
 ):
     j = pl.program_id(1)
     g = pl.program_id(2)
@@ -550,9 +586,16 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
 
-    visit = _should_visit(
-        i, j, block_q, block_k, q_offset, causal, sliding_window
-    ) & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    # see _fwd_kernel: skip decisions must come from the ORIGINAL grid index,
+    # not from the streamed (possibly redirected) seg block. Here the bounds
+    # are q-block ranges per kv block, so the gate runs on i.
+    batch_i = pl.program_id(0) // num_kv_heads
+    in_bounds = (i >= seg_lo_ref[batch_i, j]) & (i <= seg_hi_ref[batch_i, j])
+    visit = (
+        _should_visit(i, j, block_q, block_k, q_offset, causal, sliding_window)
+        & in_bounds
+        & _seg_overlap(q_seg_ref[0, 0], kv_seg_ref[0, 0])
+    )
     interior = _pos_interior(i, j, block_q, block_k, q_offset, causal, sliding_window)
     uniform = _seg_uniform(q_seg_ref[0, 0], kv_seg_ref[0, 0])
     _masked_dispatch(visit, interior, uniform, _visit)
@@ -596,19 +639,14 @@ def flash_fwd_flat(
     hyper = dict(
         scale=scale, causal=causal, sliding_window=sliding_window,
         logits_soft_cap=logits_soft_cap, q_offset=q_offset,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, num_q_heads=num_q_heads,
         has_sinks=sinks is not None,
     )
     kv_bh = _kv_bh_map(num_q_heads, num_kv_heads)
     kv_c = _kv_clamp(block_q, block_k, q_offset, causal, sliding_window, nk)
     seg_lo, seg_hi = _segment_block_bounds(seg_q, seg_kv, block_q, block_k)
 
-    def kv_idx(b, i, j, lo, hi):
-        # static position clamp, then the runtime segment clamp — visited
-        # tiles are inside both ranges, so their index stays the identity
-        jj = kv_c(i, j)
-        batch_i = b // num_q_heads
-        return jnp.clip(jj, lo[batch_i, i], jnp.maximum(hi[batch_i, i], lo[batch_i, i]))
+    kv_idx = _bounded_idx(kv_c, num_q_heads)
 
     in_specs = [
         pl.BlockSpec((1, 1, block_q), lambda b, i, j, lo, hi: (b // num_q_heads, 0, i)),
@@ -711,66 +749,101 @@ def flash_bwd_flat(
     q_bh = _q_bh_map(num_q_heads, num_kv_heads)
     kv_c = _kv_clamp(block_q, block_k, q_offset, causal, sliding_window, nk)
     q_c = _q_clamp(block_q, block_k, q_offset, causal, sliding_window, nq)
+    # kv-block bounds per q block (dq) and q-block bounds per kv block (dkv):
+    # the same runtime DMA elision the forward does, mirrored for the dkv
+    # kernel's transposed grid
+    seg_lo, seg_hi = _segment_block_bounds(seg_q, seg_kv, block_q, block_k)
+    qblk_lo, qblk_hi = _segment_block_bounds(seg_kv, seg_q, block_k, block_q)
+
+    kv_idx = _bounded_idx(kv_c, num_q_heads)
+    q_idx = _bounded_idx(q_c, num_kv_heads)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **hyper),
-        grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // num_q_heads, 0, i)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // num_q_heads, 0, kv_c(i, j))),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv_bh(b), kv_c(i, j), 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        functools.partial(_dq_kernel, num_q_heads=num_q_heads, **hyper),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j, lo, hi: (b // num_q_heads, 0, i)),
+                pl.BlockSpec(
+                    (1, 1, block_k),
+                    lambda b, i, j, lo, hi: (b // num_q_heads, 0, kv_idx(b, i, j, lo, hi)),
+                ),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, lo, hi: (b, i, 0)),
+                pl.BlockSpec(
+                    (1, block_k, d),
+                    lambda b, i, j, lo, hi: (kv_bh(b), kv_idx(b, i, j, lo, hi), 0),
+                ),
+                pl.BlockSpec(
+                    (1, block_k, d),
+                    lambda b, i, j, lo, hi: (kv_bh(b), kv_idx(b, i, j, lo, hi), 0),
+                ),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j, lo, hi: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j, lo, hi: (b, 0, i)),
+                pl.BlockSpec((1, 1, block_q), lambda b, i, j, lo, hi: (b, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j, lo, hi: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
+    )(seg_lo, seg_hi, seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
 
     # q-side refs are indexed by (kv batch-head, group member): the GQA
     # reduction over the q heads sharing one kv head happens in scratch
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **hyper),
-        grid=(bh_kv, nk, group, nq),
-        in_specs=[
-            pl.BlockSpec(
-                (1, 1, block_q),
-                lambda b, j, g, i: (b // num_kv_heads, 0, q_c(j, i)),
-            ),
-            pl.BlockSpec(
-                (1, 1, block_k), lambda b, j, g, i: (b // num_kv_heads, 0, j)
-            ),
-            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), q_c(j, i), 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, g, i: (q_bh(b, g), q_c(j, i), 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, q_c(j, i))),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, g, i: (q_bh(b, g), 0, q_c(j, i))),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, g, i: (b, j, 0)),
-        ],
+        functools.partial(_dkv_kernel, num_kv_heads=num_kv_heads, **hyper),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh_kv, nk, group, nq),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, block_q),
+                    lambda b, j, g, i, lo, hi: (b // num_kv_heads, 0, q_idx(b, j, i, lo, hi)),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_k), lambda b, j, g, i, lo, hi: (b // num_kv_heads, 0, j)
+                ),
+                pl.BlockSpec(
+                    (1, block_q, d),
+                    lambda b, j, g, i, lo, hi: (q_bh(b, g), q_idx(b, j, i, lo, hi), 0),
+                ),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i, lo, hi: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i, lo, hi: (b, j, 0)),
+                pl.BlockSpec(
+                    (1, block_q, d),
+                    lambda b, j, g, i, lo, hi: (q_bh(b, g), q_idx(b, j, i, lo, hi), 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_q),
+                    lambda b, j, g, i, lo, hi: (q_bh(b, g), 0, q_idx(b, j, i, lo, hi)),
+                ),
+                pl.BlockSpec(
+                    (1, 1, block_q),
+                    lambda b, j, g, i, lo, hi: (q_bh(b, g), 0, q_idx(b, j, i, lo, hi)),
+                ),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i, lo, hi: (b, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, g, i, lo, hi: (b, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
-    )(seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
+    )(qblk_lo, qblk_hi, seg_q[:, None], seg_kv[:, None], q, k, v, do, lse[:, None], delta[:, None])
     return dq, dk, dv
 
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Commit gate: the not-slow test tier plus a bench trace/compile check.
+# Run before EVERY commit — round 4 shipped a broken HEAD because a
+# mid-edit tree was committed without this (VERDICT r4, weak #2).
+#
+# Usage: scripts/precommit.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== precommit: not-slow test tier =="
+python -m pytest tests/ -x -q -m "not slow" "$@"
+
+# note: under axon the sitecustomize registers the TPU backend at interpreter
+# start, so JAX_PLATFORMS=cpu does NOT demote this to a CPU smoke — when a
+# chip is attached this runs the REAL default bench (and must print rc=0 with
+# a sane MFU); on CPU-only machines it runs the tiny smoke config.
+echo "== precommit: bench smoke (default bench path must run rc=0) =="
+JAX_PLATFORMS=cpu python bench.py
+
+echo "== precommit: OK =="

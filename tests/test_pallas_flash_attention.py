@@ -73,6 +73,45 @@ def test_forward_matches_xla(name, hq, hkv, window, cap, packed):
     np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
 
 
+def test_packed_block_aligned_docs():
+    """Document boundaries aligned to kv blocks: the DMA-elision index maps
+    redirect segment-skipped tiles onto already-resident kv blocks, so the
+    kernel's skip decision must come from the grid index, not the streamed
+    segment ids. Two 256-token docs at block 128 put kv blocks wholly inside
+    an earlier document — the exact layout the random cuts in
+    `_packed_segments` never produce (r4 advisor repro: max abs error 2.5)."""
+    rng = np.random.default_rng(7)
+    batch, seq, h, d = 2, 512, 2, 32
+    q, k, v = _make_qkv(rng, batch, seq, seq, h, h, d)
+    seg = jnp.asarray(np.tile(np.repeat([1, 2], 256)[None], (batch, 1)), jnp.int32)
+    expected = dot_product_attention(q, k, v, segment_ids=seg, causal=True, impl="xla")
+    got = flash_attention(q, k, v, segment_ids=seg, causal=True, block_q=128, block_k=128)
+    np.testing.assert_allclose(got, expected, rtol=2e-3, atol=2e-3)
+
+
+def test_gradients_smoke_packed_aligned():
+    """Fast (non-slow) gradient check so the default suite always traces the
+    backward kernels — the r4 regression shipped because every gradient test
+    was slow-marked. Block-aligned packing exercises the dq/dkv segment-skip
+    gates too."""
+    rng = np.random.default_rng(8)
+    batch, seq, h, d = 1, 256, 2, 32
+    q, k, v = _make_qkv(rng, batch, seq, seq, h, h, d)
+    seg = jnp.asarray(np.repeat([1, 2], 128)[None], jnp.int32)
+    cot = jnp.asarray(_rand(rng, (batch, seq, h, d)))
+
+    gx = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, segment_ids=seg, impl="xla") * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gp = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, segment_ids=seg, block_q=128, block_k=128) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, name in zip(gx, gp, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=2e-3, atol=2e-3, err_msg=f"d{name}")
+
+
 @pytest.mark.slow
 def test_gradients_match_xla():
     rng = np.random.default_rng(0)
