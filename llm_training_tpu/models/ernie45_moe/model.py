@@ -162,7 +162,9 @@ class Ernie45MoeBlock(nn.Module):
             out = jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
             return out + bd[expert_order] if cfg.use_bias else out
 
-        out = dropless_moe_apply(
+        # dropped-row count discarded (no stats channel through this
+        # family's layers — see the note in deepseek/model.py)
+        out, _ = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(

@@ -173,7 +173,7 @@ class GptOssMoE(nn.Module):
 
         from llm_training_tpu.models.moe import dropless_moe_apply
 
-        out = dropless_moe_apply(
+        out, dropped = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate_up, b_gate_up, w_down, b_down),
@@ -197,7 +197,10 @@ class GptOssMoE(nn.Module):
         mean_prob = (
             jax.nn.softmax(logits, axis=-1) * valid[:, None]
         ).sum(axis=0) / n_valid
-        return out.reshape(batch, seq, embed).astype(hidden.dtype), (sel_frac, mean_prob)
+        return (
+            out.reshape(batch, seq, embed).astype(hidden.dtype),
+            (sel_frac, mean_prob, dropped),
+        )
 
 
 class GptOssDecoderLayer(nn.Module):
@@ -292,7 +295,9 @@ class GptOss(nn.Module):
                 length=cfg.num_hidden_layers // period,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
-            hidden, (sel_frac, mean_prob) = scanned(hidden, segment_ids, cos, sin)
+            hidden, (sel_frac, mean_prob, dropped) = scanned(
+                hidden, segment_ids, cos, sin
+            )
             # [cycles, period, E] -> [L, E]; depth order is irrelevant to the
             # mean-pooled aux loss below
             sel_frac = sel_frac.reshape(-1, sel_frac.shape[-1])
@@ -307,7 +312,9 @@ class GptOss(nn.Module):
                     cfg, cfg.layer_sliding_window(i), name=f"layers_{i}"
                 )(hidden, segment_ids, cos, sin)
                 stats.append(layer_stats)
-            sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+            sel_frac, mean_prob, dropped = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *stats
+            )
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
@@ -324,6 +331,7 @@ class GptOss(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             aux_loss=aux_loss,
+            ep_dropped_rows=dropped.sum(),
         )
 
     def get_input_embeddings_path(self) -> str:

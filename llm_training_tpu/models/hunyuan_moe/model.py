@@ -113,7 +113,9 @@ class HunYuanMoeBlock(nn.Module):
             up = jax.lax.ragged_dot(xs, wu, group_sizes)
             return jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
 
-        out = dropless_moe_apply(
+        # dropped-row count discarded (no stats channel through this
+        # family's layers — see the note in deepseek/model.py)
+        out, _ = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate, w_up, w_down),

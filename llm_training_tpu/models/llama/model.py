@@ -317,8 +317,9 @@ class LlamaDecoderLayer(nn.Module):
 
         def mlp(x):
             """(out, aux): MoE block returns per-layer router stats
-            (sel_frac, mean_prob) [E]; dense SwiGLU a zero scalar (the ys
-            type is uniform across layers within one model)."""
+            (sel_frac [E], mean_prob [E], dropped scalar); dense SwiGLU a
+            zero scalar (the ys type is uniform across layers within one
+            model — a config is either all-MoE or all-dense)."""
             if cfg.num_experts:
                 from llm_training_tpu.models.moe import MoEMLP
 
@@ -390,11 +391,12 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     def _layers(self, hidden, segment_ids, cos, sin, local_cos=None, local_sin=None):
-        """Returns (hidden, aux_loss). For MoE configs the per-layer router
-        stats (sel_frac, mean_prob) are pooled across depth BEFORE the
-        E * sum(f * P) product — matching HF `load_balancing_loss_func`,
-        which concatenates all layers' gate logits first, so the loss stays
-        ~top_k when balanced regardless of num_hidden_layers."""
+        """Returns (hidden, aux_loss, ep_dropped_rows). For MoE configs the
+        per-layer router stats (sel_frac, mean_prob, dropped) are pooled
+        across depth BEFORE the E * sum(f * P) product — matching HF
+        `load_balancing_loss_func`, which concatenates all layers' gate
+        logits first, so the loss stays ~top_k when balanced regardless of
+        num_hidden_layers."""
         cfg = self.config
         policy = _remat_policy(cfg)
         if cfg.scan_layers:
@@ -442,12 +444,12 @@ class Llama(nn.Module):
                 stats.append(layer_aux)
             aux = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
         if not cfg.num_experts:
-            return hidden, jnp.float32(0.0)
-        sel_frac, mean_prob = aux  # each [L, E]
+            return hidden, jnp.float32(0.0), jnp.float32(0.0)
+        sel_frac, mean_prob, dropped = aux  # [L, E], [L, E], [L]
         aux_loss = cfg.num_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )
-        return hidden, aux_loss
+        return hidden, aux_loss, dropped.sum()
 
     @nn.compact
     def __call__(
@@ -533,7 +535,7 @@ class Llama(nn.Module):
                 half = local_cos.shape[-1] // 2
                 local_cos = jnp.repeat(local_cos[..., :half], 2, axis=-1)
                 local_sin = jnp.repeat(local_sin[..., :half], 2, axis=-1)
-        hidden, aux_loss = self._layers(
+        hidden, aux_loss, ep_dropped = self._layers(
             hidden, segment_ids, cos, sin, local_cos, local_sin
         )
         hidden = _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
@@ -568,6 +570,7 @@ class Llama(nn.Module):
             # unscaled load-balancing loss; the objective applies
             # router_aux_loss_coef (None for dense models)
             aux_loss=aux_loss if cfg.num_experts else None,
+            ep_dropped_rows=ep_dropped if cfg.num_experts else None,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -265,7 +265,7 @@ class MiniMax(nn.Module):
                 length=cfg.num_hidden_layers // period,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
-            hidden, (sel_frac, mean_prob) = scanned(
+            hidden, (sel_frac, mean_prob, dropped) = scanned(
                 hidden, segment_ids, cos, sin,
                 all_slopes.reshape(-1, period, heads),
             )
@@ -283,7 +283,9 @@ class MiniMax(nn.Module):
                     cfg, cfg.layer_is_linear(i), name=f"layers_{i}"
                 )(hidden, segment_ids, cos, sin, all_slopes[i])
                 stats.append(layer_stats)
-            sel_frac, mean_prob = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+            sel_frac, mean_prob, dropped = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *stats
+            )
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
@@ -303,6 +305,7 @@ class MiniMax(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             aux_loss=aux_loss,
+            ep_dropped_rows=dropped.sum(),
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -127,17 +127,26 @@ def _ep_ragged_apply(
         valid = jnp.arange(capacity) < total  # local rows sort first
         ys = ys * (flat_w[sel] * valid).astype(ys.dtype)[:, None]
         out_all = jnp.zeros((t_all, hidden), ys.dtype).at[sel_tok].add(ys)
-        return lax.psum_scatter(out_all, EXPERT_AXIS, scatter_dimension=0, tiled=True)
+        # (token, expert) rows routed to this rank's experts that did not
+        # fit the capacity buffer — the silent quality hazard of static
+        # capacity; summed over the EP group and surfaced as a train metric
+        dropped = lax.psum(
+            (counts.sum() - total).astype(jnp.float32), EXPERT_AXIS
+        )
+        return (
+            lax.psum_scatter(out_all, EXPERT_AXIS, scatter_dimension=0, tiled=True),
+            dropped,
+        )
 
-    out = jax.shard_map(
+    out, dropped = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P()) + tuple(P(EXPERT_AXIS) for _ in w_leaves),
-        out_specs=P(EXPERT_AXIS),
+        out_specs=(P(EXPERT_AXIS), P()),
         axis_names={EXPERT_AXIS},
         check_vma=False,
     )(x, topk_idx, topk_weights, *w_leaves)
-    return out.astype(out_dtype)
+    return out.astype(out_dtype), dropped
 
 
 def dropless_moe_apply(
@@ -150,7 +159,7 @@ def dropless_moe_apply(
     ragged_fn,
     weights=None,
     ep_capacity_factor: float = 2.0,
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shared dropless dispatch/combine for every MoE family.
 
     x: [T, H] compute-dtype tokens; topk_idx/topk_weights: [T, K].
@@ -161,8 +170,13 @@ def dropless_moe_apply(
     lookups). `weights` is the pytree of stacked expert parameters (leading
     dim E) that ragged_fn consumes — passed explicitly so the
     expert-parallel path can hand each rank its local slice.
+
+    Returns (out [T, H], dropped_rows fp32 scalar): dropped_rows counts
+    (token, slot) assignments lost to the expert-parallel capacity buffer
+    this call — exactly 0 on the truly-dropless dense/single-rank paths.
     """
     n_tokens, top_k = topk_idx.shape
+    no_drops = jnp.float32(0.0)
     if impl == "auto":
         impl = "ragged" if jax.default_backend() == "tpu" else "dense"
     if impl == "dense":
@@ -171,7 +185,7 @@ def dropless_moe_apply(
         combine = combine.at[
             jnp.arange(n_tokens)[:, None], topk_idx
         ].set(topk_weights)
-        return jnp.einsum("teh,te->th", y, combine)
+        return jnp.einsum("teh,te->th", y, combine), no_drops
     ep = _ep_group_size()
     if ep > 1:
         if num_experts % ep:
@@ -191,14 +205,16 @@ def dropless_moe_apply(
     group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
     ys = ragged_fn(x[token_order], group_sizes, flat_expert[order], weights)
     ys = ys * flat_weight[order][:, None]
-    return jnp.zeros((n_tokens, x.shape[-1]), x.dtype).at[token_order].add(ys)
+    out = jnp.zeros((n_tokens, x.shape[-1]), x.dtype).at[token_order].add(ys)
+    return out, no_drops
 
 
 class MoEMLP(nn.Module):
     """Sparse MoE block with the (config-driven) surface of LlamaMLP.
 
     __call__(hidden [B, S, H], pad_mask [B, S] bool | None) ->
-    (out [B, S, H], (sel_frac [E], mean_prob [E]) fp32 router stats).
+    (out [B, S, H], (sel_frac [E], mean_prob [E], dropped scalar) fp32
+    router stats — `dropped` counts EP capacity-buffer losses, 0 off-EP).
     The caller pools the per-layer stats across depth and applies the
     Switch/Mixtral formula E * sum(f * P) — pooling BEFORE the product is
     what HF's `load_balancing_loss_func` does (it concatenates every
@@ -275,7 +291,7 @@ class MoEMLP(nn.Module):
             up = jax.lax.ragged_dot(xs, wu, group_sizes)
             return jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
 
-        out = dropless_moe_apply(
+        out, dropped = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_probs, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate, w_up, w_down),
@@ -321,5 +337,5 @@ class MoEMLP(nn.Module):
 
         return (
             out.reshape(batch, seq, embed).astype(hidden.dtype),
-            (sel_frac, mean_prob),
+            (sel_frac, mean_prob, dropped),
         )

@@ -485,12 +485,13 @@ class Qwen3Next(nn.Module):
         )(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
-        aux_loss = None
+        aux_loss = ep_dropped = None
         if cfg.num_experts:
-            sel_frac, mean_prob = pooled
+            sel_frac, mean_prob, dropped = pooled
             aux_loss = cfg.num_experts * jnp.sum(
                 sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
             )
+            ep_dropped = dropped.sum()
 
         logits = None
         if compute_logits:
@@ -504,6 +505,7 @@ class Qwen3Next(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             aux_loss=aux_loss,
+            ep_dropped_rows=ep_dropped,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -80,6 +80,58 @@ def test_ep_grads_match_dense(ep_mesh):
         )
 
 
+def test_ep_dropped_rows_match_capacity_math(ep_mesh):
+    """Adversarial routing (every token to ONE expert) must report exactly
+    the rows the static capacity buffer cannot hold — the silent-drop hazard
+    VERDICT r4 flagged, now surfaced as a counter."""
+    from llm_training_tpu.models.moe import dropless_moe_apply
+
+    T, H, E, K = 32, 8, 4, 2
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.float32)
+    topk_idx = jnp.zeros((T, K), jnp.int32)  # all T*K rows -> expert 0
+    topk_w = jnp.full((T, K), 0.5, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, H, H)) * 0.1, jnp.float32)
+
+    def dense_fn(xc):
+        return jnp.einsum("th,ehg->teg", xc, w)
+
+    def ragged_fn(xs, gs, order, wl):
+        return jax.lax.ragged_dot(xs, wl[0], gs)
+
+    def run(factor):
+        out, dropped = dropless_moe_apply(
+            x, topk_idx, topk_w, E, "ragged", dense_fn, ragged_fn,
+            weights=(w,), ep_capacity_factor=factor,
+        )
+        return out, dropped
+
+    with ep_mesh:
+        out, dropped = jax.jit(run, static_argnums=0)(0.5)
+        # ep=2: capacity = ceil(T*K/ep * 0.5) = 16 rows/rank; all 64 rows
+        # route to rank 0's expert -> 64 - 16 = 48 dropped, psum'd
+        assert int(jax.device_get(dropped)) == 48
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+        # the default factor 2.0 at ep=2 sizes the buffer to ALL T*K rows:
+        # even fully-imbalanced routing cannot drop
+        _, dropped_full = jax.jit(run, static_argnums=0)(2.0)
+        assert int(jax.device_get(dropped_full)) == 0
+
+
+def test_ep_dropped_rows_metric_flows_to_output(ep_mesh):
+    """The counter reaches CausalLMOutput (and thus CLM's train metrics)."""
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 128, (4, 16)))
+    cfg = LlamaConfig(**TINY_MOE, moe_impl="ragged")
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), ids)
+    with ep_mesh:
+        out = jax.jit(lambda p, x: model.apply(p, x))(params, ids)
+    assert out.ep_dropped_rows is not None
+    # default capacity factor 2.0 at ep=2 -> drops impossible
+    assert float(jax.device_get(out.ep_dropped_rows)) == 0.0
+
+
 def test_ep_requires_divisible_experts(ep_mesh):
     cfg = LlamaConfig(**{**TINY_MOE, "num_experts": 3, "num_experts_per_tok": 2},
                       moe_impl="ragged")
