@@ -13,7 +13,7 @@ Graph verified against HF `modeling_gpt_oss.py`:
   (up + 1) * gate * sigmoid(alpha * gate) with alpha=1.702, limit=7.0
   (HF hardcodes both). Dropless ragged_dot path for training, exact dense
   path for parity.
-- aux loss: per-layer (sel_frac, mean_prob) stats pooled across depth, the
+- aux loss: per-layer (sel_frac, mean_prob, dropped) stats pooled across depth, the
   same HF `load_balancing_loss_func` scale the other MoE families use; the
   CLM objective applies config.router_aux_loss_coef.
 """

@@ -16,6 +16,7 @@ expects.
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 from collections.abc import Mapping
@@ -23,6 +24,7 @@ from pathlib import Path
 from typing import Any, Iterator
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 logger = logging.getLogger(__name__)
@@ -113,9 +115,16 @@ def load_hf_config(path: str | Path) -> dict:
     return json.loads(config_file.read_text())
 
 
+@functools.lru_cache(maxsize=None)
+def _device_cast(dtype_name: str):
+    # one compiled cast per (dtype, shape/sharding) via the jit cache —
+    # astype preserves the operand's sharding, so no out_shardings needed
+    return jax.jit(lambda x: x.astype(jnp.dtype(dtype_name)))
+
+
 def load_pretrained_params(
     config: Any,
-    hf_path: str | Path,
+    hf_path: str | Path | Mapping,
     shardings: Any | None = None,
     dtypes: Any | None = None,
 ) -> Any:
@@ -126,9 +135,14 @@ def load_pretrained_params(
     the memory-safe analogue of the reference's broadcast distribution
     (`base_lm.py:175-193`). `dtypes` (matching pytree or single dtype) casts
     leaves on the way in (e.g. fp32 master params from a bf16 checkpoint).
+
+    `hf_path` may also be an in-memory Mapping of HF keys -> tensors
+    (tests / already-open checkpoints) instead of a directory.
     """
     conv = conversion_module(config)
-    state_dict = LazyStateDict(hf_path)
+    state_dict = (
+        hf_path if isinstance(hf_path, Mapping) else LazyStateDict(hf_path)
+    )
 
     if shardings is None and dtypes is None:
         return conv.params_from_hf(state_dict, config)
@@ -141,11 +155,19 @@ def load_pretrained_params(
     def leaf_fn(path: tuple[str, ...], value: np.ndarray):
         key = ("params",) + path
         dtype = dtypes_by_path[key] if dtypes_by_path is not None else dtypes
-        if dtype is not None:
-            value = value.astype(dtype)
         sharding = by_path.get(key) if by_path is not None else None
         if sharding is not None:
-            return jax.device_put(value, sharding)
+            # place in the STORAGE dtype, widen on device: a host-side
+            # astype would hold checkpoint + widened copies simultaneously
+            # (at 70B geometry a scanned mlp stack is ~37 GB bf16 — the
+            # fp32 master cast would transiently need ~112 GB of host RAM;
+            # on device the transient is per-chip and freed per leaf)
+            placed = jax.device_put(value, sharding)
+            if dtype is not None and placed.dtype != jnp.dtype(dtype):
+                placed = _device_cast(jnp.dtype(dtype).name)(placed)
+            return placed
+        if dtype is not None:
+            value = value.astype(dtype)
         return value
 
     # each converted leaf is placed (device_put) inside the conversion walk,
