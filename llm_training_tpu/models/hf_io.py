@@ -157,14 +157,19 @@ def load_pretrained_params(
         dtype = dtypes_by_path[key] if dtypes_by_path is not None else dtypes
         sharding = by_path.get(key) if by_path is not None else None
         if sharding is not None:
-            # place in the STORAGE dtype, widen on device: a host-side
-            # astype would hold checkpoint + widened copies simultaneously
-            # (at 70B geometry a scanned mlp stack is ~37 GB bf16 — the
-            # fp32 master cast would transiently need ~112 GB of host RAM;
-            # on device the transient is per-chip and freed per leaf)
+            target = jnp.dtype(dtype) if dtype is not None else None
+            if target is not None and target.itemsize < value.dtype.itemsize:
+                # NARROWING (e.g. fp32 checkpoint -> bf16 leaves): cast on
+                # host so the transfer ships the small copy
+                value = value.astype(target)
+            # WIDENING (bf16 checkpoint -> fp32 masters) happens on device:
+            # a host-side astype would hold checkpoint + widened copies
+            # simultaneously (at 70B geometry a scanned mlp stack is ~37 GB
+            # bf16 — the fp32 cast would transiently need ~112 GB of host
+            # RAM; on device the transient is per-chip and freed per leaf)
             placed = jax.device_put(value, sharding)
-            if dtype is not None and placed.dtype != jnp.dtype(dtype):
-                placed = _device_cast(jnp.dtype(dtype).name)(placed)
+            if target is not None and placed.dtype != target:
+                placed = _device_cast(target.name)(placed)
             return placed
         if dtype is not None:
             value = value.astype(dtype)

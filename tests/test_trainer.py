@@ -139,6 +139,123 @@ def test_frozen_modules(devices):
     assert np.abs(params["norm"]["weight"] - init["norm"]["weight"]).max() > 1e-3
 
 
+def test_blocked_offload_update_matches_whole_tree(devices):
+    """Numeric parity of the per-leaf blocked update (global clip factored
+    out + per-leaf tx.update over zipped leaves) against the whole-tree
+    chain(clip, adamw) step. Runs on CPU with device memory kinds — the
+    blocked step's MATH is memory-kind agnostic, only the pinned_host
+    placement needs the chip."""
+    import flax.linen as nn
+
+    from llm_training_tpu.optim.builder import build_optimizer
+    from llm_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from llm_training_tpu.trainer.state import TrainState
+    from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+    trainer, objective, dm = _make(max_steps=1)
+    trainer.mesh = build_mesh(MeshConfig(fsdp_size=4, tensor_parallel_size=2))
+    dm.setup()
+    batch = next(dm.train_batches(start_step=0))
+
+    tx_full, _ = build_optimizer(objective.config.optim, num_total_steps=4)
+    clip_free = objective.config.optim.model_copy(update={"grad_clip_norm": None})
+    tx_core, _ = build_optimizer(clip_free, num_total_steps=4)
+
+    with trainer.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+        params = nn.meta.unbox(
+            objective.init_params(jax.random.key(0), batch)
+        )
+        # whole-tree reference step
+        trainer._blocked_offload = False
+        state_a = TrainState.create(params, tx_full.init(params), jax.random.key(7))
+        step_a = trainer._build_step(objective, tx_full)
+        new_a, metrics_a = jax.jit(step_a)(state_a, batch)
+
+        # blocked step, device memory kinds (no offload placement)
+        trainer._blocked_offload = True
+        trainer._clip_norm = objective.config.optim.grad_clip_norm
+        opt_blocks = trainer._opt_init(tx_core, params)
+        state_b = TrainState.create(params, opt_blocks, jax.random.key(7))
+        dev_sharding = jax.sharding.NamedSharding(
+            trainer.mesh, jax.sharding.PartitionSpec()
+        )
+        opt_dev = tuple(
+            jax.tree.map(lambda _: dev_sharding, blk) for blk in opt_blocks
+        )
+        step_b = trainer._build_blocked_offload_step(
+            objective, tx_core, opt_dev, opt_dev
+        )
+        new_b, metrics_b = jax.jit(step_b)(state_b, batch)
+
+    np.testing.assert_allclose(
+        float(metrics_a["grad_norm"]), float(metrics_b["grad_norm"]), rtol=1e-6
+    )
+    flat_a = jax.tree.leaves(new_a.params)
+    flat_b = jax.tree.leaves(new_b.params)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-6, atol=2e-6
+        )
+
+
+def test_blocked_offload_state_structure(devices):
+    """Overlapped offload (VERDICT r4 #5): with the blocked path active the
+    optimizer state is one block per param leaf (independent copy/update
+    chains for transfer/compute overlap), every mu/nu maps to pinned_host
+    with the PARAM's sharding (not replicated), and counters stay on
+    device. Execution needs the real chip (no Host placement runtime on
+    CPU) — covered by `BENCH_OFFLOAD=1 python bench.py`."""
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec
+
+    from llm_training_tpu.optim.builder import build_optimizer
+    from llm_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+    trainer, objective, dm = _make(max_steps=1)
+    trainer.config = trainer.config.model_copy(
+        update={"offload_optimizer_state": True}
+    )
+    trainer.mesh = build_mesh(MeshConfig(fsdp_size=4, tensor_parallel_size=2))
+    trainer._blocked_offload = True
+    trainer._clip_norm = objective.config.optim.grad_clip_norm
+    clip_free = objective.config.optim.model_copy(update={"grad_clip_norm": None})
+    tx, _ = build_optimizer(clip_free, num_total_steps=1)
+    dm.setup()
+    batch = next(dm.train_batches(start_step=0))
+    with trainer.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+        abstract = trainer._abstract_state(objective, batch, tx)
+        shardings = trainer._state_shardings(abstract)
+
+    n_param_leaves = len(
+        jax.tree.leaves(
+            jax.tree.map(
+                lambda x: 0, abstract.params,
+                is_leaf=lambda x: hasattr(x, "value"),
+            )
+        )
+    )
+    assert isinstance(abstract.opt_state, tuple)
+    assert len(abstract.opt_state) == n_param_leaves
+    for blk_sh, blk_ab in zip(shardings.opt_state, abstract.opt_state):
+        unboxed = jax.tree.map(
+            lambda x: x.value if hasattr(x, "value") else x,
+            blk_ab, is_leaf=lambda x: hasattr(x, "value"),
+        )
+        for s, a in zip(jax.tree.leaves(blk_sh), jax.tree.leaves(unboxed)):
+            expected = "device" if a.ndim == 0 else "pinned_host"
+            assert s.memory_kind == expected, (s, a.shape)
+    host_specs = [
+        s.spec
+        for blk in shardings.opt_state
+        for s in jax.tree.leaves(blk)
+        if s.memory_kind == "pinned_host"
+    ]
+    # mu/nu inherit the param shardings — offloaded state still shards
+    assert any(spec != PartitionSpec() for spec in host_specs)
+
+
 def test_offload_shardings_map_arrays_to_host(devices):
     """VERDICT r3 #7 (metadata level): with offload_optimizer_state on, the
     optimizer-state shardings place every ARRAY leaf (mu/nu) in pinned_host
