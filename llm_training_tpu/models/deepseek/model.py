@@ -214,12 +214,7 @@ class DeepseekMoE(nn.Module):
 
         from llm_training_tpu.models.moe import dropless_moe_apply
 
-        # dropped-row count discarded: this family's layers carry no stats
-        # channel (DeepSeek computes no aux loss — the noaux bias balances
-        # instead), so EP drop monitoring is available via the MoEMLP
-        # families; threading a ys channel through the dense-prefix scan
-        # just for the counter is not worth the graph change
-        out, _ = dropless_moe_apply(
+        out, dropped = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate, w_up, w_down),
@@ -230,11 +225,14 @@ class DeepseekMoE(nn.Module):
             cfg, cfg.moe_intermediate_size * cfg.n_shared_experts,
             name="shared_experts",
         )(hidden)
-        return out + shared
+        return out + shared, dropped
 
 
 class DeepseekDecoderLayer(nn.Module):
-    """Pre-norm block (HF DeepseekV2/V3DecoderLayer)."""
+    """Pre-norm block (HF DeepseekV2/V3DecoderLayer). Returns
+    (hidden, ep_dropped_rows) — DeepSeek computes no aux loss (the noaux
+    bias balances instead), so the layer ys channel carries only the EP
+    capacity-drop counter (0 on dense layers)."""
 
     config: DeepseekConfig
     is_moe: bool
@@ -249,10 +247,11 @@ class DeepseekDecoderLayer(nn.Module):
         hidden = hidden + MLAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
         normed = norm("post_attention_layernorm")(hidden)
         if self.is_moe:
-            mlp_out = DeepseekMoE(cfg, name="mlp")(normed)
+            mlp_out, dropped = DeepseekMoE(cfg, name="mlp")(normed)
         else:
             mlp_out = DeepseekMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
-        return hidden + mlp_out
+            dropped = jnp.float32(0.0)
+        return hidden + mlp_out, dropped
 
 
 class _MoEScanBody(nn.Module):
@@ -265,10 +264,10 @@ class _MoEScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden = DeepseekDecoderLayer(self.config, True, name="layer")(
+        hidden, dropped = DeepseekDecoderLayer(self.config, True, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, None
+        return hidden, dropped
 
 
 class Deepseek(nn.Module):
@@ -317,13 +316,15 @@ class Deepseek(nn.Module):
 
         policy = _remat_policy(cfg)
         n_scanned = cfg.num_scanned_layers
+        ep_dropped = jnp.float32(0.0)
         for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = DeepseekDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(DeepseekDecoderLayer, policy=policy)
-            hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+            hidden, dropped = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
+            ep_dropped = ep_dropped + dropped
         if n_scanned:
             body = _MoEScanBody
             if policy is not None:
@@ -336,7 +337,8 @@ class Deepseek(nn.Module):
                 length=n_scanned,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="moe_layers")
-            hidden, _ = scanned(hidden, segment_ids, cos, sin)
+            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            ep_dropped = ep_dropped + dropped.sum()
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
@@ -352,6 +354,7 @@ class Deepseek(nn.Module):
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
+            ep_dropped_rows=ep_dropped,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -162,9 +162,7 @@ class Ernie45MoeBlock(nn.Module):
             out = jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
             return out + bd[expert_order] if cfg.use_bias else out
 
-        # dropped-row count discarded (no stats channel through this
-        # family's layers — see the note in deepseek/model.py)
-        out, _ = dropless_moe_apply(
+        out, dropped = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(
@@ -180,7 +178,7 @@ class Ernie45MoeBlock(nn.Module):
                 cfg, cfg.moe_intermediate_size * cfg.moe_num_shared_experts,
                 name="shared_experts",
             )(hidden)
-        return out
+        return out, dropped
 
 
 class Ernie45MoeDecoderLayer(nn.Module):
@@ -198,10 +196,11 @@ class Ernie45MoeDecoderLayer(nn.Module):
         )
         normed = norm("post_attention_layernorm")(hidden)
         if self.is_moe:
-            mlp_out = Ernie45MoeBlock(cfg, name="mlp")(normed)
+            mlp_out, dropped = Ernie45MoeBlock(cfg, name="mlp")(normed)
         else:
             mlp_out = Ernie45MoeMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
-        return hidden + mlp_out
+            dropped = jnp.float32(0.0)
+        return hidden + mlp_out, dropped
 
 
 class _MoEScanBody(nn.Module):
@@ -211,10 +210,10 @@ class _MoEScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden = Ernie45MoeDecoderLayer(self.config, True, name="layer")(
+        hidden, dropped = Ernie45MoeDecoderLayer(self.config, True, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, None
+        return hidden, dropped
 
 
 class Ernie45Moe(nn.Module):
@@ -263,13 +262,15 @@ class Ernie45Moe(nn.Module):
 
         policy = _remat_policy(cfg)
         n_scanned = cfg.num_scanned_layers
+        ep_dropped = jnp.float32(0.0)
         for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = Ernie45MoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(Ernie45MoeDecoderLayer, policy=policy)
-            hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+            hidden, dropped = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
+            ep_dropped = ep_dropped + dropped
         if n_scanned:
             body = _MoEScanBody
             if policy is not None:
@@ -282,7 +283,8 @@ class Ernie45Moe(nn.Module):
                 length=n_scanned,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="moe_layers")
-            hidden, _ = scanned(hidden, segment_ids, cos, sin)
+            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            ep_dropped = ep_dropped + dropped.sum()
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
@@ -309,6 +311,7 @@ class Ernie45Moe(nn.Module):
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
+            ep_dropped_rows=ep_dropped,
         )
 
     def get_input_embeddings_path(self) -> str:

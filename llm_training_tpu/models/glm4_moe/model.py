@@ -72,24 +72,26 @@ class Glm4MoeDecoderLayer(nn.Module):
         )
         normed = norm("post_attention_layernorm")(hidden)
         if self.is_moe:
-            mlp_out = DeepseekMoE(cfg, name="mlp")(normed)
+            mlp_out, dropped = DeepseekMoE(cfg, name="mlp")(normed)
         else:
             mlp_out = DeepseekMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
-        return hidden + mlp_out
+            dropped = jnp.float32(0.0)
+        return hidden + mlp_out, dropped
 
 
 class _MoEScanBody(nn.Module):
     """Scan body: one MoE layer (the uniform suffix after the dense prefix —
-    GLM-4.5 is 92 layers deep, so scanning is what keeps compile time flat)."""
+    GLM-4.5 is 92 layers deep, so scanning is what keeps compile time flat).
+    ys carries the EP capacity-drop counter."""
 
     config: Glm4MoeConfig
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden = Glm4MoeDecoderLayer(self.config, True, name="layer")(
+        hidden, dropped = Glm4MoeDecoderLayer(self.config, True, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, None
+        return hidden, dropped
 
 
 class Glm4Moe(nn.Module):
@@ -134,13 +136,15 @@ class Glm4Moe(nn.Module):
 
         policy = _remat_policy(cfg)
         n_scanned = cfg.num_scanned_layers
+        ep_dropped = jnp.float32(0.0)
         for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = Glm4MoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(Glm4MoeDecoderLayer, policy=policy)
-            hidden = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+            hidden, dropped = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
+            ep_dropped = ep_dropped + dropped
         if n_scanned:
             body = _MoEScanBody
             if policy is not None:
@@ -153,7 +157,8 @@ class Glm4Moe(nn.Module):
                 length=n_scanned,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="moe_layers")
-            hidden, _ = scanned(hidden, segment_ids, cos, sin)
+            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            ep_dropped = ep_dropped + dropped.sum()
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
@@ -169,6 +174,7 @@ class Glm4Moe(nn.Module):
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
+            ep_dropped_rows=ep_dropped,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -113,9 +113,7 @@ class HunYuanMoeBlock(nn.Module):
             up = jax.lax.ragged_dot(xs, wu, group_sizes)
             return jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
 
-        # dropped-row count discarded (no stats channel through this
-        # family's layers — see the note in deepseek/model.py)
-        out, _ = dropless_moe_apply(
+        out, dropped = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_weights, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate, w_up, w_down),
@@ -129,7 +127,7 @@ class HunYuanMoeBlock(nn.Module):
         shared = _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "shared_down_proj", False)(
             nn.silu(s_gate) * s_up
         )
-        return out + shared
+        return out + shared, dropped
 
 
 class HunYuanMoeDecoderLayer(nn.Module):
@@ -145,7 +143,8 @@ class HunYuanMoeDecoderLayer(nn.Module):
             normed, segment_ids, cos, sin
         )
         normed = norm("post_attention_layernorm")(hidden)
-        return hidden + HunYuanMoeBlock(cfg, name="mlp")(normed)
+        mlp_out, dropped = HunYuanMoeBlock(cfg, name="mlp")(normed)
+        return hidden + mlp_out, dropped
 
 
 class _ScannedLayer(nn.Module):
@@ -153,10 +152,10 @@ class _ScannedLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden = HunYuanMoeDecoderLayer(self.config, name="layer")(
+        hidden, dropped = HunYuanMoeDecoderLayer(self.config, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, None
+        return hidden, dropped
 
 
 class HunYuanMoe(nn.Module):
@@ -179,14 +178,16 @@ class HunYuanMoe(nn.Module):
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
-            hidden, _ = scanned(hidden, segment_ids, cos, sin)
-            return hidden
+            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            return hidden, dropped.sum()
+        ep_dropped = jnp.float32(0.0)
         for i in range(cfg.num_hidden_layers):
             layer_cls = HunYuanMoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(HunYuanMoeDecoderLayer, policy=policy)
-            hidden = layer_cls(cfg, name=f"layers_{i}")(hidden, segment_ids, cos, sin)
-        return hidden
+            hidden, dropped = layer_cls(cfg, name=f"layers_{i}")(hidden, segment_ids, cos, sin)
+            ep_dropped = ep_dropped + dropped
+        return hidden, ep_dropped
 
     @nn.compact
     def __call__(
@@ -223,7 +224,7 @@ class HunYuanMoe(nn.Module):
         )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
-        hidden = self._layers(hidden, segment_ids, cos, sin)
+        hidden, ep_dropped = self._layers(hidden, segment_ids, cos, sin)
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
@@ -238,6 +239,7 @@ class HunYuanMoe(nn.Module):
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
+            ep_dropped_rows=ep_dropped,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -128,6 +128,34 @@ class Trainer:
 
     # ------------------------------------------------------------ setup
 
+    def _build_tx(self, objective) -> tuple[optax.GradientTransformation, optax.Schedule]:
+        """Decide the optimizer LAYOUT and build the transformation. The
+        overlapped (per-leaf) offload step needs a clip-free leaf-local
+        transform; accumulation (MultiSteps wraps the whole tree) and
+        path-named freeze masks fall back to the serialized round trip.
+        fit and validate_from_checkpoint both go through here so the
+        opt_state pytree layout — which checkpoints persist — always
+        matches."""
+        cfg = self.config
+        self._blocked_offload = (
+            cfg.offload_optimizer_state
+            and cfg.accumulate_grad_batches == 1
+            and not objective.config.frozen_modules
+        )
+        optim_config = objective.config.optim
+        self._clip_norm = None
+        if self._blocked_offload:
+            self._clip_norm = optim_config.grad_clip_norm
+            optim_config = optim_config.model_copy(update={"grad_clip_norm": None})
+        tx, schedule = build_optimizer(
+            optim_config,
+            num_total_steps=cfg.max_steps,
+            frozen_modules=objective.config.frozen_modules or None,
+        )
+        if cfg.accumulate_grad_batches > 1:
+            tx = optax.MultiSteps(tx, cfg.accumulate_grad_batches)
+        return tx, schedule
+
     def _opt_init(self, tx, params) -> Any:
         """Whole-tree optimizer state, or (blocked offload) one state per
         param leaf. Flattening stops at Partitioned boxes so per-leaf init
@@ -297,25 +325,7 @@ class Trainer:
         batches = datamodule.train_batches(start_step=0)
         sample_batch = next(batches)
 
-        # the overlapped (per-leaf) offload step needs a clip-free leaf-local
-        # transform; accumulation (MultiSteps wraps the whole tree) and
-        # path-named freeze masks fall back to the serialized round trip
-        self._blocked_offload = (
-            cfg.offload_optimizer_state
-            and cfg.accumulate_grad_batches == 1
-            and not objective.config.frozen_modules
-        )
-        optim_config = objective.config.optim
-        if self._blocked_offload:
-            self._clip_norm = optim_config.grad_clip_norm
-            optim_config = optim_config.model_copy(update={"grad_clip_norm": None})
-        tx, schedule = build_optimizer(
-            optim_config,
-            num_total_steps=cfg.max_steps,
-            frozen_modules=objective.config.frozen_modules or None,
-        )
-        if cfg.accumulate_grad_batches > 1:
-            tx = optax.MultiSteps(tx, cfg.accumulate_grad_batches)
+        tx, schedule = self._build_tx(objective)
 
         dp_ways = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         batch_size = next(iter(sample_batch.values())).shape[0]
@@ -589,13 +599,7 @@ class Trainer:
         datamodule.setup()
         with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
             sample_batch = next(datamodule.train_batches())
-            tx, _ = build_optimizer(
-                objective.config.optim,
-                num_total_steps=cfg.max_steps,
-                frozen_modules=objective.config.frozen_modules or None,
-            )
-            if cfg.accumulate_grad_batches > 1:
-                tx = optax.MultiSteps(tx, cfg.accumulate_grad_batches)
+            tx, _ = self._build_tx(objective)
             abstract_boxed = self._abstract_state(objective, sample_batch, tx)
             self.state_shardings = self._state_shardings(abstract_boxed)
             abstract_state = nn.meta.unbox(abstract_boxed)

@@ -74,13 +74,14 @@ def _expert_mlp(impl: str):
     return mlp
 
 
-def bench_one(n_experts: int, width: int, impl: str, bwd: bool):
+def bench_one(n_experts: int, width: int, impl: str, bwd: bool, mlp=None):
     x = jnp.asarray(_RNG.standard_normal((ROWS, HIDDEN)) * 0.1, jnp.bfloat16)
     wg = jnp.asarray(_RNG.standard_normal((n_experts, HIDDEN, width)) * 0.02, jnp.bfloat16)
     wu = jnp.asarray(_RNG.standard_normal((n_experts, HIDDEN, width)) * 0.02, jnp.bfloat16)
     wd = jnp.asarray(_RNG.standard_normal((n_experts, width, HIDDEN)) * 0.02, jnp.bfloat16)
     gs = jnp.full((n_experts,), ROWS // n_experts, jnp.int32)  # balanced
-    mlp = _expert_mlp(impl)
+    if mlp is None:
+        mlp = _expert_mlp(impl)
 
     if not bwd:
         @jax.jit
@@ -100,8 +101,11 @@ def bench_one(n_experts: int, width: int, impl: str, bwd: bool):
         @jax.jit
         def run(salt, x, wg, wu, wd, gs):
             def body(carry, _):
-                gx, *_ = grad(x + carry, wg, wu, wd, gs)
-                return gx[0, 0].astype(jnp.bfloat16), None
+                # every gradient output must feed the carry, or jax's DCE
+                # removes the dw matmuls from the timed graph entirely
+                gx, gg, gu, gd = grad(x + carry, wg, wu, wd, gs)
+                live = gx[0, 0] + gg[0, 0, 0] + gu[0, 0, 0] + gd[0, 0, 0]
+                return live.astype(jnp.bfloat16), None
 
             y, _ = jax.lax.scan(body, salt, None, length=ITERS)
             return y

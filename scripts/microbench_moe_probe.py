@@ -1,152 +1,65 @@
 """Follow-up probes for the MoE grouped-matmul gap (r5).
 
-Measures, at the bench proxy shape (rows 65536, h 2048):
-  1. gmm tiling sweep — is megablox's default (128,128,128) the problem?
-  2. ragged_dot at MXU-aligned width 768 vs the proxy's 704 — how much of
-     the gap is lane misalignment?
+Reuses the harness from `scripts/microbench_moe.py` (timing discipline,
+input builder, ragged/gmm MLPs). Adds, at the bench proxy shape
+(rows 65536, h 2048):
+  1. ragged_dot at MXU-aligned width 768 vs the proxy's 704 — how much of
+     the gap is lane misalignment? (measured r5: 0.19 -> 0.21 fwd, minor)
+  2. gmm tiling sweep — rejected: non-128-multiple expert widths violate
+     the megablox kernel's lowering constraints.
   3. a BUCKETED formulation: balanced groups -> fixed per-expert capacity
      buckets -> ONE dense batched matmul [E, C, h] @ [E, h, w] with
-     gather/scatter at the edges. Semantics = capacity-factor MoE (drops on
-     overflow — surfaced by the ep_dropped_rows metric), FLOPs = C/avg
-     padding overhead, but the matmul is fully dense on the MXU.
-
-Same timing discipline as microbench_moe.py.
+     gather/scatter at the edges. Semantics = capacity-factor MoE (drops
+     on overflow — surfaced by the ep_dropped_rows metric); the matmul is
+     fully dense on the MXU.
 """
 
 from __future__ import annotations
 
-import functools
-import os
 import sys
-import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-ITERS = 8
-_PEAK = 197e12
-_RNG = np.random.default_rng(0)
-ROWS, HIDDEN = 65536, 2048
-
-
-def _fetch(out) -> None:
-    jax.device_get(jax.tree.leaves(out)[0].ravel()[:8])
-
-
-def _timed(fn, *args) -> float:
-    _fetch(fn(jnp.bfloat16(0.0), *args))
-    times = []
-    for rep in range(1, 4):
-        t0 = time.perf_counter()
-        _fetch(fn(jnp.bfloat16(rep * 1e-3), *args))
-        times.append((time.perf_counter() - t0) / ITERS)
-    return float(np.median(times))
-
-
-def _inputs(n_experts, width):
-    x = jnp.asarray(_RNG.standard_normal((ROWS, HIDDEN)) * 0.1, jnp.bfloat16)
-    wg = jnp.asarray(_RNG.standard_normal((n_experts, HIDDEN, width)) * 0.02, jnp.bfloat16)
-    wu = jnp.asarray(_RNG.standard_normal((n_experts, HIDDEN, width)) * 0.02, jnp.bfloat16)
-    wd = jnp.asarray(_RNG.standard_normal((n_experts, width, HIDDEN)) * 0.02, jnp.bfloat16)
-    gs = jnp.full((n_experts,), ROWS // n_experts, jnp.int32)
-    return x, wg, wu, wd, gs
-
-
-def bench_mlp(mlp, n_experts, width, bwd):
-    x, wg, wu, wd, gs = _inputs(n_experts, width)
-    if not bwd:
-        @jax.jit
-        def run(salt, x, wg, wu, wd, gs):
-            def body(carry, _):
-                y = mlp(x + carry, wg, wu, wd, gs)
-                return y.ravel()[0].astype(jnp.bfloat16), None
-            y, _ = jax.lax.scan(body, salt, None, length=ITERS)
-            return y
-    else:
-        grad = jax.grad(
-            lambda *a: jnp.sum(mlp(*a).astype(jnp.float32) ** 2), argnums=(0, 1, 2, 3)
-        )
-
-        @jax.jit
-        def run(salt, x, wg, wu, wd, gs):
-            def body(carry, _):
-                gx, *_ = grad(x + carry, wg, wu, wd, gs)
-                return gx.ravel()[0].astype(jnp.bfloat16), None
-            y, _ = jax.lax.scan(body, salt, None, length=ITERS)
-            return y
-
-    t = _timed(run, x, wg, wu, wd, gs)
-    n_mm = 3 if not bwd else 9
-    flops = n_mm * 2 * ROWS * HIDDEN * width
-    return t, flops / t / _PEAK
-
-
-def ragged_mlp(x, wg, wu, wd, gs):
-    dot = jax.lax.ragged_dot
-    gate = dot(x, wg, gs)
-    up = dot(x, wu, gs)
-    return dot(jax.nn.silu(gate) * up, wd, gs)
-
-
-def gmm_mlp_tiled(tiling):
-    from jax.experimental.pallas.ops.tpu.megablox.ops import gmm
-
-    dot = functools.partial(gmm, preferred_element_type=jnp.bfloat16, tiling=tiling)
-
-    def mlp(x, wg, wu, wd, gs):
-        gate = dot(x, wg, gs)
-        up = dot(x, wu, gs)
-        return dot(jax.nn.silu(gate) * up, wd, gs)
-
-    return mlp
+from scripts.microbench_moe import HIDDEN, ROWS, bench_one
 
 
 def bucketed_mlp(x, wg, wu, wd, gs):
     """Fixed-capacity buckets + dense bmm. Rows are already expert-sorted
-    (as in dropless_moe_apply); bucket e takes rows [e*C, (e+1)*C) of a
-    capacity-padded layout built by one gather."""
+    (as in dropless_moe_apply); bucket e takes rows [start_e, start_e + C)
+    of the sorted layout via one gather."""
     E = wg.shape[0]
-    cap = ROWS // E  # balanced probe: capacity factor 1.0, no padding waste
+    cap = ROWS // E  # balanced probe: capacity factor 1.0
     start = jnp.cumsum(gs) - gs
-    # index of row r within bucket e -> source row start[e] + offset
     offs = jnp.arange(cap)
     src = (start[:, None] + offs[None, :]).reshape(-1)  # [E*cap]
     valid = (offs[None, :] < gs[:, None]).reshape(-1)
-    xb = jnp.where(valid[:, None], x[jnp.clip(src, 0, ROWS - 1)], 0)
+    xb = (x[jnp.clip(src, 0, ROWS - 1)] * valid[:, None].astype(x.dtype))
     xb = xb.reshape(E, cap, HIDDEN)
     gate = jnp.einsum("ech,ehw->ecw", xb, wg, preferred_element_type=jnp.bfloat16)
     up = jnp.einsum("ech,ehw->ecw", xb, wu, preferred_element_type=jnp.bfloat16)
     yb = jnp.einsum("ecw,ewh->ech", jax.nn.silu(gate) * up, wd,
                     preferred_element_type=jnp.bfloat16)
-    # scatter back to sorted-row order
     y = jnp.zeros((ROWS, HIDDEN), yb.dtype)
     return y.at[jnp.clip(src, 0, ROWS - 1)].add(
-        yb.reshape(-1, HIDDEN) * valid[:, None]
+        yb.reshape(-1, HIDDEN) * valid[:, None].astype(yb.dtype)
     )
 
 
 def main():
     print("| case | impl | pass | ms/iter | MXU eff |")
     print("|---|---|---|---|---|")
-    cases = [(8, 704), (8, 768), (64, 256)]
-    for E, W in cases:
+    for E, W in ((8, 704), (8, 768), (64, 256)):
         for p in ("fwd", "bwd"):
-            t, eff = bench_mlp(ragged_mlp, E, W, p == "bwd")
+            t, eff = bench_one(E, W, "ragged", p == "bwd")
             print(f"| {E}x{W} | ragged | {p} | {t*1e3:.2f} | {eff:.3f} |", flush=True)
-    for tiling in ((512, 512, 704), (1024, 2048, 704), (2048, 512, 352)):
-        try:
-            t, eff = bench_mlp(gmm_mlp_tiled(tiling), 8, 704, False)
-            print(f"| 8x704 tiling={tiling} | gmm | fwd | {t*1e3:.2f} | {eff:.3f} |", flush=True)
-        except Exception as e:
-            print(f"| 8x704 tiling={tiling} | gmm | fwd | FAIL | {str(e)[:60]} |", flush=True)
-    for E, W in cases:
+    for E, W in ((8, 704), (64, 256)):
         for p in ("fwd", "bwd"):
             try:
-                t, eff = bench_mlp(bucketed_mlp, E, W, p == "bwd")
+                t, eff = bench_one(E, W, "bucketed", p == "bwd", mlp=bucketed_mlp)
                 print(f"| {E}x{W} | bucketed | {p} | {t*1e3:.2f} | {eff:.3f} |", flush=True)
             except Exception as e:
                 print(f"| {E}x{W} | bucketed | {p} | FAIL | {str(e)[:60]} |", flush=True)

@@ -132,6 +132,22 @@ def test_ep_dropped_rows_metric_flows_to_output(ep_mesh):
     assert float(jax.device_get(out.ep_dropped_rows)) == 0.0
 
 
+def test_ep_dropped_rows_flow_deepseek_scan_route(ep_mesh):
+    """The counter also flows through the dense-prefix + scanned-suffix
+    plumbing (DeepSeek — the EP flagship; GLM-4.5/Ernie/HunYuan share the
+    pattern)."""
+    from llm_training_tpu.models import Deepseek, DeepseekConfig
+    from tests.test_deepseek import TINY
+
+    ids = jnp.asarray(np.random.default_rng(4).integers(0, 128, (2, 16)))
+    model = Deepseek(DeepseekConfig(**TINY, n_group=4, topk_group=2, moe_impl="ragged"))
+    params = model.init(jax.random.key(0), ids)
+    with ep_mesh:
+        out = jax.jit(lambda p, x: model.apply(p, x))(params, ids)
+    assert out.ep_dropped_rows is not None
+    assert float(jax.device_get(out.ep_dropped_rows)) == 0.0  # factor 2 @ ep=2
+
+
 def test_ep_requires_divisible_experts(ep_mesh):
     cfg = LlamaConfig(**{**TINY_MOE, "num_experts": 3, "num_experts_per_tok": 2},
                       moe_impl="ragged")
