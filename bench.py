@@ -144,6 +144,10 @@ def main() -> None:
             model_kwargs[key] = int(os.environ[env])
     if os.environ.get("BENCH_SCAN"):
         model_kwargs["scan_layers"] = os.environ["BENCH_SCAN"] == "1"
+    if os.environ.get("BENCH_MOE_IMPL"):  # ragged | bucketed | dense
+        model_kwargs["moe_impl"] = os.environ["BENCH_MOE_IMPL"]
+    if os.environ.get("BENCH_MOE_CAP"):  # bucketed per-expert capacity factor
+        model_kwargs["moe_capacity_factor"] = float(os.environ["BENCH_MOE_CAP"])
     if not on_tpu:  # CPU smoke: tiny
         model_kwargs.update(hidden_size=128, intermediate_size=256, num_hidden_layers=2,
                             num_attention_heads=4, num_key_value_heads=2, head_dim=None,

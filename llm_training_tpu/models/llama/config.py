@@ -106,12 +106,20 @@ class LlamaConfig(BaseModelConfig):
     # 'mixtral' (block_sparse_moe.experts.{i}.w1/w3/w2)
     moe_style: Literal["qwen", "mixtral"] = "qwen"
     # 'ragged' = dropless grouped matmul (lax.ragged_dot, the TPU training
-    # path); 'dense' = every expert on every token (exact, for parity tests)
-    moe_impl: Literal["auto", "dense", "ragged"] = "auto"
+    # path); 'dense' = every expert on every token (exact, for parity
+    # tests); 'bucketed' = fixed per-expert capacity buckets + ONE dense
+    # batched matmul — trades token drops under imbalance (surfaced by the
+    # ep_dropped_rows metric) for fully-dense MXU work where ragged_dot's
+    # lowering underperforms (see BASELINE.md's grouped-matmul sweep)
+    moe_impl: Literal["auto", "dense", "ragged", "bucketed"] = "auto"
     # per-rank buffer slack for the expert-parallel dispatch: capacity =
     # ceil(T*K/ep * factor) rows (clamped to T*K); routing beyond it is
     # dropped, so raise this if EP training shows imbalance-driven drops
     ep_capacity_factor: float = 2.0
+    # per-EXPERT bucket slack for moe_impl='bucketed': capacity =
+    # ceil(T*K/E * factor) rows per expert (clamped to T*K); 1.0 = exactly
+    # balanced, larger absorbs imbalance at padding cost
+    moe_capacity_factor: float = 1.25
 
     enable_gradient_checkpointing: bool = False
     recompute_granularity: Literal["full", "selective"] = "full"

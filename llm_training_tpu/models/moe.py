@@ -149,6 +149,56 @@ def _ep_ragged_apply(
     return out.astype(out_dtype), dropped
 
 
+def _sorted_dispatch(topk_idx, topk_weights, num_experts):
+    """Shared dispatch prelude: (flat_weight, flat_token, order, gs) for the
+    expert-sorted row layout both the ragged and bucketed paths consume."""
+    n_tokens, top_k = topk_idx.shape
+    flat_expert = topk_idx.reshape(-1)
+    flat_weight = topk_weights.reshape(-1)
+    flat_token = jnp.arange(n_tokens * top_k) // top_k
+    order = jnp.argsort(flat_expert)  # stable: rows sorted by expert
+    gs = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
+    return flat_expert, flat_weight, flat_token, order, gs
+
+
+def _bucketed_apply(
+    x, topk_idx, topk_weights, num_experts, bmm_fn, capacity_factor: float
+):
+    """Fixed-capacity bucket dispatch: sort the (token, slot) assignments by
+    expert, gather bucket e's first C rows into a dense [E, C, H] operand,
+    run ONE batched matmul stack (`bmm_fn`), weighted-scatter back. Rows
+    beyond an expert's capacity are DROPPED (classic GShard/Switch
+    semantics — counted and returned, cf. the ep path); in exchange every
+    matmul is a dense MXU bmm where `ragged_dot`'s grouped lowering
+    underperforms (BASELINE.md r5 sweep: 0.19 fwd eff at the bench shape).
+    """
+    n_tokens, top_k = topk_idx.shape
+    hidden = x.shape[-1]
+    rows = n_tokens * top_k
+    capacity = min(math.ceil(rows / num_experts * capacity_factor), rows)
+
+    _, flat_weight, flat_token, order, gs = _sorted_dispatch(
+        topk_idx, topk_weights, num_experts
+    )
+    start = jnp.cumsum(gs) - gs
+    offs = jnp.arange(capacity)
+    # bucket e, slot c -> index into the sorted rows (clamped; invalid
+    # slots masked to zero contribution)
+    src_sorted = jnp.clip(start[:, None] + offs[None, :], 0, rows - 1)
+    valid = offs[None, :] < gs[:, None]  # [E, capacity]
+    src = order[src_sorted.reshape(-1)]  # -> original (token, slot) rows
+    tok = flat_token[src]
+    xb = jnp.where(
+        valid.reshape(-1)[:, None], x[tok], 0
+    ).reshape(num_experts, capacity, hidden)
+    yb = bmm_fn(xb)  # [E, capacity, H]
+    w = (flat_weight[src] * valid.reshape(-1).astype(flat_weight.dtype))
+    ys = yb.reshape(-1, hidden) * w.astype(yb.dtype)[:, None]
+    out = jnp.zeros((n_tokens, hidden), x.dtype).at[tok].add(ys.astype(x.dtype))
+    dropped = (rows - jnp.minimum(gs, capacity).sum()).astype(jnp.float32)
+    return out, dropped
+
+
 def dropless_moe_apply(
     x: jnp.ndarray,
     topk_idx: jnp.ndarray,
@@ -159,6 +209,8 @@ def dropless_moe_apply(
     ragged_fn,
     weights=None,
     ep_capacity_factor: float = 2.0,
+    bmm_fn=None,
+    moe_capacity_factor: float = 1.25,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Shared dropless dispatch/combine for every MoE family.
 
@@ -171,14 +223,38 @@ def dropless_moe_apply(
     dim E) that ragged_fn consumes — passed explicitly so the
     expert-parallel path can hand each rank its local slice.
 
+    `bmm_fn(xb [E, C, H]) -> [E, C, H]` (batched dense expert stack) enables
+    `impl='bucketed'`; families that do not provide it reject that impl.
+
     Returns (out [T, H], dropped_rows fp32 scalar): dropped_rows counts
-    (token, slot) assignments lost to the expert-parallel capacity buffer
-    this call — exactly 0 on the truly-dropless dense/single-rank paths.
+    (token, slot) assignments lost to a capacity buffer (expert-parallel
+    rank buffer, or the per-expert buckets of impl='bucketed') this call —
+    exactly 0 on the truly-dropless dense/ragged single-rank paths.
     """
     n_tokens, top_k = topk_idx.shape
     no_drops = jnp.float32(0.0)
     if impl == "auto":
         impl = "ragged" if jax.default_backend() == "tpu" else "dense"
+    if impl not in ("dense", "ragged", "bucketed"):
+        # fail loudly: a typo'd impl silently measuring the ragged path
+        # would corrupt exactly the A/B comparisons this knob exists for
+        raise ValueError(
+            f"unknown moe_impl {impl!r}; expected auto/dense/ragged/bucketed"
+        )
+    if impl == "bucketed":
+        if bmm_fn is None:
+            raise ValueError(
+                "moe_impl='bucketed' needs the family to provide bmm_fn "
+                "(currently: the Llama-family MoEMLP)"
+            )
+        if _ep_group_size() > 1:
+            raise ValueError(
+                "moe_impl='bucketed' does not compose with expert "
+                "parallelism yet; use 'ragged' on EP meshes"
+            )
+        return _bucketed_apply(
+            x, topk_idx, topk_weights, num_experts, bmm_fn, moe_capacity_factor
+        )
     if impl == "dense":
         y = dense_fn(x)
         combine = jnp.zeros((n_tokens, num_experts), x.dtype)
@@ -197,12 +273,10 @@ def dropless_moe_apply(
             x, topk_idx, topk_weights, num_experts, ragged_fn, weights,
             ep, ep_capacity_factor,
         )
-    flat_expert = topk_idx.reshape(-1)
-    flat_weight = topk_weights.reshape(-1)
-    flat_token = jnp.arange(n_tokens * top_k) // top_k
-    order = jnp.argsort(flat_expert)  # stable
+    flat_expert, flat_weight, flat_token, order, group_sizes = _sorted_dispatch(
+        topk_idx, topk_weights, num_experts
+    )
     token_order = flat_token[order]
-    group_sizes = jnp.bincount(flat_expert, length=num_experts).astype(jnp.int32)
     ys = ragged_fn(x[token_order], group_sizes, flat_expert[order], weights)
     ys = ys * flat_weight[order][:, None]
     out = jnp.zeros((n_tokens, x.shape[-1]), x.dtype).at[token_order].add(ys)
@@ -291,11 +365,25 @@ class MoEMLP(nn.Module):
             up = jax.lax.ragged_dot(xs, wu, group_sizes)
             return jax.lax.ragged_dot(nn.silu(gate) * up, wd, group_sizes)
 
+        def bmm_fn(xb):  # [E, C, H] dense bucket stack (moe_impl='bucketed')
+            gate = jnp.einsum(
+                "ech,ehi->eci", xb, w_gate, preferred_element_type=compute_dtype
+            )
+            up = jnp.einsum(
+                "ech,ehi->eci", xb, w_up, preferred_element_type=compute_dtype
+            )
+            return jnp.einsum(
+                "eci,eih->ech", nn.silu(gate) * up, w_down,
+                preferred_element_type=compute_dtype,
+            )
+
         out, dropped = dropless_moe_apply(
             x.astype(compute_dtype), topk_idx, topk_probs, num_experts,
             cfg.moe_impl, dense_fn, ragged_fn,
             weights=(w_gate, w_up, w_down),
             ep_capacity_factor=getattr(cfg, "ep_capacity_factor", 2.0),
+            bmm_fn=bmm_fn,
+            moe_capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25),
         )
 
         # ---- shared expert (Qwen2-MoE): dense SwiGLU + per-token sigmoid gate

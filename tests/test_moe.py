@@ -47,6 +47,51 @@ def test_dense_and_ragged_impls_agree():
     np.testing.assert_allclose(out_d.aux_loss, out_r.aux_loss, rtol=1e-6)
 
 
+def test_bucketed_impl_matches_dense_at_full_capacity():
+    """moe_impl='bucketed' with capacity >= every group size is exact: the
+    dense-bmm bucket formulation must reproduce the dense path bit-for-tol,
+    and report zero drops."""
+    ids = jnp.asarray(np.random.default_rng(1).integers(0, 128, (2, 24)))
+    cfg_d = LlamaConfig(**TINY_MOE, moe_impl="dense")
+    # factor = num_experts -> capacity == all T*K rows: drops impossible
+    cfg_b = LlamaConfig(**TINY_MOE, moe_impl="bucketed", moe_capacity_factor=4.0)
+    model_d, model_b = Llama(cfg_d), Llama(cfg_b)
+    params = model_d.init(jax.random.key(1), ids)
+    out_d = model_d.apply(params, ids)
+    out_b = model_b.apply(params, ids)
+    np.testing.assert_allclose(out_d.logits, out_b.logits, rtol=2e-5, atol=2e-5)
+    assert float(out_b.ep_dropped_rows) == 0.0
+
+
+def test_bucketed_impl_counts_drops():
+    """Tiny capacity drops exactly the rows beyond each expert's bucket,
+    the counter matches the capacity math, and gradients still flow."""
+    from llm_training_tpu.models.moe import dropless_moe_apply
+
+    T, H, E, K = 16, 8, 4, 2
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.float32)
+    topk_idx = jnp.zeros((T, K), jnp.int32)  # all 32 rows -> expert 0
+    topk_w = jnp.full((T, K), 0.5, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((E, H, H)) * 0.1, jnp.float32)
+
+    def bmm_fn(xb):
+        return jnp.einsum("ech,ehg->ecg", xb, w)
+
+    def f(x):
+        out, dropped = dropless_moe_apply(
+            x, topk_idx, topk_w, E, "bucketed", None, None,
+            bmm_fn=bmm_fn, moe_capacity_factor=1.0,
+        )
+        return out.sum(), dropped
+
+    (total, dropped), grads = jax.value_and_grad(f, has_aux=True)(x)
+    # capacity = ceil(32/4 * 1.0) = 8 rows/expert; expert 0 gets all 32
+    # assignments -> 24 dropped
+    assert float(dropped) == 24.0
+    assert np.isfinite(float(total)) and np.all(np.isfinite(np.asarray(grads)))
+
+
 @pytest.mark.slow
 def test_aux_loss_near_topk_at_init():
     """Balanced routing at random init: f_e ~ top_k/E, P_e ~ 1/E, so the
