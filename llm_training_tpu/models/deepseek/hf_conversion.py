@@ -181,7 +181,9 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> DeepseekConfig:
         lambda k, d=None: getattr(hf_config, k, d)
     )
     model_type = get("model_type")
-    version = 3 if model_type == "deepseek_v3" else 2
+    # kimi_k2 (Moonshot Kimi-K2) ships the DeepSeek-V3 graph and key layout
+    # verbatim under its own model_type
+    version = 3 if model_type in ("deepseek_v3", "kimi_k2") else 2
     if version == 2 and get("topk_method", "greedy") not in (
         "greedy", "group_limited_greedy"
     ):

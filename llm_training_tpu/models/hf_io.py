@@ -263,6 +263,10 @@ _ARCH_TO_FAMILY = {
     # HF model_type -> our (model class path, conversion config name)
     "llama": "llm_training_tpu.models.Llama",
     "mistral": "llm_training_tpu.models.Llama",  # same graph: GQA + SwiGLU + RMSNorm
+    "ministral": "llm_training_tpu.models.Llama",  # + per-layer sliding/full pattern
+    "helium": "llm_training_tpu.models.Llama",  # llama graph (o_proj bias hardcoded off)
+    "arcee": "llm_training_tpu.models.Llama",  # non-gated relu^2 MLP under rmsnorm
+    "seed_oss": "llm_training_tpu.models.Llama",  # qkv bias + separate o-bias flag
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
     "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
     "olmo2": "llm_training_tpu.models.Llama",  # + post-norm blocks, full qk-norm
@@ -283,6 +287,7 @@ _ARCH_TO_FAMILY = {
     "glm4_moe": "llm_training_tpu.models.Glm4Moe",  # GLM-4.5: V3-style noaux MoE
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
+    "kimi_k2": "llm_training_tpu.models.Deepseek",  # Kimi-K2: V3 graph verbatim
     "gpt_oss": "llm_training_tpu.models.GptOss",  # sink attention + clamped-swiglu MoE
     "qwen3_next": "llm_training_tpu.models.Qwen3Next",  # hybrid gated DeltaNet
     "minimax": "llm_training_tpu.models.MiniMax",  # hybrid lightning attention

@@ -47,6 +47,11 @@ class LlamaConfig(BaseModelConfig):
     # sliding layers use UNSCALED default rope, full layers the configured
     # rope (+ rope_scaling). None = sliding_window applies to every layer.
     layer_types: list[str] | None = None
+    # OLMo-3: sliding layers rotate with the UNSCALED default rope tables
+    # while full layers use rope_scaling. Ministral shares the layer_types
+    # pattern but rotates every layer with ONE table, so this stays False
+    # for it.
+    dual_local_rope: bool = False
     # Qwen3-style per-head RMSNorm on q and k (over head_dim, before RoPE);
     # scope 'full' is the OLMo-2/OLMoE variant (one norm over the whole
     # projected width, applied before the head reshape)
@@ -175,6 +180,16 @@ class LlamaConfig(BaseModelConfig):
                     "layer_types requires looped layers; set scan_layers=False"
                 )
             self.scan_layers = False
+            # back-compat: before dual_local_rope existed, layer_types +
+            # rope_scaling implied OLMo-3 dual tables; preserve that for
+            # hand-written configs carrying the OLMo-3 signature (post-norm)
+            # unless the flag was set explicitly
+            if (
+                "dual_local_rope" not in self.model_fields_set
+                and self.rope_scaling
+                and self.norm_scheme == "post"
+            ):
+                self.dual_local_rope = True
         if self.no_rope_layers is not None:
             if self.position_embedding_type == "learned":
                 raise ValueError(

@@ -550,11 +550,42 @@ def _check_exportable(config: LlamaConfig) -> None:
         and config.norm_scheme == "pre"
         and not config.qk_norm  # HF Nemotron has no q/k norms
     )
-    if (config.mlp_type == "relu2" or config.norm_type == "layernorm1p") and not is_nemotron:
+    # relu2 under plain RMSNorm pre-norm blocks exists as Arcee in HF
+    # (which biases all four attention projections with ONE flag)
+    is_arcee = (
+        config.norm_type == "rmsnorm" and config.mlp_type == "relu2"
+        and config.norm_scheme == "pre" and not config.qk_norm
+        and not config.rope_interleaved and config.partial_rotary_factor == 1.0
+        and config.num_experts is None
+        and config.attention_bias == config.attention_out_bias
+    )
+    if (
+        config.mlp_type == "relu2" or config.norm_type == "layernorm1p"
+    ) and not (is_nemotron or is_arcee):
         raise ValueError(
-            "mlp_type='relu2' and norm_type='layernorm1p' only exist together "
-            "under pre-norm (as Nemotron) in HF; this combination cannot be "
-            "exported"
+            "mlp_type='relu2' exists in HF only as Nemotron (with "
+            "norm_type='layernorm1p') or Arcee (with rmsnorm), both under "
+            "pre-norm without qk-norm; this combination cannot be exported"
+        )
+    if (
+        config.layer_types is not None and config.norm_scheme == "pre"
+        and (config.attention_bias or config.qk_norm)
+    ):
+        raise ValueError(
+            "a per-layer sliding/full pattern under pre-norm only exists as "
+            "Ministral in HF (bias-free, no qk-norm); this combination "
+            "cannot be exported"
+        )
+    if (
+        config.rope_interleaved and not config.fused_gate_up
+        and config.norm_scheme == "pre"
+        and config.attention_bias != config.attention_out_bias
+    ):
+        raise ValueError(
+            "interleaved rope with asymmetric attention bias and plain "
+            "(non-fused) weights matches no HF architecture (Helium "
+            "hardcodes bias-free o_proj only when attention_bias is off; "
+            "Ernie 4.5's use_bias covers o_proj); cannot be exported"
         )
     if ln_gelu and config.norm_scheme == "post":
         raise ValueError(
@@ -656,13 +687,22 @@ def _check_exportable(config: LlamaConfig) -> None:
                 "HunYuan has ONE attention_bias flag covering q/k/v/o; "
                 "asymmetric attention biases cannot be exported"
             )
-    if config.layer_types is not None and not (
+    is_olmo3_pattern = (
         config.norm_scheme == "post" and config.qk_norm
         and config.qk_norm_scope == "full"
+    )
+    is_ministral_pattern = (
+        config.norm_scheme == "pre" and not config.qk_norm
+        and not config.attention_bias and config.norm_type == "rmsnorm"
+        and config.mlp_type == "swiglu" and not config.rope_interleaved
+    )
+    if config.layer_types is not None and not (
+        is_olmo3_pattern or is_ministral_pattern
     ):
         raise ValueError(
             "per-layer sliding layer_types only exist in HF as OLMo-3 "
-            "(post-norm + full qk-norm); this combination cannot be exported"
+            "(post-norm + full qk-norm) or Ministral (bias-free pre-norm); "
+            "this combination cannot be exported"
         )
     if config.no_rope_layers is not None and not (
         config.norm_type == "rmsnorm" and config.mlp_type == "swiglu"
@@ -796,6 +836,9 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             and not config.fused_gate_up and config.norm_type == "rmsnorm"
             and config.mlp_type == "swiglu" and config.partial_rotary_factor == 1.0
             and not config.qk_norm
+            # Ernie's single use_bias flag covers o_proj too; asymmetric
+            # bias cannot ride this export (refused in _check_exportable)
+            and config.attention_bias == config.attention_out_bias
             else {}
         ),
         # parallel blocks + weight-only LayerNorm + interleaved rope +
@@ -845,6 +888,28 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "head_dim": config.resolved_head_dim,
              "hidden_act": "relu2"}
             if config.norm_type == "layernorm1p" and config.mlp_type == "relu2"
+            and not config.qk_norm
+            else {}
+        ),
+        # relu^2 MLP under plain RMSNorm pre-norm only exists as Arcee in HF
+        # (symmetric bias only — _check_exportable's is_arcee enforces it,
+        # so the qwen2 asymmetric-bias overlay can never have fired here)
+        **(
+            {"model_type": "arcee", "architectures": ["ArceeForCausalLM"],
+             "head_dim": config.resolved_head_dim,
+             "hidden_act": "relu2"}
+            if config.norm_type == "rmsnorm" and config.mlp_type == "relu2"
+            else {}
+        ),
+        # a per-layer sliding/full pattern under PRE-norm (OLMo-3 is the
+        # post-norm case above) only exists as Ministral in HF (bias-free —
+        # _check_exportable refuses biased variants)
+        **(
+            {"model_type": "ministral", "architectures": ["MinistralForCausalLM"],
+             "layer_types": list(config.layer_types),
+             "sliding_window": config.sliding_window,
+             "head_dim": config.resolved_head_dim}
+            if config.layer_types is not None and config.norm_scheme == "pre"
             and not config.qk_norm
             else {}
         ),
@@ -1005,6 +1070,17 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                     f"phi {drop}={get(drop)} is not supported: dropout is not "
                     "implemented — override it to 0.0 to fine-tune without it"
                 )
+    if model_type == "seed_oss" and get("residual_dropout", 0.0):
+        raise ValueError(
+            f"seed_oss residual_dropout={get('residual_dropout')} is not "
+            "supported: dropout is not implemented — override it to 0.0 to "
+            "fine-tune without it"
+        )
+    if model_type == "arcee" and get("hidden_act", "relu2") != "relu2":
+        raise ValueError(
+            f"arcee hidden_act={get('hidden_act')!r} is not supported; the "
+            "Arcee graph is modeled as the non-gated relu2 MLP"
+        )
     moe: dict[str, Any] = {}
     if model_type == "mixtral":
         moe = dict(
@@ -1079,8 +1155,10 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             get("use_bias", True) if model_type == "starcoder2"
             else True if model_type == "phi"
             else get("use_bias", False) if model_type == "ernie4_5"
-            # GLM biases q/k/v but never o_proj
-            else False if model_type in ("glm", "glm4")
+            # Seed-OSS carries an explicit separate o_proj flag
+            else get("attention_out_bias", False) if model_type == "seed_oss"
+            # GLM biases q/k/v but never o_proj; Helium hardcodes o bias off
+            else False if model_type in ("glm", "glm4", "helium")
             else False
             if model_type in ("qwen2", "qwen2_moe") and get("attention_bias") is None
             else (get("attention_bias") or False)
@@ -1092,11 +1170,14 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             else get("mlp_bias", False)
         ),
         rope_scaling=get("rope_scaling"),
-        # OLMo-3 carries an explicit per-layer sliding/full pattern
+        # OLMo-3 / Ministral carry an explicit per-layer sliding/full
+        # pattern; only OLMo-3 pairs it with dual rope tables (sliding
+        # layers unscaled) — Ministral rotates every layer with one table
         layer_types=(
             list(get("layer_types") or []) or None
-            if model_type == "olmo3" else None
+            if model_type in ("olmo3", "ministral") else None
         ),
+        dual_local_rope=model_type == "olmo3",
         # Mistral sets sliding_window unconditionally; the Qwen families gate
         # it behind use_sliding_window (default False)
         sliding_window=(
@@ -1140,7 +1221,9 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         ),
         mlp_type=(
             "gelu" if model_type in ("starcoder2", "phi")
-            else "relu2" if model_type == "nemotron"
+            # Arcee: the Nemotron-style non-gated up -> relu^2 -> down MLP
+            # under standard RMSNorm pre-norm blocks
+            else "relu2" if model_type in ("nemotron", "arcee")
             else "swiglu"
         ),
         partial_rotary_factor=(
@@ -1149,7 +1232,9 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             else 1.0
         ),
         lm_head_bias=(model_type == "phi"),
-        rope_interleaved=model_type in ("cohere", "glm", "glm4", "ernie4_5"),
+        rope_interleaved=model_type in (
+            "cohere", "glm", "glm4", "ernie4_5", "helium"
+        ),
         fused_gate_up=model_type in ("glm", "glm4"),
         logit_scale=(
             get("logit_scale", 0.0625) if model_type == "cohere" else None

@@ -523,8 +523,13 @@ class Llama(nn.Module):
             sin = jnp.repeat(sin[..., :half], 2, axis=-1)
 
         local_cos = local_sin = None
-        if getattr(cfg, "layer_types", None) is not None and cfg.rope_scaling:
-            # sliding layers use the UNSCALED default tables (OLMo-3)
+        if (
+            getattr(cfg, "layer_types", None) is not None
+            and cfg.rope_scaling
+            and getattr(cfg, "dual_local_rope", False)
+        ):
+            # sliding layers use the UNSCALED default tables (OLMo-3;
+            # Ministral's layer_types pattern keeps ONE table everywhere)
             inv_freq_l, scaling_l = compute_rope_frequencies(
                 cfg.local_rope_config, seq_len=seq
             )

@@ -91,6 +91,28 @@ def test_logits_parity_with_hf_deepseek_v3():
     assert cfg.routed_scaling_factor == 2.5 and cfg.n_group == 4
 
 
+def test_kimi_k2_routes_as_deepseek_v3():
+    """Kimi-K2 ships the DeepSeek-V3 graph/key layout verbatim under
+    `model_type: kimi_k2`: the router must select the Deepseek family and
+    the conversion must run in v3 mode, with logits parity against the HF
+    DeepseekV3 reference the checkpoint structure matches."""
+    torch = pytest.importorskip("torch")
+    from llm_training_tpu.models.hf_io import model_class_for_hf
+
+    hf_model, hf_config = _hf_tiny("DeepseekV3", n_group=4, topk_group=2)
+    hf_dict = hf_config.to_dict()
+    hf_dict["model_type"] = "kimi_k2"
+    assert model_class_for_hf(hf_dict) == "llm_training_tpu.models.Deepseek"
+    cfg = config_from_hf(hf_dict, compute_dtype="float32", moe_impl="dense")
+    assert cfg.version == 3
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    ids = np.random.default_rng(31).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = Deepseek(cfg).apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4)
+
+
 def test_logits_parity_with_hf_deepseek_v2_greedy():
     """V2-Lite-style: softmax scores, plain greedy top-k."""
     hf_model, hf_config = _hf_tiny(

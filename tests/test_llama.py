@@ -951,3 +951,137 @@ def test_logits_parity_with_hf_olmo3():
         hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
     ours = model.apply(params, jnp.asarray(ids)).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_ministral():
+    """Ministral routes to the Llama module: mistral weights with an
+    explicit per-layer sliding/full `layer_types` pattern, rotated by ONE
+    rope table (unlike OLMo-3's dual-table variant)."""
+    torch = pytest.importorskip("torch")
+    from transformers import MinistralConfig, MinistralForCausalLM
+
+    hf_config = MinistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8, head_dim=16,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = MinistralForCausalLM(hf_config).eval()
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.layer_types == ["sliding_attention", "full_attention"] * 2
+    assert not cfg.dual_local_rope
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(50).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_helium():
+    """Helium routes to the Llama module: plain llama graph (o_proj bias
+    hardcoded off even when attention_bias is on)."""
+    torch = pytest.importorskip("torch")
+    from transformers import HeliumConfig, HeliumForCausalLM
+
+    hf_config = HeliumConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_bias=True, head_dim=16,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = HeliumForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    assert "model.layers.0.self_attn.o_proj.bias" not in sd
+    # salt the zero-init biases: a bias-dropping conversion would pass
+    # with fresh zeros
+    with torch.no_grad():
+        for k, v in sd.items():
+            if k.endswith(".bias"):
+                v.copy_(torch.linspace(-0.2, 0.2, v.numel()))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.attention_bias and not cfg.attention_out_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(51).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_arcee():
+    """Arcee routes to the Llama module: the Nemotron-style non-gated
+    up -> relu^2 -> down MLP under standard RMSNorm pre-norm blocks."""
+    torch = pytest.importorskip("torch")
+    from transformers import ArceeConfig, ArceeForCausalLM
+
+    hf_config = ArceeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, head_dim=16,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = ArceeForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mlp.up_proj.weight" in sd
+    assert "model.layers.0.mlp.gate_proj.weight" not in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.mlp_type == "relu2" and cfg.norm_type == "rmsnorm"
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(52).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_logits_parity_with_hf_seed_oss():
+    """Seed-OSS routes to the Llama module: qkv biases with a SEPARATE
+    o_proj bias flag; nonzero residual_dropout is refused at import."""
+    torch = pytest.importorskip("torch")
+    from transformers import SeedOssConfig, SeedOssForCausalLM
+
+    hf_config = SeedOssConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, head_dim=16,
+        attention_bias=True, attention_out_bias=False, residual_dropout=0.0,
+        attention_dropout=0.0, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = SeedOssForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    assert "model.layers.0.self_attn.o_proj.bias" not in sd
+    with torch.no_grad():
+        for k, v in sd.items():
+            if k.endswith(".bias"):
+                v.copy_(torch.linspace(-0.2, 0.2, v.numel()))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.attention_bias and not cfg.attention_out_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(53).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError, match="residual_dropout"):
+        config_from_hf({**hf_config.to_dict(), "residual_dropout": 0.1})
