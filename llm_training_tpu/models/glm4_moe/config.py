@@ -36,8 +36,16 @@ class Glm4MoeConfig(BaseModelConfig):
     rope_scaling: dict[str, Any] | None = None
     partial_rotary_factor: float = 0.5
     attention_bias: bool = False
+    # dots1 biases o_proj with the SAME flag; GLM-4.5 never biases o_proj
+    attention_out_bias: bool = False
     attention_dropout: float = 0.0
-    use_qk_norm: bool = False  # per-head RMSNorm (GLM-4.5-Air)
+    use_qk_norm: bool = False  # per-head RMSNorm (GLM-4.5-Air; always on dots1)
+    # dots1: per-layer sliding/full attention (qwen2-style inverted pattern)
+    sliding_window: int | None = None
+    layer_types: list[str] | None = None
+    # which HF architecture this config round-trips as (the graphs overlap:
+    # dots1 == glm4_moe attention at partial_rotary 1.0 + the same V3 MoE)
+    hf_flavor: Literal["glm4_moe", "dots1"] = "glm4_moe"
 
     # --- DeepSeek-V3-style MoE (field names shared with DeepseekMoE)
     version: Literal[3] = 3  # sigmoid router + noaux bias, always
@@ -79,6 +87,20 @@ class Glm4MoeConfig(BaseModelConfig):
                 raise ValueError("n_routed_experts must divide into n_group groups")
             if self.topk_group is None:
                 raise ValueError("n_group requires topk_group")
+        if self.layer_types is not None:
+            if len(self.layer_types) != self.num_hidden_layers:
+                raise ValueError(
+                    f"layer_types has {len(self.layer_types)} entries for "
+                    f"{self.num_hidden_layers} layers"
+                )
+            bad = set(self.layer_types) - {"sliding_attention", "full_attention"}
+            if bad:
+                raise ValueError(
+                    f"unknown layer_types entries {sorted(bad)}; expected "
+                    "'sliding_attention' or 'full_attention'"
+                )
+            if "sliding_attention" in self.layer_types and not self.sliding_window:
+                raise ValueError("sliding layer_types require sliding_window")
         self.rope_config
         return self
 
@@ -98,9 +120,22 @@ class Glm4MoeConfig(BaseModelConfig):
     def layer_is_moe(self, layer_idx: int) -> bool:
         return layer_idx >= self.first_k_dense_replace
 
+    def layer_sliding_window(self, layer_idx: int) -> int | None:
+        if self.layer_types is None:
+            return self.sliding_window
+        if self.layer_types[layer_idx] == "sliding_attention":
+            return self.sliding_window
+        return None
+
     @property
     def num_scanned_layers(self) -> int:
-        """Depth of the scanned uniform MoE suffix (0 = loop everything)."""
+        """Depth of the scanned uniform MoE suffix (0 = loop everything).
+        A mixed sliding/full pattern over the suffix breaks its uniformity,
+        so those layers loop."""
         if not self.scan_layers:
+            return 0
+        if self.layer_types is not None and len(
+            set(self.layer_types[self.first_k_dense_replace:])
+        ) > 1:
             return 0
         return self.num_hidden_layers - self.first_k_dense_replace

@@ -38,6 +38,10 @@ _QK_NORMS = [
     (("self_attn", "k_norm", "weight"), "self_attn.k_norm.weight", False),
 ]
 
+_O_BIAS = [
+    (("self_attn", "o_proj", "bias"), "self_attn.o_proj.bias", False),
+]
+
 _DENSE_MLP = [
     (("mlp", "gate_proj", "kernel"), "mlp.gate_proj.weight", True),
     (("mlp", "up_proj", "kernel"), "mlp.up_proj.weight", True),
@@ -61,8 +65,11 @@ _EXPERT_PROJS = ("gate_proj", "up_proj", "down_proj")
 def _layer_params(config: Glm4MoeConfig, i: int) -> list:
     params = list(_ATTN)
     if config.attention_bias:
-        # HF gates q/k/v biases on attention_bias (o_proj stays bias-free)
+        # HF gates q/k/v biases on attention_bias (o_proj stays bias-free
+        # on GLM-4.5; dots1 biases it with the same flag)
         params += _ATTN_BIASES
+    if config.attention_out_bias:
+        params += _O_BIAS
     if config.use_qk_norm:
         params += _QK_NORMS
     if not config.layer_is_moe(i):
@@ -125,6 +132,67 @@ def params_to_hf(params: Mapping, config: Glm4MoeConfig) -> dict[str, np.ndarray
 
 
 def config_to_hf(config: Glm4MoeConfig, torch_dtype: str = "bfloat16") -> dict[str, Any]:
+    if config.hf_flavor == "dots1":
+        if config.partial_rotary_factor != 1.0 or not config.use_qk_norm:
+            raise ValueError(
+                "dots1 exports require partial_rotary_factor=1.0 and "
+                "use_qk_norm (the HF Dots1 graph hardcodes both)"
+            )
+        if config.attention_bias != config.attention_out_bias:
+            raise ValueError(
+                "HF Dots1 biases all four attention projections from ONE "
+                "attention_bias flag; asymmetric biases cannot be exported"
+            )
+        return {
+            "architectures": ["Dots1ForCausalLM"],
+            "model_type": "dots1",
+            "vocab_size": config.vocab_size,
+            "hidden_size": config.hidden_size,
+            "intermediate_size": config.intermediate_size,
+            "moe_intermediate_size": config.moe_intermediate_size,
+            "num_hidden_layers": config.num_hidden_layers,
+            "num_attention_heads": config.num_attention_heads,
+            "num_key_value_heads": config.num_key_value_heads,
+            "head_dim": config.head_dim,
+            "n_routed_experts": config.n_routed_experts,
+            "n_shared_experts": config.n_shared_experts,
+            "num_experts_per_tok": config.num_experts_per_tok,
+            "first_k_dense_replace": config.first_k_dense_replace,
+            "norm_topk_prob": config.norm_topk_prob,
+            "routed_scaling_factor": config.routed_scaling_factor,
+            "n_group": config.n_group,
+            "topk_group": config.topk_group,
+            "hidden_act": "silu",
+            "max_position_embeddings": config.max_position_embeddings,
+            "initializer_range": config.initializer_range,
+            "rms_norm_eps": config.rms_norm_eps,
+            "pad_token_id": config.pad_token_id,
+            "bos_token_id": config.bos_token_id,
+            "eos_token_id": config.eos_token_id,
+            "tie_word_embeddings": config.tie_word_embeddings,
+            "rope_theta": config.rope_theta,
+            "rope_scaling": config.rope_scaling,
+            "attention_bias": config.attention_bias,
+            "attention_dropout": config.attention_dropout,
+            "sliding_window": config.sliding_window,
+            "layer_types": (
+                list(config.layer_types)
+                if config.layer_types is not None
+                else ["full_attention"] * config.num_hidden_layers
+            ),
+            "use_cache": True,
+            "torch_dtype": torch_dtype,
+        }
+    if config.sliding_window is not None or config.layer_types is not None:
+        raise ValueError(
+            "HF glm4_moe has no sliding-window fields; set hf_flavor='dots1' "
+            "to export a windowed config"
+        )
+    if config.attention_out_bias:
+        raise ValueError(
+            "HF glm4_moe never biases o_proj; set hf_flavor='dots1' "
+            "(whose attention_bias covers all four projections)"
+        )
     return {
         "architectures": ["Glm4MoeForCausalLM"],
         "model_type": "glm4_moe",
@@ -167,6 +235,46 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> Glm4MoeConfig:
     get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, dict) else (
         lambda k, d=None: getattr(hf_config, k, d)
     )
+    if get("model_type") == "dots1":
+        # dots1 = the same graph at full rotary with always-on per-head
+        # qk-norm, ONE bias flag covering o_proj too, and qwen2-style
+        # per-layer sliding windows
+        layer_types = list(get("layer_types") or []) or None
+        if layer_types is None:
+            # replicate HF Dots1Config's derivation: layers from
+            # max_window_layers on slide, earlier ones are full
+            n_layers = get("num_hidden_layers")
+            mwl = get("max_window_layers", n_layers)
+            layer_types = [
+                "sliding_attention"
+                if get("sliding_window") is not None and i >= mwl
+                else "full_attention"
+                for i in range(n_layers)
+            ]
+        dots = dict(
+            partial_rotary_factor=1.0,
+            use_qk_norm=True,
+            attention_out_bias=get("attention_bias", False),
+            sliding_window=get("sliding_window"),
+            layer_types=layer_types,
+            norm_topk_prob=get("norm_topk_prob", False),
+            first_k_dense_replace=get("first_k_dense_replace", 0),
+            n_routed_experts=get("n_routed_experts"),
+            num_experts_per_tok=get("num_experts_per_tok"),
+            n_shared_experts=get("n_shared_experts"),
+            # Dots1Config has NO head_dim field; HF falls back to
+            # hidden_size // num_attention_heads
+            head_dim=(
+                get("head_dim")
+                or get("hidden_size") // get("num_attention_heads")
+            ),
+            hf_flavor="dots1",
+        )
+        # an all-full pattern folds to plain full attention
+        if set(dots["layer_types"]) == {"full_attention"}:
+            dots["layer_types"] = None
+            dots["sliding_window"] = None
+        overrides = {**dots, **overrides}
     return Glm4MoeConfig(**{**dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),

@@ -285,6 +285,7 @@ _ARCH_TO_FAMILY = {
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "glm4_moe": "llm_training_tpu.models.Glm4Moe",  # GLM-4.5: V3-style noaux MoE
+    "dots1": "llm_training_tpu.models.Glm4Moe",  # + full rotary, qk-norm, sliding pattern
     "deepseek_v2": "llm_training_tpu.models.Deepseek",  # MLA + grouped MoE
     "deepseek_v3": "llm_training_tpu.models.Deepseek",  # + sigmoid noaux routing
     "kimi_k2": "llm_training_tpu.models.Deepseek",  # Kimi-K2: V3 graph verbatim

@@ -569,7 +569,7 @@ def _check_exportable(config: LlamaConfig) -> None:
         )
     if (
         config.layer_types is not None and config.norm_scheme == "pre"
-        and (config.attention_bias or config.qk_norm)
+        and (config.attention_bias or config.attention_out_bias or config.qk_norm)
     ):
         raise ValueError(
             "a per-layer sliding/full pattern under pre-norm only exists as "
@@ -690,11 +690,18 @@ def _check_exportable(config: LlamaConfig) -> None:
     is_olmo3_pattern = (
         config.norm_scheme == "post" and config.qk_norm
         and config.qk_norm_scope == "full"
+        # HF OLMo-3 rotates sliding layers with the UNSCALED tables; a
+        # config trained with one shared scaled table would silently change
+        # semantics on reload
+        and (not config.rope_scaling or config.dual_local_rope)
     )
     is_ministral_pattern = (
         config.norm_scheme == "pre" and not config.qk_norm
-        and not config.attention_bias and config.norm_type == "rmsnorm"
+        and not config.attention_bias and not config.attention_out_bias
+        and config.norm_type == "rmsnorm"
         and config.mlp_type == "swiglu" and not config.rope_interleaved
+        # HF Ministral rotates every layer with ONE table
+        and (not config.rope_scaling or not config.dual_local_rope)
     )
     if config.layer_types is not None and not (
         is_olmo3_pattern or is_ministral_pattern

@@ -111,3 +111,66 @@ def test_e2e_fit_decreases_loss():
         max_steps=20, lr=3e-3,
     )
     assert np.mean(losses[-3:]) < np.mean(losses[:3])
+
+
+def test_logits_parity_with_hf_dots1():
+    """dots1 routes to the Glm4Moe module: the same V3-style noaux MoE with
+    full-rotary attention, ALWAYS-ON per-head qk-norm, one bias flag
+    covering o_proj too, and a qwen2-style per-layer sliding pattern."""
+    torch = pytest.importorskip("torch")
+    from transformers import Dots1Config, Dots1ForCausalLM
+
+    kwargs = dict(TINY)
+    kwargs.pop("compute_dtype")
+    hf_config = Dots1Config(
+        **kwargs, attention_bias=True, sliding_window=8,
+        max_window_layers=1,  # layer 0 full, layer 1 sliding
+        attn_implementation="eager",
+    )
+    assert hf_config.layer_types == ["full_attention", "sliding_attention"]
+    torch.manual_seed(0)
+    hf_model = Dots1ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.self_attn.o_proj.bias" in sd
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    # salt zero-init biases + the noaux bias so both are LIVE
+    with torch.no_grad():
+        for k, v in sd.items():
+            if k.endswith(".bias"):
+                v.copy_(torch.linspace(-0.2, 0.2, v.numel()))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32", moe_impl="dense")
+    assert cfg.hf_flavor == "dots1" and cfg.use_qk_norm
+    assert cfg.partial_rotary_factor == 1.0 and cfg.attention_out_bias
+    assert cfg.layer_types == ["full_attention", "sliding_attention"]
+    # the MoE suffix (layer 1) is uniformly sliding, so it still scans;
+    # only a MIXED suffix forces the loop
+    assert cfg.num_scanned_layers == 1
+    params = params_from_hf(sd, cfg)
+    model = Glm4Moe(cfg)
+
+    ids = np.random.default_rng(60).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_dots1_config_round_trip():
+    cfg = Glm4MoeConfig(
+        **{**TINY, "partial_rotary_factor": 1.0}, use_qk_norm=True,
+        attention_bias=True, attention_out_bias=True,
+        sliding_window=8, layer_types=["full_attention", "sliding_attention"],
+        hf_flavor="dots1",
+    )
+    hf = config_to_hf(cfg)
+    assert hf["model_type"] == "dots1"
+    cfg2 = config_from_hf(hf, compute_dtype="float32")
+    assert cfg2.model_dump() == cfg.model_dump()
+
+
+def test_glm4_moe_export_refuses_dots_features():
+    cfg = Glm4MoeConfig(**TINY, sliding_window=8,
+                        layer_types=["sliding_attention", "sliding_attention"])
+    with pytest.raises(ValueError, match="dots1"):
+        config_to_hf(cfg)
