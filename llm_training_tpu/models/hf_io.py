@@ -298,6 +298,7 @@ _ARCH_TO_FAMILY = {
     "qwen2_moe": "llm_training_tpu.models.Llama",
     "qwen3_moe": "llm_training_tpu.models.Llama",
     "olmoe": "llm_training_tpu.models.Llama",  # full qk-norm + qwen-style MoE
+    "flex_olmo": "llm_training_tpu.models.Llama",  # OLMoE MoE under olmo2 post-norm
     "phi3": "llm_training_tpu.models.Phi3",
     "gemma": "llm_training_tpu.models.Gemma",
     "gemma2": "llm_training_tpu.models.Gemma",  # version=2 graph features

@@ -984,7 +984,27 @@ def _moe_to_hf(config: LlamaConfig) -> dict[str, Any]:
             **common,
         }
     if config.qk_norm and config.qk_norm_scope == "full":
-        # full-width qk-norm + qwen-style experts only exist as OLMoE in HF
+        # full-width qk-norm + qwen-style experts exist as OLMoE (pre-norm)
+        # or FlexOlmo (post-norm blocks) in HF
+        if config.norm_scheme == "post":
+            if config.clip_qkv is not None:
+                raise ValueError(
+                    "HF FlexOlmo has no clip_qkv; exporting would silently "
+                    "drop the clamp (OLMoE, the pre-norm variant, has it)"
+                )
+            if config.layer_types is not None:
+                raise ValueError(
+                    "HF FlexOlmo has no per-layer sliding pattern; exporting "
+                    "would silently drop layer_types"
+                )
+            return {
+                "model_type": "flex_olmo",
+                "architectures": ["FlexOlmoForCausalLM"],
+                "num_experts": config.num_experts,
+                "intermediate_size": config.moe_intermediate_size,
+                "norm_topk_prob": config.norm_topk_prob,
+                **common,
+            }
         return {
             "model_type": "olmoe",
             "architectures": ["OlmoeForCausalLM"],
@@ -1098,9 +1118,10 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             moe_style="mixtral",
             router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
         )
-    elif model_type == "olmoe":
-        # OLMoE: qwen-style expert naming, no shared expert, and HF's
-        # intermediate_size IS the per-expert width
+    elif model_type in ("olmoe", "flex_olmo"):
+        # OLMoE / FlexOlmo: qwen-style expert naming, no shared expert, and
+        # HF's intermediate_size IS the per-expert width (FlexOlmo is the
+        # post-norm variant)
         moe = dict(
             num_experts=get("num_experts"),
             num_experts_per_tok=get("num_experts_per_tok", 8),
@@ -1202,16 +1223,17 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         qk_norm=(
             get("use_qk_norm", False) if model_type == "cohere"
             else model_type in ("qwen3", "olmo2", "olmo3", "qwen3_moe",
-                                "olmoe", "hunyuan_v1_dense")
+                                "olmoe", "flex_olmo", "hunyuan_v1_dense")
         ),
         qk_norm_position=(
             "post_rope" if model_type == "hunyuan_v1_dense" else "pre_rope"
         ),
         qk_norm_scope=(
-            "full" if model_type in ("olmo2", "olmo3", "olmoe") else "head"
+            "full" if model_type in ("olmo2", "olmo3", "olmoe",
+                                     "flex_olmo") else "head"
         ),
         norm_scheme=(
-            "post" if model_type in ("olmo2", "olmo3")
+            "post" if model_type in ("olmo2", "olmo3", "flex_olmo")
             else "parallel" if model_type in ("cohere", "phi")
             else "sandwich" if model_type == "glm4"
             else "pre"

@@ -348,3 +348,48 @@ def test_logits_parity_with_hf_olmoe():
     cfg, _, _ = _parity(hf_model, hf_config, seed=22)
     assert cfg.qk_norm_scope == "full" and cfg.norm_scheme == "pre"
     assert cfg.moe_intermediate_size == 48 and cfg.clip_qkv == 3.0
+
+
+def test_logits_parity_with_hf_flex_olmo():
+    """FlexOlmo routes to the Llama module: OLMo-2 post-norm blocks +
+    full-width qk-norm composed with the OLMoE-style sparse MoE (softmax
+    top-k over qwen-named experts, intermediate_size = per-expert width)."""
+    torch = pytest.importorskip("torch")
+    from transformers import FlexOlmoConfig, FlexOlmoForCausalLM
+
+    from llm_training_tpu.models.llama.hf_conversion import (
+        config_from_hf,
+        config_to_hf,
+        params_from_hf,
+    )
+
+    hf_config = FlexOlmoConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        norm_topk_prob=False, pad_token_id=0, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = FlexOlmoForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.post_feedforward_layernorm.weight" in sd
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    assert "model.layers.0.mlp.experts.3.gate_proj.weight" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32", moe_impl="dense")
+    assert cfg.norm_scheme == "post" and cfg.qk_norm_scope == "full"
+    assert cfg.num_experts == 4 and cfg.moe_intermediate_size == 48
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(61).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+    # export picks flex_olmo (post-norm), not olmoe
+    out = config_to_hf(cfg)
+    assert out["model_type"] == "flex_olmo"
+    cfg2 = config_from_hf(out, compute_dtype="float32")
+    assert cfg2.norm_scheme == "post" and cfg2.num_experts == 4
