@@ -107,9 +107,14 @@ class LlamaConfig(BaseModelConfig):
     norm_topk_prob: bool = True
     shared_expert_intermediate_size: int | None = None  # Qwen2-MoE
     router_aux_loss_coef: float = 0.001
-    # conversion/export naming: 'qwen' (mlp.experts.{i}.gate_proj) vs
-    # 'mixtral' (block_sparse_moe.experts.{i}.w1/w3/w2)
-    moe_style: Literal["qwen", "mixtral"] = "qwen"
+    # conversion/export naming: 'qwen' (mlp.experts.{i}.gate_proj),
+    # 'mixtral' (block_sparse_moe.experts.{i}.w1/w3/w2), or 'granite'
+    # (block_sparse_moe.input_linear [E, 2I, H] fused gate/up stacks +
+    # router.layer)
+    moe_style: Literal["qwen", "mixtral", "granite"] = "qwen"
+    # qwen2-moe gates the shared expert with a per-token sigmoid;
+    # granitemoeshared runs it always-on (no gate parameter)
+    shared_expert_gated: bool = True
     # 'ragged' = dropless grouped matmul (lax.ragged_dot, the TPU training
     # path); 'dense' = every expert on every token (exact, for parity
     # tests); 'bucketed' = fixed per-expert capacity buckets + ONE dense
@@ -153,6 +158,18 @@ class LlamaConfig(BaseModelConfig):
         if self.num_experts is not None:
             if self.mlp_type != "swiglu":
                 raise ValueError("MoE layers only support the swiglu mlp_type")
+            if (
+                self.moe_style == "granite"
+                and self.shared_expert_intermediate_size
+                and self.shared_expert_gated
+            ):
+                # the granite conversion layout has no gate tensor; a gated
+                # shared expert would silently drop its weight on export
+                raise ValueError(
+                    "moe_style='granite' shared experts are always-on; set "
+                    "shared_expert_gated=False (granitemoeshared has no "
+                    "shared gate parameter)"
+                )
             if self.moe_intermediate_size is None:
                 raise ValueError("num_experts requires moe_intermediate_size")
             if not 0 < self.num_experts_per_tok <= self.num_experts:

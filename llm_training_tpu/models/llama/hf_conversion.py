@@ -203,17 +203,21 @@ _MOE_SHARED = ("gate_proj", "up_proj", "down_proj")
 def _moe_key_set(config: LlamaConfig) -> list:
     """The in-layer paths `_moe_layer_parts` produces, without reading any
     weights — key enumeration for lazy (thunk-based) conversion callers."""
-    prefix, names = _MOE_EXPERT_NAMES[config.moe_style]
     keys = [("mlp", "gate", "kernel")]
-    keys += [("mlp", f"experts_{ours}") for ours in names]
+    keys += [("mlp", f"experts_{ours}") for ours in _MOE_SHARED]
     if config.shared_expert_intermediate_size:
         keys += [("mlp", f"shared_{ours}") for ours in _MOE_SHARED]
-        keys.append(("mlp", "shared_expert_gate"))
+        # configs outside the Llama family (qwen3-next, minimax) predate
+        # the granite knob and are always gated
+        if getattr(config, "shared_expert_gated", True):
+            keys.append(("mlp", "shared_expert_gate"))
     return keys
 
 
 def _moe_layer_parts(sd: Mapping, config: LlamaConfig, i: int) -> dict:
     """HF keys for layer i's MoE block -> {our in-layer path: array}."""
+    if config.moe_style == "granite":
+        return _granite_moe_layer_parts(sd, config, i)
     prefix, names = _MOE_EXPERT_NAMES[config.moe_style]
     parts = {
         ("mlp", "gate", "kernel"): _to_numpy(sd[f"layers.{i}.{prefix}.gate.weight"]).T,
@@ -234,8 +238,41 @@ def _moe_layer_parts(sd: Mapping, config: LlamaConfig, i: int) -> dict:
     return parts
 
 
+def _granite_moe_layer_parts(sd: Mapping, config: LlamaConfig, i: int) -> dict:
+    """GraniteMoe stores the experts PRE-stacked and gate/up PRE-fused:
+    input_linear [E, 2I, H] (gate rows first — HF chunks the output in
+    halves, act(chunk0) * chunk1) and output_linear [E, H, I]; the router
+    kernel lives under router.layer. The shared MLP (granitemoeshared) is
+    the same fused layout, unstacked."""
+    inter = config.moe_intermediate_size
+    fused = _to_numpy(sd[f"layers.{i}.block_sparse_moe.input_linear.weight"])
+    down = _to_numpy(sd[f"layers.{i}.block_sparse_moe.output_linear.weight"])
+    parts = {
+        ("mlp", "gate", "kernel"): _to_numpy(
+            sd[f"layers.{i}.block_sparse_moe.router.layer.weight"]
+        ).T,
+        # [E, 2I, H] -> [E, H, I] kernels
+        ("mlp", "experts_gate_proj"): fused[:, :inter, :].transpose(0, 2, 1),
+        ("mlp", "experts_up_proj"): fused[:, inter:, :].transpose(0, 2, 1),
+        # [E, H, I] -> [E, I, H]
+        ("mlp", "experts_down_proj"): down.transpose(0, 2, 1),
+    }
+    if config.shared_expert_intermediate_size:
+        si = config.shared_expert_intermediate_size
+        sh_fused = _to_numpy(sd[f"layers.{i}.shared_mlp.input_linear.weight"])
+        parts[("mlp", "shared_gate_proj")] = sh_fused[:si].T
+        parts[("mlp", "shared_up_proj")] = sh_fused[si:].T
+        parts[("mlp", "shared_down_proj")] = _to_numpy(
+            sd[f"layers.{i}.shared_mlp.output_linear.weight"]
+        ).T
+    return parts
+
+
 def _moe_layer_out(get, config: LlamaConfig, i: int, out: dict) -> None:
     """Inverse of _moe_layer_parts: `get(path)` reads our layer-i tree."""
+    if config.moe_style == "granite":
+        _granite_moe_layer_out(get, config, i, out)
+        return
     prefix, names = _MOE_EXPERT_NAMES[config.moe_style]
     out[f"model.layers.{i}.{prefix}.gate.weight"] = get(("mlp", "gate", "kernel")).T
     for ours, hf in names.items():
@@ -249,6 +286,25 @@ def _moe_layer_out(get, config: LlamaConfig, i: int, out: dict) -> None:
             ).T
         out[f"model.layers.{i}.mlp.shared_expert_gate.weight"] = get(
             ("mlp", "shared_expert_gate")
+        ).T
+
+
+def _granite_moe_layer_out(get, config: LlamaConfig, i: int, out: dict) -> None:
+    p = f"model.layers.{i}"
+    out[f"{p}.block_sparse_moe.router.layer.weight"] = get(("mlp", "gate", "kernel")).T
+    gate = get(("mlp", "experts_gate_proj"))  # [E, H, I]
+    up = get(("mlp", "experts_up_proj"))
+    down = get(("mlp", "experts_down_proj"))  # [E, I, H]
+    out[f"{p}.block_sparse_moe.input_linear.weight"] = np.concatenate(
+        [gate.transpose(0, 2, 1), up.transpose(0, 2, 1)], axis=1
+    )
+    out[f"{p}.block_sparse_moe.output_linear.weight"] = down.transpose(0, 2, 1)
+    if config.shared_expert_intermediate_size:
+        out[f"{p}.shared_mlp.input_linear.weight"] = np.concatenate(
+            [get(("mlp", "shared_gate_proj")).T, get(("mlp", "shared_up_proj")).T]
+        )
+        out[f"{p}.shared_mlp.output_linear.weight"] = get(
+            ("mlp", "shared_down_proj")
         ).T
 
 
@@ -948,14 +1004,7 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
         # expects (its config has no "default scale" sentinel)
         **(
             {"model_type": "granite", "architectures": ["GraniteForCausalLM"],
-             "embedding_multiplier": config.embedding_multiplier,
-             "attention_multiplier": (
-                 config.attention_multiplier
-                 if config.attention_multiplier is not None
-                 else config.resolved_head_dim ** -0.5
-             ),
-             "residual_multiplier": config.residual_multiplier,
-             "logits_scaling": config.logits_scaling}
+             **_granite_multipliers(config)}
             if (config.embedding_multiplier != 1.0
                 or config.attention_multiplier is not None
                 or config.residual_multiplier != 1.0
@@ -963,6 +1012,22 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             else {}
         ),
         **_moe_to_hf(config),
+    }
+
+
+def _granite_multipliers(config: LlamaConfig) -> dict[str, Any]:
+    """Granite-family scalar multipliers, each explicit: HF defaults them
+    all to 1.0 (including the attention scale), and our None scale means
+    the standard 1/sqrt(head_dim)."""
+    return {
+        "embedding_multiplier": config.embedding_multiplier,
+        "attention_multiplier": (
+            config.attention_multiplier
+            if config.attention_multiplier is not None
+            else config.resolved_head_dim ** -0.5
+        ),
+        "residual_multiplier": config.residual_multiplier,
+        "logits_scaling": config.logits_scaling,
     }
 
 
@@ -974,6 +1039,31 @@ def _moe_to_hf(config: LlamaConfig) -> dict[str, Any]:
         "router_aux_loss_coef": config.router_aux_loss_coef,
         "output_router_logits": False,
     }
+    if config.moe_style == "granite":
+        if not config.norm_topk_prob:
+            raise ValueError(
+                "GraniteMoe's softmax-after-topk routing implies "
+                "norm_topk_prob=True; an unrenormalized config cannot be "
+                "exported as granitemoe"
+            )
+        shared = config.shared_expert_intermediate_size
+        return {
+            "model_type": "granitemoeshared" if shared else "granitemoe",
+            "architectures": [
+                "GraniteMoeSharedForCausalLM" if shared
+                else "GraniteMoeForCausalLM"
+            ],
+            "num_local_experts": config.num_experts,
+            "intermediate_size": config.moe_intermediate_size,
+            **_granite_multipliers(config),
+            **({"shared_intermediate_size": shared} if shared else {}),
+            **common,
+        }
+    if config.shared_expert_intermediate_size and not config.shared_expert_gated:
+        raise ValueError(
+            "an UNGATED shared expert only exists as granitemoeshared in "
+            "HF; set moe_style='granite' to export it"
+        )
     if config.moe_style == "mixtral":
         return {
             "model_type": "mixtral",
@@ -1129,6 +1219,24 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             norm_topk_prob=get("norm_topk_prob", False),
             router_aux_loss_coef=get("router_aux_loss_coef", 0.01),
         )
+    elif model_type in ("granitemoe", "granitemoeshared"):
+        # GraniteMoe: pre-stacked fused experts + router.layer naming; its
+        # softmax-AFTER-topk routing is numerically identical to our
+        # softmax -> topk -> renormalize (norm_topk_prob) path, since topk
+        # by logits == topk by probs and renormalizing full-softmax probs
+        # over the selected set recovers softmax over the selected logits.
+        # HF intermediate_size is the per-expert width; the shared MLP
+        # (granitemoeshared) is always-on (no sigmoid gate parameter)
+        moe = dict(
+            num_experts=get("num_local_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 8),
+            moe_intermediate_size=get("intermediate_size"),
+            norm_topk_prob=True,
+            moe_style="granite",
+            router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+            shared_expert_intermediate_size=get("shared_intermediate_size"),
+            shared_expert_gated=False,
+        )
     elif model_type in ("qwen2_moe", "qwen3_moe"):
         if get("decoder_sparse_step", 1) != 1 or get("mlp_only_layers"):
             raise ValueError(
@@ -1273,7 +1381,9 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # so the standard 1/sqrt(head_dim) applies.
         embedding_multiplier=get("embedding_multiplier", 1.0),
         attention_multiplier=(
-            get("attention_multiplier") if model_type == "granite" else None
+            get("attention_multiplier")
+            if model_type in ("granite", "granitemoe", "granitemoeshared")
+            else None
         ),
         residual_multiplier=get("residual_multiplier", 1.0),
         logits_scaling=get("logits_scaling", 1.0),

@@ -386,7 +386,8 @@ class MoEMLP(nn.Module):
             moe_capacity_factor=getattr(cfg, "moe_capacity_factor", 1.25),
         )
 
-        # ---- shared expert (Qwen2-MoE): dense SwiGLU + per-token sigmoid gate
+        # ---- shared expert: dense SwiGLU, gated per token by a sigmoid
+        # (Qwen2-MoE) or always-on (granitemoeshared)
         if cfg.shared_expert_intermediate_size:
             xc = x.astype(compute_dtype)
             si = cfg.shared_expert_intermediate_size
@@ -394,15 +395,17 @@ class MoEMLP(nn.Module):
             sw_up = expert_param("shared_up_proj", (embed, si), ("embed", "mlp"))
             sw_down = expert_param("shared_down_proj", (si, embed), ("mlp", "embed"))
             shared = (nn.silu(xc @ sw_gate) * (xc @ sw_up)) @ sw_down
-            gate_w = self.param(
-                "shared_expert_gate",
-                nn.with_logical_partitioning(
-                    nn.initializers.normal(cfg.initializer_range), ("embed", None)
-                ),
-                (embed, 1),
-                param_dtype,
-            ).astype(compute_dtype)
-            out = out + jax.nn.sigmoid(xc @ gate_w) * shared
+            if getattr(cfg, "shared_expert_gated", True):
+                gate_w = self.param(
+                    "shared_expert_gate",
+                    nn.with_logical_partitioning(
+                        nn.initializers.normal(cfg.initializer_range), ("embed", None)
+                    ),
+                    (embed, 1),
+                    param_dtype,
+                ).astype(compute_dtype)
+                shared = jax.nn.sigmoid(xc @ gate_w) * shared
+            out = out + shared
 
         # ---- router statistics for the load-balancing loss (fp32),
         # excluding padding tokens

@@ -393,3 +393,85 @@ def test_logits_parity_with_hf_flex_olmo():
     assert out["model_type"] == "flex_olmo"
     cfg2 = config_from_hf(out, compute_dtype="float32")
     assert cfg2.norm_scheme == "post" and cfg2.num_experts == 4
+
+
+@pytest.mark.parametrize("shared", [False, True])
+def test_logits_parity_with_hf_granitemoe(shared):
+    """GraniteMoe routes to the Llama module: granite scalar multipliers +
+    a PRE-stacked fused-expert MoE (input_linear [E, 2I, H], gate rows
+    first; router under router.layer). Its softmax-after-topk routing is
+    numerically identical to our softmax->topk->renormalize path. The
+    shared variant adds an always-on (gate-free) shared MLP."""
+    torch = pytest.importorskip("torch")
+    if shared:
+        from transformers import GraniteMoeSharedConfig as HFConfig
+        from transformers import GraniteMoeSharedForCausalLM as HFModel
+        extra = dict(shared_intermediate_size=40)
+    else:
+        from transformers import GraniteMoeConfig as HFConfig
+        from transformers import GraniteMoeForCausalLM as HFModel
+        extra = {}
+
+    from llm_training_tpu.models.llama.hf_conversion import config_to_hf
+
+    hf_config = HFConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4, num_experts_per_tok=2,
+        # non-identity multipliers so the granite scalars are LIVE
+        embedding_multiplier=6.0, attention_multiplier=0.2,
+        residual_multiplier=0.5, logits_scaling=2.0,
+        attn_implementation="eager", **extra,
+    )
+    torch.manual_seed(0)
+    hf_model = HFModel(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.block_sparse_moe.input_linear.weight" in sd
+    assert "model.layers.0.block_sparse_moe.router.layer.weight" in sd
+    if shared:
+        assert "model.layers.0.shared_mlp.input_linear.weight" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32", moe_impl="dense")
+    assert cfg.moe_style == "granite" and cfg.norm_topk_prob
+    assert not cfg.shared_expert_gated
+    assert cfg.attention_multiplier == 0.2 and cfg.residual_multiplier == 0.5
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(62).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=3e-4, atol=3e-4)
+
+    out = config_to_hf(cfg)
+    expected = "granitemoeshared" if shared else "granitemoe"
+    assert out["model_type"] == expected
+    assert out["attention_multiplier"] == 0.2
+    cfg2 = config_from_hf(out, compute_dtype="float32", moe_impl="dense")
+    # export emits head_dim explicitly (HF GraniteMoe derives it), so the
+    # reimport carries the resolved value rather than None
+    assert cfg2.resolved_head_dim == cfg.resolved_head_dim
+    assert cfg2.model_dump() == {**cfg.model_dump(), "head_dim": cfg2.head_dim}
+
+
+def test_granitemoe_state_dict_round_trip():
+    """params -> HF -> params is exact through the fused-stack layout."""
+    torch = pytest.importorskip("torch")
+    from transformers import GraniteMoeSharedConfig, GraniteMoeSharedForCausalLM
+
+    hf_config = GraniteMoeSharedConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4, num_experts_per_tok=2,
+        shared_intermediate_size=40, attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    hf_model = GraniteMoeSharedForCausalLM(hf_config).eval()
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    params = params_from_hf(hf_model.state_dict(), cfg)
+    back = params_to_hf(params, cfg)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    assert set(back) == set(sd)
+    for key in sd:
+        np.testing.assert_array_equal(back[key], sd[key], err_msg=key)
