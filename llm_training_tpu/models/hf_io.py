@@ -273,6 +273,7 @@ _ARCH_TO_FAMILY = {
     "olmo3": "llm_training_tpu.models.Llama",  # + per-layer sliding, dual rope
     "granite": "llm_training_tpu.models.Llama",  # + 4 scalar multipliers
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
+    "stablelm": "llm_training_tpu.models.Llama",  # biased LayerNorm + swiglu + partial rope
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
     "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
     "nemotron": "llm_training_tpu.models.Llama",  # layernorm1p + relu^2 MLP

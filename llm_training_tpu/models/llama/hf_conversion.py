@@ -594,12 +594,28 @@ def _check_exportable(config: LlamaConfig) -> None:
             )
         return  # the gpt2 export path handles everything else
     ln_gelu = config.norm_type == "layernorm" and config.mlp_type == "gelu"
+    # biased LayerNorm with a SWIGLU mlp exists as StableLM in HF
+    # (pre-norm, bias-free o_proj, optional qkv bias, partial rotary)
+    is_stablelm = (
+        config.norm_type == "layernorm" and config.mlp_type == "swiglu"
+        and config.norm_scheme == "pre" and not config.qk_norm
+        and not config.attention_out_bias and not config.mlp_bias
+        and not config.rope_interleaved and config.num_experts is None
+        # StableLM has no sliding windows, layer patterns, or granite
+        # multipliers; any of those riding along would be silently dropped
+        and config.sliding_window is None and config.layer_types is None
+        and config.embedding_multiplier == 1.0
+        and config.attention_multiplier is None
+        and config.residual_multiplier == 1.0
+        and config.logits_scaling == 1.0
+    )
     if (config.mlp_type == "gelu") != ln_gelu or (
-        config.norm_type == "layernorm"
-    ) != ln_gelu:
+        (config.norm_type == "layernorm") != ln_gelu and not is_stablelm
+    ):
         raise ValueError(
             "mlp_type='gelu' and norm_type='layernorm' only exist together "
-            "(as Starcoder2 or Phi) in HF; this combination cannot be exported"
+            "(as Starcoder2 or Phi) in HF — except biased LayerNorm with "
+            "swiglu, which is StableLM; this combination cannot be exported"
         )
     is_nemotron = (
         config.norm_type == "layernorm1p" and config.mlp_type == "relu2"
@@ -717,7 +733,9 @@ def _check_exportable(config: LlamaConfig) -> None:
             "logit_scale only exists in HF on Cohere; it would be silently "
             "dropped by any other export"
         )
-    if config.partial_rotary_factor != 1.0 and not (is_phi or is_glm or is_nemotron):
+    if config.partial_rotary_factor != 1.0 and not (
+        is_phi or is_glm or is_nemotron or is_stablelm
+    ):
         raise ValueError(
             "partial_rotary_factor only exists in HF on Phi, GLM/GLM-4, and "
             "Nemotron; it would be silently dropped otherwise"
@@ -989,6 +1007,18 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
             and config.norm_scheme == "pre"
             else {}
         ),
+        # biased LayerNorm + swiglu only exists as StableLM in HF
+        **(
+            {"model_type": "stablelm", "architectures": ["StableLmForCausalLM"],
+             "layer_norm_eps": config.rms_norm_eps,
+             "partial_rotary_factor": config.partial_rotary_factor,
+             "use_qkv_bias": config.attention_bias,
+             "qk_layernorm": False,
+             "use_parallel_residual": False,
+             "hidden_act": "silu"}
+            if config.norm_type == "layernorm" and config.mlp_type == "swiglu"
+            else {}
+        ),
         # per-layer NoPE only exists as SmolLM3 in HF
         **(
             {"model_type": "smollm3", "architectures": ["SmolLM3ForCausalLM"],
@@ -1187,6 +1217,19 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                     f"phi {drop}={get(drop)} is not supported: dropout is not "
                     "implemented — override it to 0.0 to fine-tune without it"
                 )
+    if model_type == "stablelm":
+        if get("qk_layernorm", False):
+            raise ValueError("stablelm qk_layernorm=True is not supported")
+        if get("use_parallel_residual", False):
+            raise ValueError(
+                "stablelm use_parallel_residual=True (gpt-neox style) is "
+                "not supported; sequential StableLM-2 checkpoints are"
+            )
+        if get("hidden_dropout", 0.0):
+            raise ValueError(
+                f"stablelm hidden_dropout={get('hidden_dropout')} is not "
+                "supported: dropout is not implemented"
+            )
     if model_type == "seed_oss" and get("residual_dropout", 0.0):
         raise ValueError(
             f"seed_oss residual_dropout={get('residual_dropout')} is not "
@@ -1229,7 +1272,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # (granitemoeshared) is always-on (no sigmoid gate parameter)
         moe = dict(
             num_experts=get("num_local_experts"),
-            num_experts_per_tok=get("num_experts_per_tok", 8),
+            num_experts_per_tok=get("num_experts_per_tok", 2),
             moe_intermediate_size=get("intermediate_size"),
             norm_topk_prob=True,
             moe_style="granite",
@@ -1267,7 +1310,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         initializer_range=get("initializer_range", 0.02),
         rms_norm_eps=(
             get("norm_epsilon", 1e-5) if model_type == "starcoder2"
-            else get("layer_norm_eps", 1e-5) if model_type in ("cohere", "phi")
+            else get("layer_norm_eps", 1e-5)
+            if model_type in ("cohere", "phi", "stablelm")
             else get("norm_eps", 1e-5) if model_type == "nemotron"
             else get("rms_norm_eps", 1e-6)
         ),
@@ -1283,6 +1327,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             get("use_bias", True) if model_type == "starcoder2"
             else True if model_type == "phi"
             else get("use_bias", False) if model_type == "ernie4_5"
+            else get("use_qkv_bias", False) if model_type == "stablelm"
             else get("attention_bias")
             if get("attention_bias") is not None
             else model_type in ("qwen2", "qwen2_moe")
@@ -1293,8 +1338,9 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             else get("use_bias", False) if model_type == "ernie4_5"
             # Seed-OSS carries an explicit separate o_proj flag
             else get("attention_out_bias", False) if model_type == "seed_oss"
-            # GLM biases q/k/v but never o_proj; Helium hardcodes o bias off
-            else False if model_type in ("glm", "glm4", "helium")
+            # GLM biases q/k/v but never o_proj; Helium and StableLM
+            # hardcode the o bias off
+            else False if model_type in ("glm", "glm4", "helium", "stablelm")
             else False
             if model_type in ("qwen2", "qwen2_moe") and get("attention_bias") is None
             else (get("attention_bias") or False)
@@ -1351,7 +1397,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # q/k/v/o AND the MLP projections. Cohere: weight-only mean-centered
         # norm, parallel blocks, interleaved rope, multiplicative logit scale.
         norm_type=(
-            "layernorm" if model_type in ("starcoder2", "phi")
+            "layernorm" if model_type in ("starcoder2", "phi", "stablelm")
             else "layernorm_nobias" if model_type == "cohere"
             else "layernorm1p" if model_type == "nemotron"
             else "rmsnorm"
@@ -1366,6 +1412,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         partial_rotary_factor=(
             get("partial_rotary_factor", 0.5)
             if model_type in ("phi", "glm", "glm4", "nemotron")
+            else get("partial_rotary_factor", 0.25)
+            if model_type == "stablelm"
             else 1.0
         ),
         lm_head_bias=(model_type == "phi"),

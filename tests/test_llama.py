@@ -9,6 +9,7 @@ import pytest
 from llm_training_tpu.models import Llama, LlamaConfig
 from llm_training_tpu.models.llama.hf_conversion import (
     config_from_hf,
+    config_to_hf,
     params_from_hf,
     params_to_hf,
 )
@@ -1085,3 +1086,52 @@ def test_logits_parity_with_hf_seed_oss():
 
     with pytest.raises(ValueError, match="residual_dropout"):
         config_from_hf({**hf_config.to_dict(), "residual_dropout": 0.1})
+
+
+def test_logits_parity_with_hf_stablelm():
+    """StableLM routes to the Llama module: biased LayerNorm pre-norm
+    blocks with a SWIGLU MLP, partial rotary 0.25, optional qkv biases
+    (o_proj hardcoded bias-free)."""
+    torch = pytest.importorskip("torch")
+    from transformers import StableLmConfig, StableLmForCausalLM
+
+    hf_config = StableLmConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_qkv_bias=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = StableLmForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.input_layernorm.bias" in sd
+    assert "model.layers.0.self_attn.q_proj.bias" in sd
+    assert "model.layers.0.self_attn.o_proj.bias" not in sd
+    assert "model.layers.0.mlp.gate_proj.weight" in sd
+    # salt zero-init biases so a bias-dropping conversion cannot pass
+    with torch.no_grad():
+        for k, v in sd.items():
+            if k.endswith(".bias"):
+                v.copy_(torch.linspace(-0.2, 0.2, v.numel()))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_type == "layernorm" and cfg.mlp_type == "swiglu"
+    assert cfg.partial_rotary_factor == 0.25
+    assert cfg.attention_bias and not cfg.attention_out_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(54).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+    # export picks stablelm; round trip preserves the graph knobs
+    out = config_to_hf(cfg)
+    assert out["model_type"] == "stablelm" and out["use_qkv_bias"]
+    cfg2 = config_from_hf(out, compute_dtype="float32")
+    assert cfg2.norm_type == "layernorm" and cfg2.partial_rotary_factor == 0.25
+
+    with pytest.raises(ValueError, match="parallel_residual"):
+        config_from_hf({**hf_config.to_dict(), "use_parallel_residual": True})
