@@ -283,6 +283,7 @@ _ARCH_TO_FAMILY = {
     "hunyuan_v1_moe": "llm_training_tpu.models.HunYuanMoe",  # + softmax top-k MoE
     "gpt2": "llm_training_tpu.models.Llama",  # learned positions, fused qkv
     "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
+    "exaone4": "llm_training_tpu.models.Llama",  # post-norm + head qk-norm + hybrid NoPE
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "glm4_moe": "llm_training_tpu.models.Glm4Moe",  # GLM-4.5: V3-style noaux MoE

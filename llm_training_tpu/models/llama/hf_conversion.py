@@ -769,6 +769,35 @@ def _check_exportable(config: LlamaConfig) -> None:
         # semantics on reload
         and (not config.rope_scaling or config.dual_local_rope)
     )
+    is_exaone4_pattern = (
+        config.norm_scheme == "post" and config.qk_norm
+        and config.qk_norm_scope == "head" and not config.attention_bias
+        and not config.attention_out_bias
+        and config.num_experts is None
+        # HF EXAONE-4 rotates with ONE table (sliding layers included)
+        and (not config.rope_scaling or not config.dual_local_rope)
+        # EXAONE-4's hybrid NoPE is DERIVED: full-attention layers skip
+        # rope; an arbitrary no_rope pattern cannot ride this export
+        and (
+            config.no_rope_layers is None
+            or (
+                config.layer_types is not None
+                and config.no_rope_layers == [
+                    1 if lt == "sliding_attention" else 0
+                    for lt in config.layer_types
+                ]
+            )
+        )
+    )
+    if (
+        config.norm_scheme == "post" and config.qk_norm
+        and config.qk_norm_scope == "head" and not is_exaone4_pattern
+    ):
+        raise ValueError(
+            "post-norm blocks with per-head qk-norm only exist in HF as "
+            "EXAONE-4 (bias-free, single rope table, derived NoPE); this "
+            "combination cannot be exported"
+        )
     is_ministral_pattern = (
         config.norm_scheme == "pre" and not config.qk_norm
         and not config.attention_bias and not config.attention_out_bias
@@ -778,21 +807,26 @@ def _check_exportable(config: LlamaConfig) -> None:
         and (not config.rope_scaling or not config.dual_local_rope)
     )
     if config.layer_types is not None and not (
-        is_olmo3_pattern or is_ministral_pattern
+        is_olmo3_pattern or is_ministral_pattern or is_exaone4_pattern
     ):
         raise ValueError(
             "per-layer sliding layer_types only exist in HF as OLMo-3 "
-            "(post-norm + full qk-norm) or Ministral (bias-free pre-norm); "
-            "this combination cannot be exported"
+            "(post-norm + full qk-norm), Ministral (bias-free pre-norm), or "
+            "EXAONE-4 (post-norm + head qk-norm); this combination cannot "
+            "be exported"
         )
     if config.no_rope_layers is not None and not (
-        config.norm_type == "rmsnorm" and config.mlp_type == "swiglu"
-        and config.norm_scheme == "pre" and not config.rope_interleaved
-        and not config.qk_norm and config.num_experts is None
+        (
+            config.norm_type == "rmsnorm" and config.mlp_type == "swiglu"
+            and config.norm_scheme == "pre" and not config.rope_interleaved
+            and not config.qk_norm and config.num_experts is None
+        )
+        or is_exaone4_pattern
     ):
         raise ValueError(
             "no_rope_layers only exists in HF as SmolLM3 (a plain llama "
-            "graph); this combination cannot be exported"
+            "graph) or as EXAONE-4's derived hybrid-NoPE pattern; this "
+            "combination cannot be exported"
         )
     if config.clip_qkv is not None and not (
         config.num_experts and config.qk_norm and config.qk_norm_scope == "full"
@@ -888,6 +922,28 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "layer_types": list(config.layer_types),
              "sliding_window": config.sliding_window}
             if config.norm_scheme == "post" and config.layer_types is not None
+            else {}
+        ),
+        # post-norm + per-head qk-norm (+ hybrid sliding/NoPE pattern)
+        # only exists as EXAONE-4 in HF (rope is derived there: sliding
+        # layers rotate, full layers are NoPE)
+        **(
+            {"model_type": "exaone4", "architectures": ["Exaone4ForCausalLM"],
+             "head_dim": config.resolved_head_dim,
+             # explicit layer_types always: Exaone4Config's derivation
+             # divides by sliding_window_pattern (crashes when None), and a
+             # uniform sliding_window with no pattern must stay sliding
+             "sliding_window": config.sliding_window,
+             "layer_types": (
+                 list(config.layer_types)
+                 if config.layer_types is not None
+                 else [
+                     "sliding_attention" if config.sliding_window
+                     else "full_attention"
+                 ] * config.num_hidden_layers
+             )}
+            if config.norm_scheme == "post" and config.qk_norm
+            and config.qk_norm_scope == "head"
             else {}
         ),
         # interleaved rope + fused gate_up under pre/sandwich norms only
@@ -1026,7 +1082,9 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "no_rope_layer_interval": 4,
              "use_sliding_window": config.sliding_window is not None,
              "sliding_window": config.sliding_window}
-            if config.no_rope_layers is not None
+            # EXAONE-4 (post-norm) derives its NoPE pattern — only the
+            # pre-norm SmolLM3 carries an explicit one
+            if config.no_rope_layers is not None and config.norm_scheme == "pre"
             else {}
         ),
         # any non-identity multiplier only exists as Granite in HF; our None
@@ -1357,7 +1415,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # layers unscaled) — Ministral rotates every layer with one table
         layer_types=(
             list(get("layer_types") or []) or None
-            if model_type in ("olmo3", "ministral") else None
+            if model_type in ("olmo3", "ministral", "exaone4") else None
         ),
         dual_local_rope=model_type == "olmo3",
         # Mistral sets sliding_window unconditionally; the Qwen families gate
@@ -1369,15 +1427,24 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                                       "qwen3_moe", "smollm3"))
             else None
         ),
-        # SmolLM3 NoPE pattern (1 = rotate); absent elsewhere
+        # SmolLM3 NoPE pattern (1 = rotate); absent elsewhere.
+        # EXAONE-4 hybrid: sliding layers rotate, full-attention layers are
+        # NoPE (derived from layer_types when a window is configured)
         no_rope_layers=(
             list(get("no_rope_layers") or []) or None
-            if model_type == "smollm3" else None
+            if model_type == "smollm3"
+            else [
+                1 if lt == "sliding_attention" else 0
+                for lt in (get("layer_types") or [])
+            ]
+            if model_type == "exaone4" and get("sliding_window") is not None
+            else None
         ),
         qk_norm=(
             get("use_qk_norm", False) if model_type == "cohere"
             else model_type in ("qwen3", "olmo2", "olmo3", "qwen3_moe",
-                                "olmoe", "flex_olmo", "hunyuan_v1_dense")
+                                "olmoe", "flex_olmo", "hunyuan_v1_dense",
+                                "exaone4")
         ),
         qk_norm_position=(
             "post_rope" if model_type == "hunyuan_v1_dense" else "pre_rope"
@@ -1387,7 +1454,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                                      "flex_olmo") else "head"
         ),
         norm_scheme=(
-            "post" if model_type in ("olmo2", "olmo3", "flex_olmo")
+            "post" if model_type in ("olmo2", "olmo3", "flex_olmo",
+                                     "exaone4")
             else "parallel" if model_type in ("cohere", "phi")
             else "sandwich" if model_type == "glm4"
             else "pre"

@@ -1135,3 +1135,49 @@ def test_logits_parity_with_hf_stablelm():
 
     with pytest.raises(ValueError, match="parallel_residual"):
         config_from_hf({**hf_config.to_dict(), "use_parallel_residual": True})
+
+
+def test_logits_parity_with_hf_exaone4():
+    """EXAONE-4 routes to the Llama module: OLMo-2-style post-norm blocks,
+    per-head (qwen3-style) qk-norm, a 3:1 sliding/full hybrid pattern where
+    FULL-attention layers are NoPE (sliding layers rotate) — composed from
+    norm_scheme='post' + qk_norm head + layer_types + derived
+    no_rope_layers."""
+    torch = pytest.importorskip("torch")
+    from transformers import Exaone4Config, Exaone4ForCausalLM
+
+    hf_config = Exaone4Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8,
+        sliding_window_pattern=4,  # every 4th layer is global attention
+        attn_implementation="eager",
+    )
+    assert hf_config.layer_types == [
+        "sliding_attention", "sliding_attention", "sliding_attention",
+        "full_attention",
+    ]
+    torch.manual_seed(0)
+    hf_model = Exaone4ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.post_feedforward_layernorm.weight" in sd
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    assert "model.layers.0.input_layernorm.weight" not in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == "post" and cfg.qk_norm_scope == "head"
+    assert cfg.no_rope_layers == [1, 1, 1, 0]  # full layer is NoPE
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(55).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+    out = config_to_hf(cfg)
+    assert out["model_type"] == "exaone4"
+    cfg2 = config_from_hf(out, compute_dtype="float32")
+    assert cfg2.layer_types == cfg.layer_types
+    assert cfg2.no_rope_layers == cfg.no_rope_layers
