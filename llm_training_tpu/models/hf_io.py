@@ -284,6 +284,7 @@ _ARCH_TO_FAMILY = {
     "gpt2": "llm_training_tpu.models.Llama",  # learned positions, fused qkv
     "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
     "exaone4": "llm_training_tpu.models.Llama",  # post-norm + head qk-norm + hybrid NoPE
+    "apertus": "llm_training_tpu.models.Llama",  # non-gated xIELU MLP + head qk-norm
     "glm": "llm_training_tpu.models.Llama",  # interleaved partial rope, fused gate_up
     "glm4": "llm_training_tpu.models.Llama",  # + sandwich norms
     "glm4_moe": "llm_training_tpu.models.Glm4Moe",  # GLM-4.5: V3-style noaux MoE

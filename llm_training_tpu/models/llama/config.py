@@ -73,10 +73,12 @@ class LlamaConfig(BaseModelConfig):
     # 'layernorm_nobias' is Cohere's mean-centered weight-only norm;
     # 'layernorm1p' is Nemotron's zero-centered (1 + w) biased LayerNorm.
     # 'relu2' is Nemotron's non-gated up_proj -> relu^2 -> down_proj MLP.
+    # 'xielu' is Apertus' non-gated up -> xIELU -> down MLP with two
+    # learnable activation scalars per layer.
     norm_type: Literal[
         "rmsnorm", "layernorm", "layernorm_nobias", "layernorm1p"
     ] = "rmsnorm"
-    mlp_type: Literal["swiglu", "gelu", "relu2"] = "swiglu"
+    mlp_type: Literal["swiglu", "gelu", "relu2", "xielu"] = "swiglu"
     # Cohere/GLM/Ernie: interleaved (GPT-J) rope pairing; Cohere also has a
     # multiplicative logit scale. fused_gate_up marks GLM-style checkpoints
     # whose HF files store gate|up as ONE fused tensor (split/re-fused at

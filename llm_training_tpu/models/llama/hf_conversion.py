@@ -173,12 +173,25 @@ def _layer_params(config: LlamaConfig) -> list:
     elif config.mlp_type == "relu2":
         # Nemotron: no gate projection; up/down keep the llama names
         matmuls = [p for p in matmuls if p[0][-2] != "gate_proj"]
+    elif config.mlp_type == "xielu":
+        # Apertus: no gate; the activation's learnable scalars live under
+        # mlp.act_fn (beta/eps are constant buffers, emitted at export)
+        matmuls = [p for p in matmuls if p[0][-2] != "gate_proj"] + [
+            (("mlp", "xielu_alpha_p"), "mlp.act_fn.alpha_p", False),
+            (("mlp", "xielu_alpha_n"), "mlp.act_fn.alpha_n", False),
+        ]
     norms = {
         "post": _POST_NORM_PARAMS,
         "parallel": _PARALLEL_NORM_PARAMS,
         "sandwich": _SANDWICH_NORM_PARAMS,
         "pre": _PRE_NORM_PARAMS,
     }[config.norm_scheme]
+    if config.mlp_type == "xielu":
+        # Apertus names its pre-norms attention_/feedforward_layernorm
+        norms = [
+            (("input_layernorm", "weight"), "attention_layernorm.weight", False),
+            (("post_attention_layernorm", "weight"), "feedforward_layernorm.weight", False),
+        ]
     if config.norm_type in ("layernorm", "layernorm1p"):
         # biased LayerNorm blocks (Starcoder2 / Nemotron): a bias key each
         norms = norms + [
@@ -528,6 +541,17 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
             for i in range(config.num_hidden_layers):
                 value = np.asarray(_get_path(p, (f"layers_{i}",) + path))
                 out[f"model.layers.{i}.{hf_name}"] = value.T if transpose else value
+    if config.mlp_type == "xielu":
+        import ml_dtypes
+
+        # HF registers beta/eps as (constant) persistent buffers
+        for i in range(config.num_hidden_layers):
+            out[f"model.layers.{i}.mlp.act_fn.beta"] = np.asarray(
+                [0.5], ml_dtypes.bfloat16
+            )
+            out[f"model.layers.{i}.mlp.act_fn.eps"] = np.asarray(
+                [-1e-6], ml_dtypes.bfloat16
+            )
     if config.num_experts:
         # device->host once per stacked path, then slice per layer (a per-
         # layer np.asarray would re-transfer the full [L, E, ...] stack L
@@ -798,6 +822,46 @@ def _check_exportable(config: LlamaConfig) -> None:
             "EXAONE-4 (bias-free, single rope table, derived NoPE); this "
             "combination cannot be exported"
         )
+    # Granite's scalar multipliers only exist in HF on the Granite family,
+    # whose graph is plain llama (or the granite-MoE block): any exotic
+    # feature riding along would be silently dropped by that export
+    if (
+        config.embedding_multiplier != 1.0
+        or config.attention_multiplier is not None
+        or config.residual_multiplier != 1.0
+        or config.logits_scaling != 1.0
+    ) and not (
+        config.norm_type == "rmsnorm"
+        and config.mlp_type == "swiglu"
+        and config.norm_scheme == "pre"
+        and not config.qk_norm and not config.rope_interleaved
+        and config.partial_rotary_factor == 1.0
+        and config.layer_types is None and config.no_rope_layers is None
+        and config.sliding_window is None
+        and (config.num_experts is None or config.moe_style == "granite")
+    ):
+        raise ValueError(
+            "granite multipliers only exist in HF on Granite/GraniteMoe "
+            "(a plain llama graph); combined with other graph features "
+            "they cannot be exported"
+        )
+    is_apertus = (
+        config.norm_type == "rmsnorm" and config.mlp_type == "xielu"
+        and config.norm_scheme == "pre" and config.qk_norm
+        and config.qk_norm_scope == "head"
+        and config.qk_norm_position == "pre_rope"
+        and config.attention_bias == config.attention_out_bias
+        and not config.mlp_bias and not config.rope_interleaved
+        and config.partial_rotary_factor == 1.0
+        and config.num_experts is None and config.sliding_window is None
+        and config.layer_types is None and config.no_rope_layers is None
+    )
+    if config.mlp_type == "xielu" and not is_apertus:
+        raise ValueError(
+            "mlp_type='xielu' only exists in HF as Apertus (RMSNorm "
+            "pre-norm, per-head qk-norm, symmetric bias, full rotary); "
+            "this combination cannot be exported"
+        )
     is_ministral_pattern = (
         config.norm_scheme == "pre" and not config.qk_norm
         and not config.attention_bias and not config.attention_out_bias
@@ -1061,6 +1125,14 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "hidden_act": "gelu_pytorch_tanh"}
             if config.norm_type == "layernorm" and config.mlp_type == "gelu"
             and config.norm_scheme == "pre"
+            else {}
+        ),
+        # a non-gated xIELU MLP only exists as Apertus in HF
+        **(
+            {"model_type": "apertus", "architectures": ["ApertusForCausalLM"],
+             "hidden_act": "xielu",
+             "attention_bias": config.attention_bias}
+            if config.mlp_type == "xielu"
             else {}
         ),
         # biased LayerNorm + swiglu only exists as StableLM in HF
@@ -1444,7 +1516,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             get("use_qk_norm", False) if model_type == "cohere"
             else model_type in ("qwen3", "olmo2", "olmo3", "qwen3_moe",
                                 "olmoe", "flex_olmo", "hunyuan_v1_dense",
-                                "exaone4")
+                                "exaone4", "apertus")
         ),
         qk_norm_position=(
             "post_rope" if model_type == "hunyuan_v1_dense" else "pre_rope"
@@ -1475,6 +1547,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             # Arcee: the Nemotron-style non-gated up -> relu^2 -> down MLP
             # under standard RMSNorm pre-norm blocks
             else "relu2" if model_type in ("nemotron", "arcee")
+            # Apertus: non-gated xIELU with learnable activation scalars
+            else "xielu" if model_type == "apertus"
             else "swiglu"
         ),
         partial_rotary_factor=(

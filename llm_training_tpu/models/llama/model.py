@@ -26,6 +26,7 @@ from functools import partial as _partial
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from llm_training_tpu.models.base import CausalLMOutput
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
@@ -288,6 +289,38 @@ class LlamaMLP(nn.Module):
             return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.mlp_bias)(
                 jnp.square(nn.relu(up))
             )
+        if getattr(cfg, "mlp_type", "swiglu") == "xielu":
+            # Apertus xIELU (arXiv 2411.13010): a non-gated MLP whose
+            # activation carries two LEARNABLE scalars. Parameters store the
+            # softplus PRE-images (HF inits log(expm1(0.8)) and
+            # log(expm1(0.8 - beta))); beta/eps are the HF constants.
+            up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.mlp_bias)(hidden)
+            beta, eps = 0.5, -1e-6
+            init_p = float(np.log(np.expm1(0.8)))
+            init_n = float(np.log(np.expm1(0.8 - beta)))
+            alpha_p = self.param(
+                "xielu_alpha_p",
+                nn.with_logical_partitioning(
+                    nn.initializers.constant(init_p), (None,)
+                ),
+                (1,), cfg.param_jnp_dtype,
+            )
+            alpha_n = self.param(
+                "xielu_alpha_n",
+                nn.with_logical_partitioning(
+                    nn.initializers.constant(init_n), (None,)
+                ),
+                (1,), cfg.param_jnp_dtype,
+            )
+            x = up.astype(jnp.float32)
+            a_p = jax.nn.softplus(alpha_p.astype(jnp.float32))
+            a_n = beta + jax.nn.softplus(alpha_n.astype(jnp.float32))
+            act = jnp.where(
+                x > 0,
+                a_p * x * x + beta * x,
+                (jnp.expm1(jnp.minimum(x, eps)) - x) * a_n + beta * x,
+            ).astype(up.dtype)
+            return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.mlp_bias)(act)
         gate = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "gate_proj", cfg.mlp_bias)(hidden)
         up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.mlp_bias)(hidden)
         return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "down_proj", cfg.mlp_bias)(silu_mul(gate, up))

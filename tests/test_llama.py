@@ -1181,3 +1181,44 @@ def test_logits_parity_with_hf_exaone4():
     cfg2 = config_from_hf(out, compute_dtype="float32")
     assert cfg2.layer_types == cfg.layer_types
     assert cfg2.no_rope_layers == cfg.no_rope_layers
+
+
+def test_logits_parity_with_hf_apertus():
+    """Apertus routes to the Llama module: non-gated up -> xIELU -> down MLP
+    whose activation carries two LEARNABLE scalars per layer (stored as
+    softplus pre-images under mlp.act_fn), plus qwen3-style per-head
+    qk-norm. The scalars are salted so a conversion that dropped or
+    misread them cannot pass."""
+    torch = pytest.importorskip("torch")
+    from transformers import ApertusConfig, ApertusForCausalLM
+
+    hf_config = ApertusConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = ApertusForCausalLM(hf_config).eval().float()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.mlp.act_fn.alpha_p" in sd
+    assert "model.layers.0.mlp.gate_proj.weight" not in sd
+    assert "model.layers.0.self_attn.q_norm.weight" in sd
+    with torch.no_grad():  # make the learnable activation scalars LIVE
+        sd["model.layers.0.mlp.act_fn.alpha_p"].copy_(torch.tensor([1.3]))
+        sd["model.layers.1.mlp.act_fn.alpha_n"].copy_(torch.tensor([-0.4]))
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.mlp_type == "xielu" and cfg.qk_norm_scope == "head"
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(56).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+    out = config_to_hf(cfg)
+    assert out["model_type"] == "apertus" and out["hidden_act"] == "xielu"
+    cfg2 = config_from_hf(out, compute_dtype="float32")
+    assert cfg2.mlp_type == "xielu"
