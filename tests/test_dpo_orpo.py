@@ -60,6 +60,29 @@ def _datamodule(batch_size=8):
     return module
 
 
+def _assert_ref_frozen_policy_moved(objective, trainer, state):
+    """The frozen ref copy never moved; the policy did."""
+    import flax.linen as nn
+
+    params = jax.device_get(nn.meta.unbox(state.params))
+    init = jax.device_get(
+        nn.meta.unbox(
+            objective.init_params(
+                jax.random.key(trainer.config.seed),
+                {"chosen_input_ids": np.ones((1, 64), np.int32)},
+            )
+        )
+    )
+    ref_diff = jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), params["ref"], init["ref"]
+    )
+    assert max(jax.tree.leaves(ref_diff)) < 1e-6
+    policy_diff = jax.tree.map(
+        lambda a, b: float(np.abs(a - b).max()), params["policy"], init["policy"]
+    )
+    assert max(jax.tree.leaves(policy_diff)) > 1e-4
+
+
 class _Rec:
     def __init__(self):
         self.metrics = []
@@ -87,26 +110,7 @@ def test_dpo_initial_loss_is_log2_and_improves(devices):
     assert rec.metrics[-1]["loss"] < rec.metrics[0]["loss"]
     assert rec.metrics[-1]["reward_margin"] > 0
 
-    # the reference copy never moved
-    import flax.linen as nn
-
-    params = jax.device_get(nn.meta.unbox(state.params))
-    init = jax.device_get(
-        nn.meta.unbox(
-            objective.init_params(
-                jax.random.key(trainer.config.seed),
-                {"chosen_input_ids": np.ones((1, 64), np.int32)},
-            )
-        )
-    )
-    ref_diff = jax.tree.map(
-        lambda a, b: float(np.abs(a - b).max()), params["ref"], init["ref"]
-    )
-    assert max(jax.tree.leaves(ref_diff)) < 1e-6
-    policy_diff = jax.tree.map(
-        lambda a, b: float(np.abs(a - b).max()), params["policy"], init["policy"]
-    )
-    assert max(jax.tree.leaves(policy_diff)) > 1e-4
+    _assert_ref_frozen_policy_moved(objective, trainer, state)
 
 
 def test_dpo_label_smoothing_changes_loss():
@@ -175,23 +179,4 @@ def test_dpo_on_hybrid_recurrent_family(devices):
     assert rec.metrics[0]["loss"] == pytest.approx(float(np.log(2)), abs=1e-3)
     assert rec.metrics[-1]["loss"] < rec.metrics[0]["loss"]
 
-    # the frozen ref copy of the hybrid tree never moved; the policy did
-    import flax.linen as nn
-
-    params = jax.device_get(nn.meta.unbox(state.params))
-    init = jax.device_get(
-        nn.meta.unbox(
-            objective.init_params(
-                jax.random.key(trainer.config.seed),
-                {"chosen_input_ids": np.ones((1, 64), np.int32)},
-            )
-        )
-    )
-    ref_diff = jax.tree.map(
-        lambda a, b: float(np.abs(a - b).max()), params["ref"], init["ref"]
-    )
-    assert max(jax.tree.leaves(ref_diff)) < 1e-6
-    policy_diff = jax.tree.map(
-        lambda a, b: float(np.abs(a - b).max()), params["policy"], init["policy"]
-    )
-    assert max(jax.tree.leaves(policy_diff)) > 1e-4
+    _assert_ref_frozen_policy_moved(objective, trainer, state)
