@@ -225,6 +225,9 @@ def main() -> None:
             # host offloading) — frees 8 bytes/param of HBM for bigger
             # models at a per-step transfer cost (recorded in BASELINE.md)
             offload_optimizer_state=bool(os.environ.get("BENCH_OFFLOAD")),
+            # BENCH_OFFLOAD_DTYPE=int8|bfloat16 compresses the offloaded
+            # state storage (quantized_state.py) to cut the host round trip
+            offload_state_dtype=os.environ.get("BENCH_OFFLOAD_DTYPE", "float32"),
         ),
         callbacks=callbacks,
     )

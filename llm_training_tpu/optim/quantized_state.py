@@ -1,0 +1,189 @@
+"""Block-quantized storage codec for offloaded optimizer state.
+
+The host-offloaded optimizer round trip is transfer-bound: at the 916M-param
+bench proxy the fp32 mu/nu round trip is ~14.7 GB/step against a ~15 GB/s
+host link, and the r5 chip measurement showed the per-leaf "overlapped"
+chains hide none of it (0.3035 vs 0.313 MFU serialized) because the update
+compute they overlap with is negligible next to the transfers. The lever
+that works is shrinking the bytes: store mu as block-wise int8 and nu as
+block-wise uint8 of sqrt(nu) (8-bit-Adam-style state compression — the
+capability analogue of DeepSpeed's quantized ZeRO-offload knobs,
+`/root/reference/src/llm_training/lightning/strategy/deepspeed/deepspeed_strategy.py:70-102`),
+cutting the round trip 4x while mu/nu still never reside in HBM between
+steps.
+
+Codec design:
+- symmetric int8 ("sym", for mu and any signed state): per-block scale =
+  max|x|/127 over BLOCK consecutive elements of the last axis;
+  dequant = q * scale. Round-to-nearest; the quantization error decays
+  geometrically under the EMA (mu' = b1*dq(q(mu)) + (1-b1)g).
+- sqrt-uint8 ("sqrt", for nu / adafactor v*): quantize r = sqrt(nu) —
+  halves the dynamic range the linear scale must span — with CEIL
+  rounding, so the dequantized nu is an upper bound of the true value
+  wherever it underestimates the scale grid. Adam divides by
+  sqrt(nu_hat)+eps: over-estimating nu only shrinks a coordinate's step
+  (safe); under-estimating it (in particular quantizing a tiny nu to 0)
+  would multiply the step by up to sqrt(nu_true)/eps — catastrophic. Ceil
+  bounds every per-coordinate step from above by its true-Adam value.
+
+Arrays whose last axis is not a multiple of the block (tiny gates/scalars)
+stay fp32 — their transfer cost is noise. Scales are fp32 at 1/BLOCK the
+element count (1.6% overhead at 256).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+DEFAULT_BLOCK = 256
+
+# optax state fields that are non-negative second-moment accumulators
+# (adam/adamw nu, adafactor v/v_row/v_col) — these take the sqrt codec
+_NONNEG_FIELDS = {"nu", "v", "v_row", "v_col"}
+
+
+@flax.struct.dataclass
+class QuantArray:
+    """Block-quantized stand-in for one fp32 optimizer-state array.
+
+    q keeps the original array shape (int8 for "sym", uint8 for "sqrt") so
+    it inherits the parent array's sharding spec unchanged; scale has the
+    last axis divided by `block`. `kind`/`block` are treedef constants —
+    checkpoints restore them from the abstract target, not from disk.
+    """
+
+    q: Any
+    scale: Any
+    kind: str = flax.struct.field(pytree_node=False)
+    block: int = flax.struct.field(pytree_node=False)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):  # the logical dtype (what dequantize returns)
+        return jnp.float32
+
+
+def _blocked(x: jnp.ndarray, block: int) -> jnp.ndarray:
+    return x.reshape(*x.shape[:-1], x.shape[-1] // block, block)
+
+
+def quantize_array(x: jnp.ndarray, kind: str, block: int) -> QuantArray:
+    xb = _blocked(x.astype(jnp.float32), block)
+    if kind == "sym":
+        scale = jnp.max(jnp.abs(xb), axis=-1) / 127.0
+        q = jnp.round(xb / jnp.maximum(scale, 1e-30)[..., None])
+        q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    elif kind == "sqrt":
+        r = jnp.sqrt(xb)
+        scale = jnp.max(r, axis=-1) / 254.0
+        q = jnp.ceil(r / jnp.maximum(scale, 1e-30)[..., None])
+        q = jnp.clip(q, 0, 255).astype(jnp.uint8)
+    else:
+        raise ValueError(f"unknown quantization kind {kind!r}")
+    return QuantArray(
+        q=q.reshape(x.shape), scale=scale.astype(jnp.float32), kind=kind, block=block
+    )
+
+
+def dequantize_array(qa: QuantArray) -> jnp.ndarray:
+    xb = _blocked(qa.q.astype(jnp.float32), qa.block) * qa.scale[..., None]
+    if qa.kind == "sqrt":
+        xb = xb * xb
+    return xb.reshape(qa.q.shape)
+
+
+def _is_nonneg_field(path) -> bool:
+    """Whether this leaf sits under a non-negative optax state field.
+
+    State trees nest as (chain idx, state-namedtuple field, *param-tree
+    path): namedtuple fields flatten to GetAttrKey (which has .name), while
+    param-tree keys are DictKey (.key) — so checking only .name entries
+    against the field set cannot be fooled by a model param literally named
+    'v', and survives wrapper states (MaskedState etc.) that add their own
+    GetAttrKeys around the field."""
+    return any(getattr(entry, "name", None) in _NONNEG_FIELDS for entry in path)
+
+
+def _boxed(ref, value):
+    """Re-wrap value in ref's Partitioned box (sharding metadata), if any."""
+    if isinstance(ref, nn.Partitioned):
+        return ref.replace_boxed(value)
+    return value
+
+
+def _unboxed(leaf):
+    return leaf.value if isinstance(leaf, nn.Partitioned) else leaf
+
+
+def encode_state(state: Any, block: int = DEFAULT_BLOCK) -> Any:
+    """Quantize every eligible fp32 array in an optax state tree.
+
+    Eligible: floating arrays with ndim >= 1 whose last axis is a multiple
+    of `block`. Field name picks the codec (nu/v* -> "sqrt", else "sym").
+    Partitioned boxes are preserved AROUND q and scale so the abstract tree
+    still carries per-array sharding metadata.
+    """
+
+    def enc(path, leaf):
+        value = _unboxed(leaf)
+        if (
+            not hasattr(value, "ndim")
+            or value.ndim < 1
+            or not jnp.issubdtype(value.dtype, jnp.floating)
+            or value.shape[-1] % block != 0
+        ):
+            return leaf
+        kind = "sqrt" if _is_nonneg_field(path) else "sym"
+        qa = quantize_array(value, kind, block)
+        return QuantArray(
+            q=_boxed(leaf, qa.q), scale=_boxed(leaf, qa.scale),
+            kind=kind, block=block,
+        )
+
+    return jax.tree_util.tree_map_with_path(
+        enc, state, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+def decode_state(state: Any) -> Any:
+    """Inverse of encode_state: QuantArray leaves back to fp32 arrays."""
+
+    def dec(leaf):
+        if isinstance(leaf, QuantArray):
+            qa = QuantArray(
+                q=_unboxed(leaf.q), scale=_unboxed(leaf.scale),
+                kind=leaf.kind, block=leaf.block,
+            )
+            return _boxed(leaf.q, dequantize_array(qa))
+        return leaf
+
+    return jax.tree.map(dec, state, is_leaf=lambda x: isinstance(x, QuantArray))
+
+
+def cast_state(state: Any, dtype) -> Any:
+    """Elementwise storage cast (the "bfloat16" offload dtype): every
+    floating array with ndim >= 1 is stored as `dtype`; ints/scalars stay."""
+
+    def cast(leaf):
+        value = _unboxed(leaf)
+        if (
+            hasattr(value, "ndim")
+            and value.ndim >= 1
+            and jnp.issubdtype(value.dtype, jnp.floating)
+        ):
+            return _boxed(leaf, value.astype(dtype))
+        return leaf
+
+    return jax.tree.map(cast, state, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+
+
+def uncast_state(state: Any, dtype=jnp.float32) -> Any:
+    return cast_state(state, dtype)

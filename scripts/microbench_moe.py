@@ -40,8 +40,12 @@ ITERS = 8
 _PEAK = 197e12  # v5e nominal bf16
 _RNG = np.random.default_rng(0)
 
-HIDDEN = 2048
-ROWS = 65536  # bench proxy: 2048 seq * 16 batch * top-2
+HIDDEN = int(os.environ.get("MOE_HIDDEN", 2048))
+# bench proxy: 2048 seq * 16 batch * top-2. ROWS is overridable so new
+# graph shapes (e.g. the bucketed gather/scatter probe) can be validated
+# small first — a 65k-row first-contact graph once wedged the tunnel
+# permanently (see .claude/skills/verify/SKILL.md).
+ROWS = int(os.environ.get("MOE_ROWS", 65536))
 
 
 def _fetch(out) -> None:
