@@ -143,6 +143,14 @@ class LlamaConfig(BaseModelConfig):
     # over it (requires a mesh with sequence_parallel_size > 1); goes beyond
     # the reference, which reaches long context via TP+SP only (SURVEY.md §5.7)
     ring_attention: bool = False
+    # GPipe pipeline parallelism (models/pipeline.py): split the scanned
+    # stack into this many stages over the 'pipe' mesh axis (mesh
+    # pipeline_parallel_size must match). Beyond the reference, which has
+    # no PP. Changes the layer-stack param layout to [S, L/S, ...]
+    pipeline_stages: int = 1
+    # microbatches per step (defaults to pipeline_stages); bubble fraction
+    # is (S-1)/(microbatches+S-1)
+    pipeline_microbatches: int | None = None
 
     @model_validator(mode="after")
     def _validate(self) -> "LlamaConfig":
@@ -226,6 +234,34 @@ class LlamaConfig(BaseModelConfig):
                     "scan_layers=False"
                 )
             self.scan_layers = False
+        if self.pipeline_stages > 1:
+            if not self.scan_layers:
+                raise ValueError(
+                    "pipeline_stages > 1 requires scan_layers=True (stages "
+                    "are a leading axis over the scanned stack)"
+                )
+            if self.num_experts:
+                raise ValueError(
+                    "pipeline_stages > 1 does not compose with MoE layers "
+                    "yet (router load-balancing stats would pool over "
+                    "bubble-tick junk batches)"
+                )
+            if self.num_hidden_layers % self.pipeline_stages != 0:
+                raise ValueError(
+                    f"num_hidden_layers {self.num_hidden_layers} must split "
+                    f"evenly over pipeline_stages {self.pipeline_stages}"
+                )
+            if self.position_embedding_type == "learned":
+                raise ValueError(
+                    "pipeline_stages > 1 requires rotary positions"
+                )
+            if self.ring_attention:
+                raise ValueError(
+                    "pipeline_stages > 1 does not compose with "
+                    "ring_attention (the ring's shard_map cannot sit under "
+                    "the stage vmap); shard long sequences with "
+                    "tensor/sequence-parallel attention instead"
+                )
         self.rope_config  # construct to trigger RoPEConfig validation
         return self
 

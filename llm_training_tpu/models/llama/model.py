@@ -432,6 +432,18 @@ class Llama(nn.Module):
         num_hidden_layers."""
         cfg = self.config
         policy = _remat_policy(cfg)
+        if getattr(cfg, "pipeline_stages", 1) > 1:
+            from llm_training_tpu.models.pipeline import PipelinedLayers
+
+            layer_cls = _ScannedLayer
+            if policy is not None:
+                layer_cls = nn.remat(
+                    _ScannedLayer, policy=policy, prevent_cse=False,
+                )
+            hidden = PipelinedLayers(
+                cfg, layer_cls, LlamaDecoderLayer, name="pipeline"
+            )(hidden, segment_ids, cos, sin)
+            return hidden, jnp.float32(0.0), jnp.float32(0.0)
         if cfg.scan_layers:
             layer_cls = _ScannedLayer
             if policy is not None:

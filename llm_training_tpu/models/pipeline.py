@@ -1,0 +1,172 @@
+"""GPipe pipeline parallelism over the `pipe` mesh axis.
+
+No reference analogue: cchou0519/LLM-Training stops at FSDP/ZeRO + TP + SP
+(SURVEY.md §2.8 lists PP as absent there). This is the GSPMD-native
+formulation — no per-stage programs, no send/recv: the decoder stack
+becomes an `nn.vmap` over a leading stage axis (params `[S, L/S, ...]`,
+logical name 'stages' -> mesh axis 'pipe'), microbatches march through a
+stage-sharded shift buffer, and the one-position shift along the sharded
+axis each tick is lowered by GSPMD to a neighbour collective-permute over
+ICI. The whole pipeline — bubbles and all — is a single `nn.scan` over
+M + S - 1 ticks inside the same jitted SPMD program as everything else,
+so PP composes freely with data/fsdp/tensor/sequence sharding of each
+stage's interior.
+
+Schedule: plain GPipe. Tick t injects microbatch t (zeros once the real
+ones run out) at stage 0; every stage applies its L/S layers to its
+current microbatch; the last stage's outputs from ticks S-1 .. S-1+M-1
+are the finished microbatches. Bubble fraction (S-1)/(M+S-1); activation
+memory is the standard GPipe M-microbatch footprint bounded by the
+per-layer remat policy already applied to `layer_cls`.
+
+Zero-injected bubble ticks are safe by construction: segment id 0 means
+padding, and the attention mask keeps fully-masked rows finite (see
+ops/attention.py), so junk lanes produce finite activations whose
+outputs are never consumed — their cotangents are exactly zero.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class _Tick(nn.Module):
+    """One pipeline tick: inject at stage 0, run all stages in parallel
+    (vmapped), emit the last stage's output, shift the buffers one stage
+    down. `carry` holds what each stage just produced plus the metadata
+    (segment ids / rope tables) travelling with each in-flight microbatch.
+    """
+
+    config: Any
+    layer_cls: Type[nn.Module]
+    inner_cls: Type[nn.Module]
+    stages: int
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, carry, xs):
+        h_prev, seg_prev, cos_prev, sin_prev = carry  # [S, mb, ...]
+        inj_h, inj_seg, inj_cos, inj_sin = xs  # [mb, ...]
+
+        # stage s consumes what stage s-1 produced last tick; stage 0
+        # consumes the injected microbatch. The concat across the
+        # 'stages'-sharded axis IS the inter-stage communication.
+        h_in = jnp.concatenate([inj_h[None], h_prev[:-1]], axis=0)
+        seg_in = jnp.concatenate([inj_seg[None], seg_prev[:-1]], axis=0)
+        cos_in = jnp.concatenate([inj_cos[None], cos_prev[:-1]], axis=0)
+        sin_in = jnp.concatenate([inj_sin[None], sin_prev[:-1]], axis=0)
+        h_in = nn.with_logical_constraint(
+            h_in, ("stages", "batch", "act_seq", "act_embed")
+        )
+
+        stack = nn.scan(
+            self.layer_cls,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+            length=self.layers_per_stage,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        stages = nn.vmap(
+            stack,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, 0, 0, 0),
+            out_axes=0,
+            axis_size=self.stages,
+            metadata_params={nn.PARTITION_NAME: "stages"},
+        )
+        h_out, _aux = stages(self.config, self.inner_cls, name="layers")(
+            h_in, seg_in, cos_in, sin_in
+        )
+        h_out = nn.with_logical_constraint(
+            h_out, ("stages", "batch", "act_seq", "act_embed")
+        )
+        return (h_out, seg_in, cos_in, sin_in), h_out[-1]
+
+
+class PipelinedLayers(nn.Module):
+    """Drop-in replacement for the scanned decoder stack when
+    `config.pipeline_stages > 1`: same (hidden, segment_ids, cos, sin) ->
+    hidden contract as the nn.scan path in `Llama._layers`, identical
+    per-token math (each token passes through the same L layers in order —
+    microbatching only regroups the batch dimension), different parameter
+    layout (`layers` subtree leaves are [S, L/S, ...] instead of [L, ...]).
+    """
+
+    config: Any
+    layer_cls: Type[nn.Module]  # (possibly rematted) scan-adapter class
+    inner_cls: Type[nn.Module]  # the decoder layer
+
+    @nn.compact
+    def __call__(self, hidden, segment_ids, cos, sin):
+        cfg = self.config
+        stages = cfg.pipeline_stages
+        num_layers = cfg.num_hidden_layers
+        if num_layers % stages != 0:
+            raise ValueError(
+                f"num_hidden_layers {num_layers} must divide evenly into "
+                f"pipeline_stages {stages}"
+            )
+        if cos is None:
+            raise ValueError(
+                "pipeline_stages > 1 requires rotary positions (learned-"
+                "position models would need the position table piped "
+                "through the stages; unsupported)"
+            )
+        batch = hidden.shape[0]
+        micro = cfg.pipeline_microbatches or stages
+        # param shapes don't depend on the microbatch split, but shape-level
+        # passes (init, eval_shape) trace with tiny batches — degrade to the
+        # largest feasible count instead of failing the trace. A non-divisor
+        # setting on the real batch degrades the bubble fraction, never
+        # correctness
+        micro = math.gcd(batch, micro)
+        mb = batch // micro
+
+        # segment ids and rope tables travel with each microbatch, so they
+        # need explicit full-batch leading dims (callers may pass None segs
+        # for a single unpacked document, and rope tables broadcast [1, T, d])
+        if segment_ids is None:
+            segment_ids = jnp.ones(hidden.shape[:2], jnp.int32)
+        cos = jnp.broadcast_to(cos, (batch,) + cos.shape[1:])
+        sin = jnp.broadcast_to(sin, (batch,) + sin.shape[1:])
+
+        def microbatched(x):
+            return x.reshape((micro, mb) + x.shape[1:])
+
+        ticks = micro + stages - 1
+
+        def with_bubbles(x):  # [M, mb, ...] -> [T, mb, ...], zero-padded
+            pad = jnp.zeros((stages - 1,) + x.shape[1:], x.dtype)
+            return jnp.concatenate([x, pad], axis=0)
+
+        xs = tuple(
+            with_bubbles(microbatched(v))
+            for v in (hidden, segment_ids, cos, sin)
+        )
+        carry = tuple(
+            jnp.zeros((stages, mb) + v.shape[2:], v.dtype)
+            for v in xs
+        )
+
+        tick_loop = nn.scan(
+            _Tick,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+            length=ticks,
+        )
+        _, ys = tick_loop(
+            self.config, self.layer_cls, self.inner_cls,
+            stages, num_layers // stages, name="ticks",
+        )(carry, xs)
+
+        # last stage finishes microbatch m at tick m + S - 1
+        out = ys[stages - 1 :]
+        return out.reshape((batch,) + out.shape[2:])

@@ -8,6 +8,8 @@ inserting all collectives over ICI/DCN.
 
 Axes:
   data     — pure data parallelism (replicated params)
+  pipe     — GPipe pipeline stages (models/pipeline.py); beyond reference
+             parity (it has no PP)
   fsdp     — data parallelism with parameter sharding (ZeRO-3 semantics)
   tensor   — tensor parallelism (the reference's TP plans) + sequence-
              parallel activations between blocks (its `SequenceParallel`)
@@ -21,6 +23,7 @@ from llm_training_tpu.parallel.mesh import (
     initialize_distributed,
     DATA_AXIS,
     FSDP_AXIS,
+    PIPELINE_AXIS,
     TENSOR_AXIS,
     SEQUENCE_AXIS,
 )
@@ -36,6 +39,7 @@ __all__ = [
     "initialize_distributed",
     "DATA_AXIS",
     "FSDP_AXIS",
+    "PIPELINE_AXIS",
     "TENSOR_AXIS",
     "SEQUENCE_AXIS",
     "DEFAULT_LOGICAL_AXIS_RULES",

@@ -20,12 +20,19 @@ from pydantic import BaseModel, ConfigDict
 logger = logging.getLogger(__name__)
 
 DATA_AXIS = "data"
+PIPELINE_AXIS = "pipe"
 FSDP_AXIS = "fsdp"
 EXPERT_AXIS = "expert"
 TENSOR_AXIS = "tensor"
 SEQUENCE_AXIS = "sequence"
 
-MESH_AXIS_NAMES = (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, TENSOR_AXIS, SEQUENCE_AXIS)
+# data outermost (gradient all-reduce tolerates DCN), then pipe — the
+# per-tick stage boundary ppermute is the lowest-bandwidth traffic in the
+# stack — then the per-layer fsdp gathers and the latency-critical
+# tensor/sequence collectives innermost on the fastest ICI
+MESH_AXIS_NAMES = (
+    DATA_AXIS, PIPELINE_AXIS, FSDP_AXIS, EXPERT_AXIS, TENSOR_AXIS, SEQUENCE_AXIS
+)
 
 
 class MeshConfig(BaseModel):
@@ -43,6 +50,9 @@ class MeshConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     data_parallel_size: int = 1
+    # GPipe stages over the 'pipe' axis (models/pipeline.py); the model's
+    # pipeline_stages must match. No reference analogue (it has no PP)
+    pipeline_parallel_size: int = 1
     fsdp_size: int = -1
     expert_parallel_size: int = 1
     tensor_parallel_size: int = 1
@@ -51,6 +61,7 @@ class MeshConfig(BaseModel):
     def axis_sizes(self) -> dict[str, int]:
         return {
             DATA_AXIS: self.data_parallel_size,
+            PIPELINE_AXIS: self.pipeline_parallel_size,
             FSDP_AXIS: self.fsdp_size,
             EXPERT_AXIS: self.expert_parallel_size,
             TENSOR_AXIS: self.tensor_parallel_size,
@@ -85,12 +96,14 @@ def build_mesh(
     config: MeshConfig | None = None,
     devices: list | None = None,
 ) -> Mesh:
-    """Build the 5-axis mesh.
+    """Build the 6-axis mesh.
 
-    Axis order is (data, fsdp, expert, tensor, sequence) — innermost axes
-    get physically-adjacent devices, so tensor/sequence collectives (the
-    latency-sensitive ones) ride the fastest ICI links; EP's per-MoE-layer
-    gather/scatter sits just outside them.
+    Axis order is (data, pipe, fsdp, expert, tensor, sequence) — innermost
+    axes get physically-adjacent devices, so tensor/sequence collectives
+    (the latency-sensitive ones) ride the fastest ICI links; EP's
+    per-MoE-layer gather/scatter sits just outside them; the pipeline
+    stage boundary ppermute (lowest bandwidth need) and the gradient
+    all-reduce over data (DCN-tolerant) take the outermost positions.
     """
     config = config or MeshConfig()
     devices = devices if devices is not None else jax.devices()

@@ -26,6 +26,7 @@ from llm_training_tpu.parallel.mesh import (
     DATA_AXIS,
     EXPERT_AXIS,
     FSDP_AXIS,
+    PIPELINE_AXIS,
     SEQUENCE_AXIS,
     TENSOR_AXIS,
 )
@@ -50,6 +51,11 @@ DEFAULT_LOGICAL_AXIS_RULES: LogicalAxisRules = (
     ("vocab", TENSOR_AXIS),
     ("norm", None),
     ("expert", EXPERT_AXIS),
+    # --- pipeline parallelism: the leading stage axis of the vmapped layer
+    # stacks ([S, L/S, ...], models/pipeline.py) and of the microbatch
+    # shift buffers shards over 'pipe'; the shift concat across it lowers
+    # to a GSPMD collective-permute between neighbouring stages
+    ("stages", PIPELINE_AXIS),
 )
 
 

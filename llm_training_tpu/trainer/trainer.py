@@ -153,7 +153,7 @@ class Trainer:
 
     def _build_tx(self, objective) -> tuple[optax.GradientTransformation, optax.Schedule]:
         """Decide the optimizer LAYOUT and build the transformation. The
-        overlapped (per-leaf) offload step needs a clip-free leaf-local
+        blocked (per-leaf) offload step needs a clip-free leaf-local
         transform; accumulation (MultiSteps wraps the whole tree) and
         path-named freeze masks fall back to the serialized round trip.
         fit and validate_from_checkpoint both go through here so the
@@ -176,6 +176,10 @@ class Trainer:
                 "path: offload_optimizer_state=True, accumulate_grad_batches"
                 "=1 and no frozen_modules (the compressed state layout is "
                 "per-param-leaf)"
+            )
+        if cfg.offload_quant_block < 1:
+            raise ValueError(
+                f"offload_quant_block must be >= 1, got {cfg.offload_quant_block}"
             )
         optim_config = objective.config.optim
         self._clip_norm = None
@@ -392,6 +396,26 @@ class Trainer:
                 f"data*fsdp mesh ways ({dp_ways})"
             )
 
+        # a pipe axis only does work when the model splits into matching
+        # stages; a silent mismatch would replicate every computation
+        # across it (pipe>1, stages=1) or pay GPipe bubbles for nothing
+        pp_mesh = self.mesh.shape.get("pipe", 1)
+        model_cfg = getattr(getattr(objective, "model", None), "config", None)
+        pp_model = getattr(model_cfg, "pipeline_stages", 1)
+        if pp_mesh > 1 and pp_model != pp_mesh:
+            raise ValueError(
+                f"mesh pipeline_parallel_size={pp_mesh} but the model has "
+                f"pipeline_stages={pp_model}; they must match (the pipe "
+                "axis shards the model's stage dimension)"
+            )
+        if pp_mesh == 1 and pp_model > 1:
+            logger.warning(
+                "pipeline_stages=%d with no pipe mesh axis: the GPipe "
+                "schedule runs sequentially (debug mode) — its bubbles "
+                "cost throughput without parallelism",
+                pp_model,
+            )
+
         # the boxed (Partitioned-annotated) abstract tree exists only to
         # derive shardings; the canonical runtime state is unboxed
         abstract_boxed = self._abstract_state(objective, sample_batch, tx)
@@ -413,6 +437,7 @@ class Trainer:
                 raise RuntimeError(
                     "checkpoint restore failed — note the optimizer-state "
                     "layout depends on offload_optimizer_state, "
+                    "offload_state_dtype, offload_quant_block, "
                     "accumulate_grad_batches, and frozen_modules; resume "
                     "with the same settings the checkpoint was written with"
                 ) from e

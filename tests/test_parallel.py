@@ -18,12 +18,12 @@ from llm_training_tpu.parallel.sharding import logical_to_spec
 
 def test_auto_factoring(devices):
     sizes = resolve_axis_sizes(MeshConfig(tensor_parallel_size=2), 8)
-    assert sizes == {"data": 1, "fsdp": 4, "expert": 1, "tensor": 2, "sequence": 1}
+    assert sizes == {"data": 1, "pipe": 1, "fsdp": 4, "expert": 1, "tensor": 2, "sequence": 1}
 
 
 def test_auto_factoring_default_is_pure_fsdp(devices):
     sizes = resolve_axis_sizes(MeshConfig(), 8)
-    assert sizes == {"data": 1, "fsdp": 8, "expert": 1, "tensor": 1, "sequence": 1}
+    assert sizes == {"data": 1, "pipe": 1, "fsdp": 8, "expert": 1, "tensor": 1, "sequence": 1}
 
 
 def test_factoring_errors():
@@ -39,7 +39,7 @@ def test_factoring_errors():
 
 def test_build_mesh(devices):
     mesh = build_mesh(MeshConfig(fsdp_size=2, tensor_parallel_size=2, sequence_parallel_size=2))
-    assert mesh.shape == {"data": 1, "fsdp": 2, "expert": 1, "tensor": 2, "sequence": 2}
+    assert mesh.shape == {"data": 1, "pipe": 1, "fsdp": 2, "expert": 1, "tensor": 2, "sequence": 2}
 
 
 def test_logical_to_spec_rules():
