@@ -122,6 +122,58 @@ def _device_cast(dtype_name: str):
     return jax.jit(lambda x: x.astype(jnp.dtype(dtype_name)))
 
 
+def _pp_stages(config: Any) -> int:
+    return int(getattr(config, "pipeline_stages", 1) or 1)
+
+
+def _pp_wrap_leaf_fn(config: Any, leaf_fn):
+    """Pipeline-layout load adapter (models/pipeline.py): conversions emit
+    the scan layout — stacked leaves [L, ...] under ('layers', ...) — but a
+    pipelined model stores [S, L/S, ...] under ('pipeline', 'ticks',
+    'layers', ...). Reshape on host BEFORE placement (so the device_put
+    lands on the stage-sharded buffers) and look shardings up under the
+    pipeline path; `_pp_relocate` moves the subtree afterwards."""
+    stages = _pp_stages(config)
+    per = config.num_hidden_layers // stages
+
+    def wrapped(path: tuple[str, ...], value):
+        if path and path[0] == "layers":
+            value = value.reshape((stages, per) + value.shape[1:])
+            path = ("pipeline", "ticks") + path
+        return leaf_fn(path, value) if leaf_fn is not None else value
+
+    return wrapped
+
+
+def _pp_relocate(tree: Any, config: Any) -> Any:
+    """Move the converted scan stack to its pipeline-layout position (the
+    conversion's `_set_path` keyed it by the original 'layers' path)."""
+    params = tree.get("params", tree)
+    if "layers" in params:
+        params.setdefault("pipeline", {}).setdefault("ticks", {})[
+            "layers"
+        ] = params.pop("layers")
+    return tree
+
+
+def _pp_as_scan(params: Mapping, config: Any) -> Mapping:
+    """Pipeline-layout export adapter: present the [S, L/S, ...] stage
+    stacks as the [L, ...] scan layout the conversions consume. The
+    reshape merges the stage axis lazily; values cross to host once,
+    inside the conversion's own per-path fetch."""
+    import flax.linen as nn
+
+    p = params.get("params", params)
+    if "pipeline" not in p:
+        return params
+    p = dict(p)
+    stack = nn.meta.unbox(p.pop("pipeline"))["ticks"]["layers"]
+    p["layers"] = jax.tree.map(
+        lambda v: v.reshape((v.shape[0] * v.shape[1],) + v.shape[2:]), stack
+    )
+    return {"params": p} if "params" in params else p
+
+
 def load_pretrained_params(
     config: Any,
     hf_path: str | Path | Mapping,
@@ -144,7 +196,14 @@ def load_pretrained_params(
         hf_path if isinstance(hf_path, Mapping) else LazyStateDict(hf_path)
     )
 
+    pipelined = _pp_stages(config) > 1
+
     if shardings is None and dtypes is None:
+        if pipelined:
+            tree = conv.params_from_hf(
+                state_dict, config, leaf_fn=_pp_wrap_leaf_fn(config, None)
+            )
+            return _pp_relocate(tree, config)
         return conv.params_from_hf(state_dict, config)
 
     by_path = _flatten_by_path(shardings)
@@ -177,6 +236,11 @@ def load_pretrained_params(
 
     # each converted leaf is placed (device_put) inside the conversion walk,
     # so the host never holds more than one (stacked) tensor at a time
+    if pipelined:
+        tree = conv.params_from_hf(
+            state_dict, config, leaf_fn=_pp_wrap_leaf_fn(config, leaf_fn)
+        )
+        return _pp_relocate(tree, config)
     return conv.params_from_hf(state_dict, config, leaf_fn=leaf_fn)
 
 
@@ -223,6 +287,8 @@ def save_hf_checkpoint(
     output_dir = Path(output_dir)
     output_dir.mkdir(parents=True, exist_ok=True)
 
+    if _pp_stages(config) > 1:
+        params = _pp_as_scan(params, config)
     state_dict = _as_torch_state_dict(conv.params_to_hf(params, config), dtype)
 
     # shard greedily in key order, HF-style file naming

@@ -27,11 +27,16 @@ outputs are never consumed — their cotangents are exactly zero.
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Any, Type
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from llm_training_tpu.parallel.mesh import active_mesh
+
+logger = logging.getLogger(__name__)
 
 
 class _Tick(nn.Module):
@@ -106,12 +111,10 @@ class PipelinedLayers(nn.Module):
     def __call__(self, hidden, segment_ids, cos, sin):
         cfg = self.config
         stages = cfg.pipeline_stages
+        # L % S == 0 is enforced by the family config validator
+        # (LlamaConfig._validate) — every model-driven path arrives here
+        # pre-checked
         num_layers = cfg.num_hidden_layers
-        if num_layers % stages != 0:
-            raise ValueError(
-                f"num_hidden_layers {num_layers} must divide evenly into "
-                f"pipeline_stages {stages}"
-            )
         if cos is None:
             raise ValueError(
                 "pipeline_stages > 1 requires rotary positions (learned-"
@@ -124,9 +127,33 @@ class PipelinedLayers(nn.Module):
         # passes (init, eval_shape) trace with tiny batches — degrade to the
         # largest feasible count instead of failing the trace. A non-divisor
         # setting on the real batch degrades the bubble fraction, never
-        # correctness
-        micro = math.gcd(batch, micro)
+        # correctness. Warnings fire once per compiled shape (trace time)
+        eff = math.gcd(batch, micro)
+        if eff != micro and batch > 1:
+            logger.warning(
+                "pipeline_microbatches=%d does not divide batch %d; running "
+                "%d microbatches (bubble fraction %.0f%% instead of %.0f%%)",
+                micro, batch, eff,
+                100 * (stages - 1) / (eff + stages - 1),
+                100 * (stages - 1) / (micro + stages - 1),
+            )
+        micro = eff
         mb = batch // micro
+        mesh = active_mesh()
+        if mesh is not None:
+            batch_ways = (
+                mesh.shape.get("data", 1)
+                * mesh.shape.get("fsdp", 1)
+                * mesh.shape.get("expert", 1)
+            )
+            if batch_ways > 1 and mb % batch_ways != 0:
+                logger.warning(
+                    "pipeline microbatch size %d does not divide the %d-way "
+                    "batch sharding (data*fsdp*expert): GSPMD pads each "
+                    "microbatch and some ranks idle every tick — use "
+                    "batch/pipeline_microbatches divisible by %d",
+                    mb, batch_ways, batch_ways,
+                )
 
         # segment ids and rope tables travel with each microbatch, so they
         # need explicit full-batch leading dims (callers may pass None segs

@@ -400,21 +400,30 @@ class Trainer:
         # stages; a silent mismatch would replicate every computation
         # across it (pipe>1, stages=1) or pay GPipe bubbles for nothing
         pp_mesh = self.mesh.shape.get("pipe", 1)
-        model_cfg = getattr(getattr(objective, "model", None), "config", None)
-        pp_model = getattr(model_cfg, "pipeline_stages", 1)
-        if pp_mesh > 1 and pp_model != pp_mesh:
-            raise ValueError(
-                f"mesh pipeline_parallel_size={pp_mesh} but the model has "
-                f"pipeline_stages={pp_model}; they must match (the pipe "
-                "axis shards the model's stage dimension)"
-            )
-        if pp_mesh == 1 and pp_model > 1:
-            logger.warning(
-                "pipeline_stages=%d with no pipe mesh axis: the GPipe "
-                "schedule runs sequentially (debug mode) — its bubbles "
-                "cost throughput without parallelism",
-                pp_model,
-            )
+        # check EVERY model the objective runs (DPO/ORPO carry a ref model
+        # too — an unpipelined ref on a pipe mesh would replicate its whole
+        # forward across the axis)
+        models = {"model": getattr(objective, "model", None)}
+        ref = getattr(objective, "ref_model", None)
+        if ref is not None and ref is not models["model"]:
+            models["ref_model"] = ref
+        for name, model in models.items():
+            if model is None:
+                continue
+            pp_model = getattr(getattr(model, "config", None), "pipeline_stages", 1)
+            if pp_mesh > 1 and pp_model != pp_mesh:
+                raise ValueError(
+                    f"mesh pipeline_parallel_size={pp_mesh} but {name} has "
+                    f"pipeline_stages={pp_model}; they must match (the pipe "
+                    "axis shards the model's stage dimension)"
+                )
+            if pp_mesh == 1 and pp_model > 1:
+                logger.warning(
+                    "%s pipeline_stages=%d with no pipe mesh axis: the "
+                    "GPipe schedule runs sequentially (debug mode) — its "
+                    "bubbles cost throughput without parallelism",
+                    name, pp_model,
+                )
 
         # the boxed (Partitioned-annotated) abstract tree exists only to
         # derive shardings; the canonical runtime state is unboxed
