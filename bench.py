@@ -44,6 +44,32 @@ def _detect_peak() -> float:
     return _PEAK_FLOPS["cpu"]
 
 
+def _watchdog(seconds: float, stage: str):
+    """A wedged axon tunnel blocks jax calls FOREVER (r5: after a
+    pathological remote compile, backend init AND in-flight device fetches
+    hung indefinitely). Emit a diagnosable JSON line and exit instead of
+    hanging the driver. Re-armed per stage: a short fuse for backend init,
+    a long one covering the compile+run (remote compiles are legitimately
+    ~30-90s each)."""
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "llama_clm_train_mfu",
+            "value": None,
+            "unit": "mfu_fraction",
+            "vs_baseline": None,
+            "error": f"jax {stage} unresponsive after {seconds:.0f}s "
+                     "(axon tunnel wedged?) — bench did not finish",
+        }), flush=True)
+        os._exit(3)
+
+    timer = threading.Timer(seconds, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
     from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
     from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
@@ -51,7 +77,16 @@ def main() -> None:
     from llm_training_tpu.parallel import MeshConfig
     from llm_training_tpu.trainer import Trainer, TrainerConfig
 
+    watchdog = _watchdog(
+        float(os.environ.get("BENCH_BACKEND_TIMEOUT", 300)), "backend init"
+    )
     on_tpu = jax.default_backend() == "tpu"
+    watchdog.cancel()
+    # the r5 wedge incidents struck DURING remote compiles, not just init —
+    # keep a long fuse armed over the whole compile+run
+    watchdog = _watchdog(
+        float(os.environ.get("BENCH_RUN_TIMEOUT", 2400)), "compile/run"
+    )
     bench_model = os.environ.get("BENCH_MODEL", "8b-layer")
     if bench_model == "8b-layer":
         # north-star layer proxy (the DEFAULT bench): the EXACT Llama-3-8B
@@ -277,6 +312,7 @@ def main() -> None:
     flops_per_token = 6 * n_active + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
     mfu = tokens_per_sec_chip * flops_per_token / _detect_peak()
 
+    watchdog.cancel()
     print(json.dumps({
         "metric": "llama_clm_train_mfu",
         "value": round(mfu, 4),
