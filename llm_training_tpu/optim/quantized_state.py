@@ -42,8 +42,17 @@ import jax.numpy as jnp
 
 DEFAULT_BLOCK = 256
 
-# optax state fields that are non-negative second-moment accumulators
-# (adam/adamw nu, adafactor v/v_row/v_col) — these take the sqrt codec
+# Quantization is a WHITELIST of known optimizer state fields — anything
+# unrecognized stays fp32. This is what makes the codec safe under wrapper
+# transforms: e.g. optax.MultiSteps' acc_grads accumulator repeatedly adds
+# small per-micro-batch gradients, which block quantization would zero out;
+# it is not listed, so it passes through exact.
+#   sym codec: first-moment / momentum EMAs (quantization error decays
+#   geometrically under the EMA update). 'ema' is adafactor's momentum
+#   (optax appends optax.transform.ema when momentum is set)
+_SYM_FIELDS = {"mu", "trace", "ema"}
+#   sqrt codec: non-negative second-moment accumulators (adam/adamw nu,
+#   adafactor v/v_row/v_col)
 _NONNEG_FIELDS = {"nu", "v", "v_row", "v_col"}
 
 
@@ -100,16 +109,23 @@ def dequantize_array(qa: QuantArray) -> jnp.ndarray:
     return xb.reshape(qa.q.shape)
 
 
-def _is_nonneg_field(path) -> bool:
-    """Whether this leaf sits under a non-negative optax state field.
+def _codec_kind(path) -> str | None:
+    """Which codec this leaf's optax state field gets (None = keep fp32).
 
     State trees nest as (chain idx, state-namedtuple field, *param-tree
     path): namedtuple fields flatten to GetAttrKey (which has .name), while
     param-tree keys are DictKey (.key) — so checking only .name entries
-    against the field set cannot be fooled by a model param literally named
-    'v', and survives wrapper states (MaskedState etc.) that add their own
-    GetAttrKeys around the field."""
-    return any(getattr(entry, "name", None) in _NONNEG_FIELDS for entry in path)
+    against the field sets cannot be fooled by a model param literally
+    named 'v', and survives wrapper states (MaskedState, MultiStepsState)
+    that add their own GetAttrKeys around the field. A non-negative match
+    wins over a sym match (no current optax state nests one inside the
+    other, but under-stepping is the safe direction)."""
+    names = {getattr(entry, "name", None) for entry in path}
+    if names & _NONNEG_FIELDS:
+        return "sqrt"
+    if names & _SYM_FIELDS:
+        return "sym"
+    return None
 
 
 def _boxed(ref, value):
@@ -127,21 +143,24 @@ def encode_state(state: Any, block: int = DEFAULT_BLOCK) -> Any:
     """Quantize every eligible fp32 array in an optax state tree.
 
     Eligible: floating arrays with ndim >= 1 whose last axis is a multiple
-    of `block`. Field name picks the codec (nu/v* -> "sqrt", else "sym").
-    Partitioned boxes are preserved AROUND q and scale so the abstract tree
-    still carries per-array sharding metadata.
+    of `block`, under a WHITELISTED optimizer field (mu/trace -> "sym",
+    nu/v* -> "sqrt"; anything else — counts, MultiSteps grad accumulators,
+    unknown fields — stays exact). Partitioned boxes are preserved AROUND
+    q and scale so the abstract tree still carries per-array sharding
+    metadata.
     """
 
     def enc(path, leaf):
         value = _unboxed(leaf)
+        kind = _codec_kind(path)
         if (
-            not hasattr(value, "ndim")
+            kind is None
+            or not hasattr(value, "ndim")
             or value.ndim < 1
             or not jnp.issubdtype(value.dtype, jnp.floating)
             or value.shape[-1] % block != 0
         ):
             return leaf
-        kind = "sqrt" if _is_nonneg_field(path) else "sym"
         qa = quantize_array(value, kind, block)
         return QuantArray(
             q=_boxed(leaf, qa.q), scale=_boxed(leaf, qa.scale),
@@ -169,20 +188,25 @@ def decode_state(state: Any) -> Any:
 
 
 def cast_state(state: Any, dtype) -> Any:
-    """Elementwise storage cast (the "bfloat16" offload dtype): every
-    floating array with ndim >= 1 is stored as `dtype`; ints/scalars stay."""
+    """Elementwise storage cast (the "bfloat16" offload dtype): floating
+    arrays under whitelisted fields are stored as `dtype`; ints/scalars and
+    unlisted fields (e.g. MultiSteps grad accumulators, whose repeated
+    small adds need fp32) stay."""
 
-    def cast(leaf):
+    def cast(path, leaf):
         value = _unboxed(leaf)
         if (
-            hasattr(value, "ndim")
+            _codec_kind(path) is not None
+            and hasattr(value, "ndim")
             and value.ndim >= 1
             and jnp.issubdtype(value.dtype, jnp.floating)
         ):
             return _boxed(leaf, value.astype(dtype))
         return leaf
 
-    return jax.tree.map(cast, state, is_leaf=lambda x: isinstance(x, nn.Partitioned))
+    return jax.tree_util.tree_map_with_path(
+        cast, state, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
 
 
 def uncast_state(state: Any, dtype=jnp.float32) -> Any:

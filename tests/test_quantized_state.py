@@ -203,13 +203,81 @@ def test_blocked_compressed_step_matches_fp32(devices, offload_dtype):
         assert all(leaf.dtype == jnp.bfloat16 for leaf in bf_leaves)
 
 
-def test_compressed_dtype_requires_blocked_path(devices):
+def test_compressed_dtype_requires_offload(devices):
     trainer, objective, dm = _offloadable_trainer("int8")
     trainer.config = trainer.config.model_copy(
-        update={"accumulate_grad_batches": 2}
+        update={"offload_optimizer_state": False}
     )
-    with pytest.raises(ValueError, match="blocked offload"):
+    with pytest.raises(ValueError, match="offload_optimizer_state"):
         trainer._build_tx(objective)
+
+
+def _acc_grad_leaves(opt_state):
+    return [
+        leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            opt_state, is_leaf=lambda x: isinstance(x, QuantArray)
+        )[0]
+        if any(getattr(p, "name", None) == "acc_grads" for p in path)
+    ]
+
+
+def test_serialized_int8_with_accumulation_matches_fp32(devices):
+    """Grad accumulation forces the serialized (whole-tree) layout; the
+    codec's field whitelist must leave MultiSteps' acc_grads exact while
+    still compressing mu/nu, and the accumulated update must track the
+    fp32-state run. Runs the REAL serialized train_step (device memory
+    kinds) for two micro-steps = one optimizer step."""
+    import flax.linen as nn
+
+    from llm_training_tpu.parallel.mesh import MeshConfig, build_mesh
+    from llm_training_tpu.trainer.state import TrainState
+    from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+    runs = {}
+    for dtype in ("float32", "int8"):
+        trainer, objective, dm = _offloadable_trainer(dtype)
+        trainer.config = trainer.config.model_copy(
+            update={"accumulate_grad_batches": 2}
+        )
+        trainer.mesh = build_mesh(MeshConfig(fsdp_size=4, tensor_parallel_size=2))
+        dm.setup()
+        it = dm.train_batches(start_step=0)
+        b1, b2 = next(it), next(it)
+        with trainer.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+            tx, _ = trainer._build_tx(objective)
+            assert not trainer._blocked_offload  # accumulation -> serialized
+            params = nn.meta.unbox(objective.init_params(jax.random.key(0), b1))
+            opt_state = trainer._opt_init(tx, params)
+            state = TrainState.create(params, opt_state, jax.random.key(7))
+            dev = jax.sharding.NamedSharding(
+                trainer.mesh, jax.sharding.PartitionSpec()
+            )
+            trainer.state_shardings = jax.tree.map(
+                lambda _: dev, jax.eval_shape(lambda: state)
+            )
+            step = jax.jit(trainer._build_step(objective, tx))
+            s1, _ = step(state, b1)
+            s2, _ = step(s1, b2)
+        runs[dtype] = (opt_state, s1, s2)
+
+    init_q, s1_q, s2_q = runs["int8"]
+    init_f, s1_f, s2_f = runs["float32"]
+    # mu/nu compressed, accumulators exact fp32 arrays
+    flat_q = jax.tree_util.tree_flatten_with_path(
+        init_q, is_leaf=lambda x: isinstance(x, QuantArray)
+    )[0]
+    assert any(isinstance(leaf, QuantArray) for _, leaf in flat_q)
+    accs = _acc_grad_leaves(init_q)
+    assert accs and all(
+        not isinstance(a, QuantArray) and a.dtype == jnp.float32 for a in accs
+    )
+    # after micro-step 1 (accumulate only) the accumulators match BITWISE
+    for a, b in zip(_acc_grad_leaves(s1_q.opt_state), _acc_grad_leaves(s1_f.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # after micro-step 2 the optimizer fired: params track the fp32 run
+    for a, b in zip(jax.tree.leaves(s2_q.params), jax.tree.leaves(s2_f.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=1e-3)
 
 
 def test_checkpoint_roundtrip_int8_state(tmp_path, devices):
