@@ -93,7 +93,15 @@ def quantize_array(x: jnp.ndarray, kind: str, block: int) -> QuantArray:
     elif kind == "sqrt":
         r = jnp.sqrt(xb)
         scale = jnp.max(r, axis=-1) / 254.0
-        q = jnp.ceil(r / jnp.maximum(scale, 1e-30)[..., None])
+        # ceil with a slack of 5e-4 grid steps: large enough to absorb the
+        # fp32 rounding of a dequantize->requantize cycle (~6e-5 steps at
+        # code 254), so the codec is GRID-IDEMPOTENT — re-encoding an
+        # unchanged state reproduces q and scale exactly instead of
+        # ratcheting codes upward (the serialized offload path re-encodes
+        # every accumulation micro-step). Weakens the never-underestimate
+        # guarantee by at most 5e-4 steps — noise against the sqrt(nu)/eps
+        # blowup the ceil protects from
+        q = jnp.ceil(r / jnp.maximum(scale, 1e-30)[..., None] - 5e-4)
         q = jnp.clip(q, 0, 255).astype(jnp.uint8)
     else:
         raise ValueError(f"unknown quantization kind {kind!r}")
