@@ -102,6 +102,11 @@ def quantize_array(x: jnp.ndarray, kind: str, block: int) -> QuantArray:
         # guarantee by at most 5e-4 steps — noise against the sqrt(nu)/eps
         # blowup the ceil protects from
         q = jnp.ceil(r / jnp.maximum(scale, 1e-30)[..., None] - 5e-4)
+        # the slack must never let a NONZERO nu encode to 0 — dequantized
+        # nu = 0 is the sqrt(nu)/eps catastrophe this codec exists to
+        # prevent. Floor positive inputs at code 1 (idempotent: code 1
+        # dequantizes to exactly one step, which re-encodes to 1)
+        q = jnp.maximum(q, (xb > 0).astype(q.dtype))
         q = jnp.clip(q, 0, 255).astype(jnp.uint8)
     else:
         raise ValueError(f"unknown quantization kind {kind!r}")

@@ -108,9 +108,20 @@ def test_pipeline_microbatch_counts_agree(devices):
             np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
-def test_pipeline_sharded_train_step(devices):
-    """One real train step on the pipe=2 x fsdp=2 x tensor=2 mesh: executes,
-    loss finite, parameters actually move."""
+@pytest.mark.parametrize(
+    "mesh_kw",
+    [
+        dict(pipeline_parallel_size=2, fsdp_size=2, tensor_parallel_size=2),
+        # sequence-parallel activations inside each stage (plain SP, not
+        # ring): the shift buffers carry an act_seq axis sharded over
+        # 'sequence' and GSPMD composes it with the stage shift
+        dict(pipeline_parallel_size=2, fsdp_size=2, sequence_parallel_size=2),
+    ],
+    ids=["pipe-fsdp-tp", "pipe-fsdp-sp"],
+)
+def test_pipeline_sharded_train_step(devices, mesh_kw):
+    """One real train step on a pipe-composed mesh: executes, loss finite,
+    parameters actually move."""
     objective = CLM(
         CLMConfig(
             model=ModelProvider(
@@ -135,9 +146,7 @@ def test_pipeline_sharded_train_step(devices):
     trainer = Trainer(
         TrainerConfig(
             max_steps=2, log_every_n_steps=1,
-            mesh=MeshConfig(
-                pipeline_parallel_size=2, fsdp_size=2, tensor_parallel_size=2
-            ),
+            mesh=MeshConfig(**mesh_kw),
         ),
         callbacks=[Rec()],
     )
@@ -310,6 +319,9 @@ def test_pipeline_save_resume_matches_uninterrupted(devices, tmp_path):
         checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir, async_save=False)),
     ).fit(objective(), data())
 
+    # the resumed run must actually RESUME at step 4 (a silent restore
+    # miss would rerun 1-6 deterministically and pass the loss checks)
+    assert set(rec_b.losses) == {4, 5, 6}
     for step in range(1, 4):  # checkpointing must not perturb the live run
         np.testing.assert_allclose(
             rec_a.losses[step], rec_full.losses[step], rtol=1e-6,

@@ -54,6 +54,9 @@ def test_sqrt_codec_never_underestimates():
     r, dr = np.sqrt(np.asarray(x)), np.sqrt(deq)
     step = np.repeat(np.asarray(qa.scale), 256, axis=-1)
     assert (dr >= r - 1e-3 * step).all()
+    # and NEVER to zero for nonzero input — dequantized nu = 0 would blow
+    # up the Adam step by sqrt(nu_true)/eps
+    assert (deq[np.asarray(x) > 0] > 0).all()
     # and it is still a useful approximation for values near the block max
     big = np.asarray(x) > np.asarray(x).max(-1, keepdims=True) * 0.1
     rel = np.abs(deq - np.asarray(x)) / np.asarray(x)
