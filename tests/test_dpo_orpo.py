@@ -113,6 +113,44 @@ def test_dpo_initial_loss_is_log2_and_improves(devices):
     _assert_ref_frozen_policy_moved(objective, trainer, state)
 
 
+@pytest.mark.slow
+def test_dpo_with_pipeline_parallelism(devices):
+    """DPO's policy + frozen-ref pair both run the GPipe stage layout on a
+    pipe mesh (the trainer's stage cross-check demands they match): initial
+    loss is exactly ln 2 (policy == ref through the pipeline), training
+    moves it, the ref stays frozen."""
+    from llm_training_tpu.parallel import MeshConfig
+
+    pp_model = dict(
+        TINY_MODEL,
+        model_kwargs=dict(
+            TINY_MODEL["model_kwargs"],
+            pipeline_stages=2, pipeline_microbatches=4,
+        ),
+    )
+    objective = DPO(
+        DPOConfig(
+            model=ModelProvider(**pp_model),
+            optim=OptimConfig(learning_rate=1e-3, lr_scheduler="constant"),
+            beta=0.1,
+        )
+    )
+    rec = _Rec()
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=8, log_every_n_steps=1,
+            mesh=MeshConfig(
+                pipeline_parallel_size=2, fsdp_size=2, tensor_parallel_size=2
+            ),
+        ),
+        callbacks=[rec],
+    )
+    state = trainer.fit(objective, _datamodule())
+    assert rec.metrics[0]["loss"] == pytest.approx(float(np.log(2)), abs=1e-3)
+    assert rec.metrics[-1]["loss"] < rec.metrics[0]["loss"]
+    _assert_ref_frozen_policy_moved(objective, trainer, state)
+
+
 def test_dpo_label_smoothing_changes_loss():
     cfg = DPOConfig(model=ModelProvider(**TINY_MODEL), label_smoothing=0.2)
     # closed-form check of the smoothed sigmoid loss at a known logit gap
