@@ -306,3 +306,43 @@ def test_hf_causal_lm_through_model_provider(hf_llama_dir):
     model = provider.get_model()
     assert isinstance(model, Llama)
     assert model.config.scan_layers is False
+
+
+def test_hf_causal_lm_pipeline_load_logits_parity(hf_llama_dir, devices):
+    """The llama-3-8b_pp_pt.yaml path end-to-end at tiny scale: HFCausalLM
+    routes the checkpoint into a PIPELINED Llama (pipeline_stages forwarded
+    through the router), load_pretrained_params adapts the scan-layout
+    conversion into the [S, L/S, ...] stage stacks, and the loaded model's
+    logits match the scan-routed model loaded from the same directory."""
+    from llm_training_tpu.models import HFCausalLM, HFCausalLMConfig
+    from llm_training_tpu.models.hf_io import load_pretrained_params
+
+    m_scan = HFCausalLM(
+        HFCausalLMConfig(hf_path=str(hf_llama_dir), compute_dtype="float32")
+    )
+    m_pp = HFCausalLM(
+        HFCausalLMConfig(
+            hf_path=str(hf_llama_dir),
+            compute_dtype="float32",
+            pipeline_stages=2,
+            pipeline_microbatches=2,
+        )
+    )
+    assert m_pp.config.pipeline_stages == 2
+
+    p_scan = load_pretrained_params(m_scan.config, str(hf_llama_dir))
+    p_pp = load_pretrained_params(m_pp.config, str(hf_llama_dir))
+    stack_leaf = jax.tree.leaves(p_pp["params"]["pipeline"])[0]
+    assert stack_leaf.shape[:2] == (2, 1)  # [S, L/S, ...]
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(1, TINY_HF["vocab_size"], (4, 16)),
+        jnp.int32,
+    )
+    seg = jnp.ones((4, 16), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(16), (4, 16)).astype(jnp.int32)
+    out_scan = m_scan.apply(p_scan, ids, seg, pos)
+    out_pp = m_pp.apply(p_pp, ids, seg, pos)
+    np.testing.assert_allclose(
+        np.asarray(out_pp.logits), np.asarray(out_scan.logits), atol=2e-5
+    )
