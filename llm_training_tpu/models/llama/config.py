@@ -240,12 +240,6 @@ class LlamaConfig(BaseModelConfig):
                     "pipeline_stages > 1 requires scan_layers=True (stages "
                     "are a leading axis over the scanned stack)"
                 )
-            if self.num_experts:
-                raise ValueError(
-                    "pipeline_stages > 1 does not compose with MoE layers "
-                    "yet (router load-balancing stats would pool over "
-                    "bubble-tick junk batches)"
-                )
             if self.num_hidden_layers % self.pipeline_stages != 0:
                 raise ValueError(
                     f"num_hidden_layers {self.num_hidden_layers} must split "

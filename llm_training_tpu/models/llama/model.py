@@ -440,11 +440,12 @@ class Llama(nn.Module):
                 layer_cls = nn.remat(
                     _ScannedLayer, policy=policy, prevent_cse=False,
                 )
-            hidden = PipelinedLayers(
+            # aux comes back pre-pooled to the scan layout ([L, ...], real
+            # microbatches only) so the MoE tail below applies unchanged
+            hidden, aux = PipelinedLayers(
                 cfg, layer_cls, LlamaDecoderLayer, name="pipeline"
             )(hidden, segment_ids, cos, sin)
-            return hidden, jnp.float32(0.0), jnp.float32(0.0)
-        if cfg.scan_layers:
+        elif cfg.scan_layers:
             layer_cls = _ScannedLayer
             if policy is not None:
                 layer_cls = nn.remat(

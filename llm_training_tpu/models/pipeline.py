@@ -85,13 +85,16 @@ class _Tick(nn.Module):
             axis_size=self.stages,
             metadata_params={nn.PARTITION_NAME: "stages"},
         )
-        h_out, _aux = stages(self.config, self.inner_cls, name="layers")(
+        h_out, aux = stages(self.config, self.inner_cls, name="layers")(
             h_in, seg_in, cos_in, sin_in
         )
         h_out = nn.with_logical_constraint(
             h_out, ("stages", "batch", "act_seq", "act_embed")
         )
-        return (h_out, seg_in, cos_in, sin_in), h_out[-1]
+        # aux: per-stage, per-layer router stats ([S, per, ...]; a zero
+        # scalar per layer for dense models) — emitted every tick, masked
+        # to the valid (tick, stage) cells by the caller
+        return (h_out, seg_in, cos_in, sin_in), (h_out[-1], aux)
 
 
 class PipelinedLayers(nn.Module):
@@ -146,7 +149,8 @@ class PipelinedLayers(nn.Module):
                 * mesh.shape.get("fsdp", 1)
                 * mesh.shape.get("expert", 1)
             )
-            if batch_ways > 1 and mb % batch_ways != 0:
+            if batch_ways > 1 and mb % batch_ways != 0 and batch > 1:
+                # batch == 1 is the shape-level init trace, not a real run
                 logger.warning(
                     "pipeline microbatch size %d does not divide the %d-way "
                     "batch sharding (data*fsdp*expert): GSPMD pads each "
@@ -189,11 +193,46 @@ class PipelinedLayers(nn.Module):
             out_axes=0,
             length=ticks,
         )
-        _, ys = tick_loop(
+        _, (outs, aux) = tick_loop(
             self.config, self.layer_cls, self.inner_cls,
             stages, num_layers // stages, name="ticks",
         )(carry, xs)
 
         # last stage finishes microbatch m at tick m + S - 1
-        out = ys[stages - 1 :]
-        return out.reshape((batch,) + out.shape[2:])
+        out = outs[stages - 1 :]
+        hidden = out.reshape((batch,) + out.shape[2:])
+
+        # pool router stats over the REAL (tick, stage) cells only: stage s
+        # processes microbatch t - s at tick t, so exactly `micro` cells per
+        # (stage, layer) are live and each real microbatch visits each
+        # layer once. MoEMLP normalizes sel_frac/mean_prob by its OWN
+        # dispatch's valid-token count, so the cells are recombined
+        # weighted by each microbatch's share of valid tokens —
+        # sum_m (n_m/N)·(counts_m/n_m) == sum(counts)/N, the scan path's
+        # global normalization, EXACTLY, even with padding concentrated in
+        # one microbatch. Bubble cells carry zero-token junk and get
+        # weight 0
+        delta = jnp.arange(ticks)[:, None] - jnp.arange(stages)[None, :]
+        valid = (delta >= 0) & (delta < micro)
+
+        def pool(a, weights):  # [T, S, per, ...] -> [L, ...]
+            w = weights.astype(a.dtype).reshape(
+                weights.shape + (1,) * (a.ndim - 2)
+            )
+            return (a * w).sum(axis=0).reshape((num_layers,) + a.shape[3:])
+
+        if cfg.num_experts:
+            n_valid = (microbatched(segment_ids) > 0).sum(axis=(1, 2))  # [M]
+            cell_tokens = jnp.where(
+                valid, n_valid[jnp.clip(delta, 0, micro - 1)], 0
+            ).astype(jnp.float32)
+            token_share = cell_tokens / jnp.maximum(n_valid.sum(), 1.0)
+            sel_frac, mean_prob, dropped = aux
+            aux = (
+                pool(sel_frac, token_share),
+                pool(mean_prob, token_share),
+                pool(dropped, valid),  # absolute counts: plain masked sum
+            )
+        else:
+            aux = None
+        return hidden, aux

@@ -436,6 +436,20 @@ class Trainer:
                     "bubbles cost throughput without parallelism",
                     name, pp_model,
                 )
+            if (
+                pp_model > 1
+                and self.mesh.shape.get("expert", 1) > 1
+                and getattr(getattr(model, "config", None), "num_experts", None)
+            ):
+                # the EP dispatch is a shard_map, which cannot sit under
+                # the pipeline's stage vmap; MoE under PP runs the plain
+                # (ragged/dense/bucketed) dispatch with experts sharded
+                # over fsdp/tensor like other params
+                raise ValueError(
+                    "pipeline_stages > 1 does not compose with "
+                    "expert_parallel_size > 1 (shard_map under the stage "
+                    "vmap); use fsdp/tensor sharding for the experts"
+                )
 
         # the boxed (Partitioned-annotated) abstract tree exists only to
         # derive shardings; the canonical runtime state is unboxed

@@ -196,6 +196,81 @@ def test_pipeline_loss_decreases(devices):
     assert min(losses[-5:]) < losses[0] - 0.3
 
 
+MOE_KW = dict(
+    KW, num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+)
+
+
+def test_pipeline_moe_matches_scan(devices):
+    """MoE under PP: logits AND the pooled router-stat aux loss must match
+    the scanned stack exactly — the pipeline pools sel_frac/mean_prob over
+    the real (tick, stage) cells only (each equal-sized microbatch's mean
+    averages to the full-batch mean) and masks bubble-tick junk stats."""
+    import flax.linen as nn
+
+    from llm_training_tpu.models.llama.config import LlamaConfig
+    from llm_training_tpu.models.llama.model import Llama
+
+    m_s = Llama(LlamaConfig(**MOE_KW))
+    m_p = Llama(LlamaConfig(**MOE_KW, pipeline_stages=2, pipeline_microbatches=4))
+    ids, seg, pos = _inputs()
+    # concentrate padding in the FIRST microbatch (rows 0-1): the router
+    # stats normalize per dispatch by valid-token count, so equal-weight
+    # pooling would diverge here — the token-share weighting must not
+    seg = seg.at[:2, 10:].set(0)
+    p_p = nn.meta.unbox(m_p.init(jax.random.key(0), ids, seg, pos))["params"]
+    p_s = _scan_params_from_pipeline(p_p, KW["num_hidden_layers"])
+
+    out_s = m_s.apply({"params": p_s}, ids, seg, pos)
+    out_p = m_p.apply({"params": p_p}, ids, seg, pos)
+    np.testing.assert_allclose(
+        np.asarray(out_p.logits), np.asarray(out_s.logits), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(out_p.aux_loss), float(out_s.aux_loss), rtol=1e-6
+    )
+
+    def loss_fn(params, model):
+        out = model.apply({"params": params}, ids, seg, pos)
+        logp = jax.nn.log_softmax(out.logits.astype(jnp.float32))
+        return jnp.mean(logp[..., 0] ** 2) + 0.01 * out.aux_loss
+
+    g_s = jax.grad(loss_fn)(p_s, m_s)
+    g_p = _scan_params_from_pipeline(
+        jax.grad(loss_fn)(p_p, m_p), KW["num_hidden_layers"]
+    )
+    for a, b in zip(jax.tree.leaves(g_s), jax.tree.leaves(g_p)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=2e-5)
+
+
+def test_pipeline_moe_rejects_expert_parallel(devices):
+    objective = CLM(
+        CLMConfig(
+            model=ModelProvider(
+                model_class="llm_training_tpu.models.Llama",
+                model_kwargs=dict(
+                    MOE_KW, pipeline_stages=2, pipeline_microbatches=2
+                ),
+            ),
+            optim=OptimConfig(learning_rate=1e-3),
+        )
+    )
+    dm = DummyDataModule(
+        DummyDataModuleConfig(batch_size=8, max_length=32, num_samples=16, vocab_size=128)
+    )
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=1,
+            mesh=MeshConfig(
+                pipeline_parallel_size=2, expert_parallel_size=2,
+                tensor_parallel_size=2,
+            ),
+        )
+    )
+    with pytest.raises(ValueError, match="expert_parallel"):
+        trainer.fit(objective, dm)
+
+
 def test_pipeline_hf_round_trip(devices):
     """HF checkpoint -> pipeline layout -> HF: loading a converted HF state
     dict into the [S, L/S] layout must give logits parity with the scan
@@ -341,11 +416,6 @@ def test_pipeline_config_validation():
         LlamaConfig(**{**KW, "num_hidden_layers": 5}, pipeline_stages=2)
     with pytest.raises(ValueError, match="scan_layers"):
         LlamaConfig(**KW, pipeline_stages=2, scan_layers=False)
-    with pytest.raises(ValueError, match="MoE"):
-        LlamaConfig(
-            **KW, pipeline_stages=2, num_experts=4, num_experts_per_tok=2,
-            moe_intermediate_size=32,
-        )
     with pytest.raises(ValueError, match="rotary"):
         LlamaConfig(**KW, pipeline_stages=2, position_embedding_type="learned")
     with pytest.raises(ValueError, match="ring_attention"):
