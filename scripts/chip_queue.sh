@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# The queued TPU measurements (BASELINE.md "r5 status notes"), in priority
+# order, each under timeout with the bench watchdog armed — safe to run
+# unattended the moment the axon tunnel is back. Results append to
+# chip_queue_results.log; transfer the numbers into BASELINE.md tables.
+#
+# Wedge discipline (verify-skill gotchas): one TPU process at a time,
+# every run under timeout, smallest shapes first for any new graph shape.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-/tmp/jaxcache}"
+LOG=chip_queue_results.log
+
+run() {
+    local name="$1" tmo="$2"; shift 2
+    echo "=== $name ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
+    timeout "$tmo" env "$@" python bench.py 2>&1 | tail -2 | tee -a "$LOG"
+    echo "rc=$? for $name" | tee -a "$LOG"
+}
+
+probe() {
+    timeout 90 python -c "import jax; print(jax.devices())" >/dev/null 2>&1
+}
+
+if ! probe; then
+    echo "tunnel unreachable — aborting before any measurement" | tee -a "$LOG"
+    exit 3
+fi
+
+# 1. default bench sanity (must be rc=0, ~0.72 MFU at this HEAD's kernels)
+run default-8b-layer 900
+
+# 2. int8-compressed offloaded state (NEW, target >=0.45 from 0.3035 fp32)
+run offload-int8 1200 BENCH_OFFLOAD=1 BENCH_OFFLOAD_DTYPE=int8 BENCH_LAYERS=3 BENCH_BATCH=2
+run offload-bf16 1200 BENCH_OFFLOAD=1 BENCH_OFFLOAD_DTYPE=bfloat16 BENCH_LAYERS=3 BENCH_BATCH=2
+
+# 3. bucketed MoE A/B vs ragged (trainer graph; small seq first — the
+#    bucketed PROBE graph is the prime wedge suspect, never run it)
+probe || exit 3
+run moe-bucketed-small 900 BENCH_MODEL=moe BENCH_MOE_IMPL=bucketed BENCH_SEQ=512 BENCH_BATCH=4
+run moe-bucketed 1500 BENCH_MODEL=moe BENCH_MOE_IMPL=bucketed
+run moe-ragged 1500 BENCH_MODEL=moe
+
+# 4. flash microbench re-measure with the gradient-DCE fix (fwd+bwd rows)
+probe || exit 3
+echo "=== flash microbench ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
+timeout 2400 python scripts/microbench_flash.py 2>&1 | tail -20 | tee -a "$LOG"
+
+# 5. MoE grouped-matmul re-measure — THIS IS WHAT WEDGED THE TUNNEL at
+#    04:20 (r5 outage #2). Smallest shapes first; stop at first failure.
+probe || exit 3
+echo "=== moe microbench small ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
+timeout 600 env MOE_ROWS=8192 CASES=8x704 IMPLS=ragged PASSES=fwd \
+    python scripts/microbench_moe.py 2>&1 | tail -5 | tee -a "$LOG" || exit 0
+probe || exit 3
+echo "=== moe microbench full ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
+timeout 2400 env IMPLS=ragged python scripts/microbench_moe.py 2>&1 | tail -16 | tee -a "$LOG"
+
+echo "=== queue complete ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
