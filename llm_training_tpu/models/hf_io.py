@@ -341,6 +341,8 @@ _ARCH_TO_FAMILY = {
     "starcoder2": "llm_training_tpu.models.Llama",  # LayerNorm + gelu MLP + biases
     "stablelm": "llm_training_tpu.models.Llama",  # biased LayerNorm + swiglu + partial rope
     "cohere": "llm_training_tpu.models.Llama",  # parallel blocks, interleaved rope
+    "cohere2": "llm_training_tpu.models.Llama",  # + sliding/full pattern, NoPE full layers
+    "code_llama": "llm_training_tpu.models.Llama",  # llama graph verbatim
     "phi": "llm_training_tpu.models.Llama",  # parallel + partial rotary + biases
     "nemotron": "llm_training_tpu.models.Llama",  # layernorm1p + relu^2 MLP
     "ernie4_5": "llm_training_tpu.models.Llama",  # interleaved full-dim rope

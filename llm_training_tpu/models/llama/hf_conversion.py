@@ -585,6 +585,12 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     return out
 
 
+def _derived_no_rope(layer_types) -> list[int]:
+    """The hybrid-NoPE rule EXAONE-4 and Cohere2 share: sliding layers
+    rotate (1), full-attention layers skip rope (0)."""
+    return [1 if lt == "sliding_attention" else 0 for lt in layer_types]
+
+
 def _check_exportable(config: LlamaConfig) -> None:
     """Refuse feature combinations no HF architecture represents — a silent
     plain-llama fallthrough would reload with random-initialized modules."""
@@ -806,10 +812,8 @@ def _check_exportable(config: LlamaConfig) -> None:
             config.no_rope_layers is None
             or (
                 config.layer_types is not None
-                and config.no_rope_layers == [
-                    1 if lt == "sliding_attention" else 0
-                    for lt in config.layer_types
-                ]
+                and config.no_rope_layers
+                == _derived_no_rope(config.layer_types)
             )
         )
     )
@@ -862,6 +866,23 @@ def _check_exportable(config: LlamaConfig) -> None:
             "pre-norm, per-head qk-norm, symmetric bias, full rotary); "
             "this combination cannot be exported"
         )
+    is_cohere2_pattern = (
+        config.norm_scheme == "parallel"
+        and config.norm_type == "layernorm_nobias"
+        and config.rope_interleaved
+        and config.sliding_window is not None
+        and config.num_experts is None
+        # HF Cohere2 has no qk-norm (only Cohere R+ does) — a qk-normed
+        # config exported as cohere2 would silently drop it on reload
+        and not config.qk_norm
+        # Cohere2's NoPE is DERIVED like EXAONE-4's: sliding layers
+        # rotate, full-attention layers skip rope. It MUST be present and
+        # exact — rope-on-every-layer cannot ride this export (the HF
+        # module would skip rope on full layers, changing the math)
+        and config.layer_types is not None
+        and config.no_rope_layers == _derived_no_rope(config.layer_types)
+        and (not config.rope_scaling or not config.dual_local_rope)
+    )
     is_ministral_pattern = (
         config.norm_scheme == "pre" and not config.qk_norm
         and not config.attention_bias and not config.attention_out_bias
@@ -872,11 +893,13 @@ def _check_exportable(config: LlamaConfig) -> None:
     )
     if config.layer_types is not None and not (
         is_olmo3_pattern or is_ministral_pattern or is_exaone4_pattern
+        or is_cohere2_pattern
     ):
         raise ValueError(
             "per-layer sliding layer_types only exist in HF as OLMo-3 "
-            "(post-norm + full qk-norm), Ministral (bias-free pre-norm), or "
-            "EXAONE-4 (post-norm + head qk-norm); this combination cannot "
+            "(post-norm + full qk-norm), Ministral (bias-free pre-norm), "
+            "EXAONE-4 (post-norm + head qk-norm), or Cohere2 (parallel "
+            "blocks + weight-only LayerNorm); this combination cannot "
             "be exported"
         )
     if config.no_rope_layers is not None and not (
@@ -886,10 +909,12 @@ def _check_exportable(config: LlamaConfig) -> None:
             and not config.qk_norm and config.num_experts is None
         )
         or is_exaone4_pattern
+        or is_cohere2_pattern
     ):
         raise ValueError(
             "no_rope_layers only exists in HF as SmolLM3 (a plain llama "
-            "graph) or as EXAONE-4's derived hybrid-NoPE pattern; this "
+            "graph), as EXAONE-4's derived hybrid-NoPE pattern, or as "
+            "Cohere2's (same derivation under parallel blocks); this "
             "combination cannot be exported"
         )
     if config.clip_qkv is not None and not (
@@ -1051,7 +1076,17 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "use_qk_norm": config.qk_norm,
              # honest tie flag: forcing True would re-tie an untied lm_head
              # on reload and silently discard its trained weights
-             "tie_word_embeddings": config.tie_word_embeddings}
+             "tie_word_embeddings": config.tie_word_embeddings,
+             # Command R7B: same graph + a sliding/full pattern (NoPE on
+             # full layers is derived by the HF module, like EXAONE-4)
+             **(
+                 {"model_type": "cohere2",
+                  "architectures": ["Cohere2ForCausalLM"],
+                  "sliding_window": config.sliding_window,
+                  "layer_types": list(config.layer_types)}
+                 if config.layer_types is not None
+                 else {}
+             )}
             if config.norm_scheme == "parallel"
             and config.norm_type == "layernorm_nobias"
             else {}
@@ -1441,7 +1476,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         rms_norm_eps=(
             get("norm_epsilon", 1e-5) if model_type == "starcoder2"
             else get("layer_norm_eps", 1e-5)
-            if model_type in ("cohere", "phi", "stablelm")
+            if model_type in ("cohere", "cohere2", "phi", "stablelm")
             else get("norm_eps", 1e-5) if model_type == "nemotron"
             else get("rms_norm_eps", 1e-6)
         ),
@@ -1487,7 +1522,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # layers unscaled) — Ministral rotates every layer with one table
         layer_types=(
             list(get("layer_types") or []) or None
-            if model_type in ("olmo3", "ministral", "exaone4") else None
+            if model_type in ("olmo3", "ministral", "exaone4", "cohere2")
+            else None
         ),
         dual_local_rope=model_type == "olmo3",
         # Mistral sets sliding_window unconditionally; the Qwen families gate
@@ -1505,15 +1541,13 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         no_rope_layers=(
             list(get("no_rope_layers") or []) or None
             if model_type == "smollm3"
-            else [
-                1 if lt == "sliding_attention" else 0
-                for lt in (get("layer_types") or [])
-            ]
-            if model_type == "exaone4" and get("sliding_window") is not None
+            else _derived_no_rope(get("layer_types") or [])
+            if model_type in ("exaone4", "cohere2")
+            and get("sliding_window") is not None
             else None
         ),
         qk_norm=(
-            get("use_qk_norm", False) if model_type == "cohere"
+            get("use_qk_norm", False) if model_type in ("cohere", "cohere2")
             else model_type in ("qwen3", "olmo2", "olmo3", "qwen3_moe",
                                 "olmoe", "flex_olmo", "hunyuan_v1_dense",
                                 "exaone4", "apertus")
@@ -1528,7 +1562,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         norm_scheme=(
             "post" if model_type in ("olmo2", "olmo3", "flex_olmo",
                                      "exaone4")
-            else "parallel" if model_type in ("cohere", "phi")
+            else "parallel" if model_type in ("cohere", "cohere2", "phi")
             else "sandwich" if model_type == "glm4"
             else "pre"
         ),
@@ -1538,7 +1572,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # norm, parallel blocks, interleaved rope, multiplicative logit scale.
         norm_type=(
             "layernorm" if model_type in ("starcoder2", "phi", "stablelm")
-            else "layernorm_nobias" if model_type == "cohere"
+            else "layernorm_nobias" if model_type in ("cohere", "cohere2")
             else "layernorm1p" if model_type == "nemotron"
             else "rmsnorm"
         ),
@@ -1560,11 +1594,12 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         ),
         lm_head_bias=(model_type == "phi"),
         rope_interleaved=model_type in (
-            "cohere", "glm", "glm4", "ernie4_5", "helium"
+            "cohere", "cohere2", "glm", "glm4", "ernie4_5", "helium"
         ),
         fused_gate_up=model_type in ("glm", "glm4"),
         logit_scale=(
-            get("logit_scale", 0.0625) if model_type == "cohere" else None
+            get("logit_scale", 0.0625)
+            if model_type in ("cohere", "cohere2") else None
         ),
         # Granite scalar multipliers (absent on every other family -> the
         # identity defaults). attention_multiplier stays None for non-Granite

@@ -51,7 +51,9 @@ timeout 2400 python scripts/microbench_flash.py 2>&1 | tail -20 | tee -a "$LOG"
 probe || exit 3
 echo "=== moe microbench small ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
 timeout 600 env MOE_ROWS=8192 CASES=8x704 IMPLS=ragged PASSES=fwd \
-    python scripts/microbench_moe.py 2>&1 | tail -5 | tee -a "$LOG" || exit 0
+    python scripts/microbench_moe.py 2>&1 | tail -5 | tee -a "$LOG" \
+    || { echo "moe small-probe failed — stopping before the full sweep" \
+         | tee -a "$LOG"; exit 4; }
 probe || exit 3
 echo "=== moe microbench full ($(date -u +%H:%M:%S)) ===" | tee -a "$LOG"
 timeout 2400 env IMPLS=ragged python scripts/microbench_moe.py 2>&1 | tail -16 | tee -a "$LOG"

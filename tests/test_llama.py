@@ -537,6 +537,15 @@ def test_unexportable_combos_raise():
         ))
     with _pytest.raises(ValueError, match="clip_qkv"):
         config_to_hf(LlamaConfig(**TINY, clip_qkv=3.0))  # dense, no OLMoE home
+    # a cohere-graph config with layer_types but rope on EVERY layer must
+    # refuse the cohere2 export (the HF module derives NoPE on full layers)
+    with _pytest.raises(ValueError, match="layer_types"):
+        config_to_hf(LlamaConfig(
+            **{**TINY, "num_hidden_layers": 2, "scan_layers": False},
+            norm_scheme="parallel", norm_type="layernorm_nobias",
+            rope_interleaved=True, sliding_window=8,
+            layer_types=["sliding_attention", "full_attention"],
+        ))
 
 
 def test_logits_parity_with_hf_phi():
@@ -1222,3 +1231,77 @@ def test_logits_parity_with_hf_apertus():
     assert out["model_type"] == "apertus" and out["hidden_act"] == "xielu"
     cfg2 = config_from_hf(out, compute_dtype="float32")
     assert cfg2.mlp_type == "xielu"
+
+
+@pytest.mark.slow
+def test_logits_parity_with_hf_cohere2():
+    """Cohere2 (Command R7B) = the Cohere graph + a sliding/full layer
+    pattern where full-attention layers skip rope entirely (derived NoPE,
+    like EXAONE-4) — routed to the looped Llama path via layer_types +
+    no_rope_layers."""
+    torch = pytest.importorskip("torch")
+    from transformers import Cohere2Config, Cohere2ForCausalLM
+
+    hf_config = Cohere2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=112,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, logit_scale=0.125,
+        layer_norm_eps=1e-5, sliding_window=8, sliding_window_pattern=2,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = Cohere2ForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == "parallel" and cfg.norm_type == "layernorm_nobias"
+    assert cfg.rope_interleaved and cfg.logit_scale == 0.125
+    assert cfg.layer_types == [
+        "sliding_attention", "full_attention",
+        "sliding_attention", "full_attention",
+    ]
+    assert cfg.no_rope_layers == [1, 0, 1, 0]  # full layers are NoPE
+    assert not cfg.scan_layers  # per-layer patterns loop
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    # 24 > sliding_window so local attention actually truncates
+    ids = np.random.default_rng(18).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_cohere2_export_round_trip(tmp_path):
+    """A parallel-block weight-only-LayerNorm config WITH a sliding/full
+    pattern must export as Cohere2 and reload in transformers with matching
+    logits."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **{**TINY, "num_hidden_layers": 2, "scan_layers": False},
+        norm_scheme="parallel", norm_type="layernorm_nobias",
+        rope_interleaved=True, logit_scale=0.125,
+        tie_word_embeddings=True, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"],
+        no_rope_layers=[1, 0],
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(19).integers(0, 128, (2, 24)))
+    params = model.init(jax.random.key(5), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "Cohere2ForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
