@@ -366,6 +366,7 @@ _ARCH_TO_FAMILY = {
     "bamba": "llm_training_tpu.models.Bamba",  # Mamba-2 SSD + attention hybrid
     # sparse MoE variants: stacked-expert MoEMLP block (models/moe.py)
     "mixtral": "llm_training_tpu.models.Llama",
+    "phimoe": "llm_training_tpu.models.Llama",  # Phi-3.5-MoE: SparseMixer routing + biased LN
     "granitemoe": "llm_training_tpu.models.Llama",  # granite multipliers + fused-stack MoE
     "granitemoeshared": "llm_training_tpu.models.Llama",  # + always-on shared MLP
     "qwen2_moe": "llm_training_tpu.models.Llama",

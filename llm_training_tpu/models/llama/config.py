@@ -114,6 +114,11 @@ class LlamaConfig(BaseModelConfig):
     # (block_sparse_moe.input_linear [E, 2I, H] fused gate/up stacks +
     # router.layer)
     moe_style: Literal["qwen", "mixtral", "granite"] = "qwen"
+    # router selection: plain softmax top-k, or Phi-3.5-MoE's SparseMixer
+    # (sequential argmax picks weighted by a band-masked softmax —
+    # models/moe.py:sparsemixer_topk; requires top_k=2)
+    moe_router_impl: Literal["softmax", "sparsemixer"] = "softmax"
+    router_jitter_eps: float = 0.01  # SparseMixer masking band half-width
     # qwen2-moe gates the shared expert with a per-token sigmoid;
     # granitemoeshared runs it always-on (no gate parameter)
     shared_expert_gated: bool = True

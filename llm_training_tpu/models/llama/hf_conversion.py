@@ -639,13 +639,31 @@ def _check_exportable(config: LlamaConfig) -> None:
         and config.residual_multiplier == 1.0
         and config.logits_scaling == 1.0
     )
+    is_phimoe = (
+        config.norm_type == "layernorm" and config.mlp_type == "swiglu"
+        and config.norm_scheme == "pre" and not config.qk_norm
+        and config.num_experts is not None
+        and config.moe_style == "mixtral"
+        and config.moe_router_impl == "sparsemixer"
+        and config.sliding_window is None and config.layer_types is None
+        and not config.rope_interleaved
+    )
+    if config.moe_router_impl == "sparsemixer" and not is_phimoe:
+        raise ValueError(
+            "sparsemixer routing only exists in HF as Phimoe (biased "
+            "LayerNorm pre-norm blocks + mixtral expert naming); exporting "
+            "any other combination would silently reload with softmax "
+            "routing"
+        )
     if (config.mlp_type == "gelu") != ln_gelu or (
-        (config.norm_type == "layernorm") != ln_gelu and not is_stablelm
+        (config.norm_type == "layernorm") != ln_gelu
+        and not is_stablelm and not is_phimoe
     ):
         raise ValueError(
             "mlp_type='gelu' and norm_type='layernorm' only exist together "
             "(as Starcoder2 or Phi) in HF — except biased LayerNorm with "
-            "swiglu, which is StableLM; this combination cannot be exported"
+            "swiglu, which is StableLM (dense) or Phimoe (SparseMixer MoE); "
+            "this combination cannot be exported"
         )
     is_nemotron = (
         config.norm_type == "layernorm1p" and config.mlp_type == "relu2"
@@ -770,9 +788,9 @@ def _check_exportable(config: LlamaConfig) -> None:
             "partial_rotary_factor only exists in HF on Phi, GLM/GLM-4, and "
             "Nemotron; it would be silently dropped otherwise"
         )
-    if config.lm_head_bias and not is_phi:
+    if config.lm_head_bias and not (is_phi or is_phimoe):
         raise ValueError(
-            "lm_head_bias only exists in HF on Phi; it would be silently "
+            "lm_head_bias only exists in HF on Phi and Phimoe; it would be silently "
             "dropped by any other export"
         )
     if config.qk_norm and config.qk_norm_position == "post_rope":
@@ -891,6 +909,18 @@ def _check_exportable(config: LlamaConfig) -> None:
         # HF Ministral rotates every layer with ONE table
         and (not config.rope_scaling or not config.dual_local_rope)
     )
+    if (
+        config.norm_scheme == "parallel"
+        and config.norm_type == "layernorm_nobias"
+        and config.sliding_window is not None
+        and config.layer_types is None
+    ):
+        raise ValueError(
+            "a cohere-graph config with a uniform sliding_window has no HF "
+            "home (Cohere has no windows; Cohere2 needs the sliding/full "
+            "layer_types pattern) — exporting as 'cohere' would silently "
+            "drop local attention on reload"
+        )
     if config.layer_types is not None and not (
         is_olmo3_pattern or is_ministral_pattern or is_exaone4_pattern
         or is_cohere2_pattern
@@ -1260,6 +1290,25 @@ def _moe_to_hf(config: LlamaConfig) -> dict[str, Any]:
             "HF; set moe_style='granite' to export it"
         )
     if config.moe_style == "mixtral":
+        if config.moe_router_impl == "sparsemixer":
+            # SparseMixer routing + biased LayerNorms = Phi-3.5-MoE
+            if config.norm_type != "layernorm":
+                raise ValueError(
+                    "sparsemixer routing only exists in HF as Phimoe "
+                    "(biased LayerNorm blocks); this combination cannot "
+                    "be exported"
+                )
+            return {
+                "model_type": "phimoe",
+                "architectures": ["PhimoeForCausalLM"],
+                "num_local_experts": config.num_experts,
+                "intermediate_size": config.moe_intermediate_size,
+                "router_jitter_noise": config.router_jitter_eps,
+                "input_jitter_noise": 0.0,
+                "lm_head_bias": config.lm_head_bias,
+                "attention_bias": config.attention_bias,
+                **common,
+            }
         return {
             "model_type": "mixtral",
             "architectures": ["MixtralForCausalLM"],
@@ -1407,7 +1456,20 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             "Arcee graph is modeled as the non-gated relu2 MLP"
         )
     moe: dict[str, Any] = {}
-    if model_type == "mixtral":
+    if model_type == "phimoe":
+        # Phi-3.5-MoE: mixtral expert naming, SparseMixer routing (weights
+        # NOT renormalized across the 2 picks), biased LayerNorms
+        moe = dict(
+            num_experts=get("num_local_experts"),
+            num_experts_per_tok=get("num_experts_per_tok", 2),
+            moe_intermediate_size=get("intermediate_size"),
+            norm_topk_prob=False,
+            moe_style="mixtral",
+            moe_router_impl="sparsemixer",
+            router_jitter_eps=get("router_jitter_noise", 0.01),
+            router_aux_loss_coef=get("router_aux_loss_coef", 0.001),
+        )
+    elif model_type == "mixtral":
         moe = dict(
             num_experts=get("num_local_experts"),
             num_experts_per_tok=get("num_experts_per_tok", 2),
@@ -1463,6 +1525,24 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
                 else None
             ),
         )
+    # per-layer sliding/full pattern, resolved once (layer_types and the
+    # derived NoPE list must agree): explicit list, or Command R7B's
+    # pattern-field fallback
+    resolved_layer_types = None
+    if model_type in ("olmo3", "ministral", "exaone4", "cohere2"):
+        resolved_layer_types = list(get("layer_types") or []) or None
+        if (
+            resolved_layer_types is None
+            and model_type == "cohere2"
+            and get("sliding_window") is not None
+        ):
+            pattern = get("sliding_window_pattern", 4)
+            resolved_layer_types = [
+                "full_attention" if (i + 1) % pattern == 0
+                else "sliding_attention"
+                for i in range(get("num_hidden_layers"))
+            ]
+
     return LlamaConfig(**{**dict(
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
@@ -1520,11 +1600,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # OLMo-3 / Ministral carry an explicit per-layer sliding/full
         # pattern; only OLMo-3 pairs it with dual rope tables (sliding
         # layers unscaled) — Ministral rotates every layer with one table
-        layer_types=(
-            list(get("layer_types") or []) or None
-            if model_type in ("olmo3", "ministral", "exaone4", "cohere2")
-            else None
-        ),
+        layer_types=resolved_layer_types,
         dual_local_rope=model_type == "olmo3",
         # Mistral sets sliding_window unconditionally; the Qwen families gate
         # it behind use_sliding_window (default False)
@@ -1541,8 +1617,9 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         no_rope_layers=(
             list(get("no_rope_layers") or []) or None
             if model_type == "smollm3"
-            else _derived_no_rope(get("layer_types") or [])
+            else _derived_no_rope(resolved_layer_types)
             if model_type in ("exaone4", "cohere2")
+            and resolved_layer_types is not None
             and get("sliding_window") is not None
             else None
         ),
@@ -1571,7 +1648,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # q/k/v/o AND the MLP projections. Cohere: weight-only mean-centered
         # norm, parallel blocks, interleaved rope, multiplicative logit scale.
         norm_type=(
-            "layernorm" if model_type in ("starcoder2", "phi", "stablelm")
+            "layernorm" if model_type in ("starcoder2", "phi", "stablelm",
+                                          "phimoe")
             else "layernorm_nobias" if model_type in ("cohere", "cohere2")
             else "layernorm1p" if model_type == "nemotron"
             else "rmsnorm"
@@ -1592,7 +1670,10 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             if model_type == "stablelm"
             else 1.0
         ),
-        lm_head_bias=(model_type == "phi"),
+        lm_head_bias=(
+            get("lm_head_bias", False) if model_type == "phimoe"
+            else model_type == "phi"
+        ),
         rope_interleaved=model_type in (
             "cohere", "cohere2", "glm", "glm4", "ernie4_5", "helium"
         ),

@@ -149,6 +149,39 @@ def _ep_ragged_apply(
     return out.astype(out_dtype), dropped
 
 
+def sparsemixer_topk(logits, jitter_eps: float, top_k: int = 2):
+    """Phi-3.5-MoE SparseMixer routing, deterministic (inference) form.
+
+    HF's `sparsemixer` (modeling_phimoe.py) selects experts sequentially:
+    pick the argmax, weight it by a softmax over only the logits within a
+    2*jitter_eps relative band of the max (everything else masked to -inf),
+    then mask the picked expert out and repeat. Weights are NOT
+    renormalized across the k picks. The training-time extras (Gumbel
+    sampling + the Heun third-order gradient estimator of
+    arXiv 2409.12136) are stochastic-estimation machinery, not a different
+    function; fine-tuning here differentiates the deterministic form
+    through the softmax weights like every other routed family.
+    """
+    if top_k != 2:
+        raise ValueError("sparsemixer routing is defined for top_k=2")
+
+    def pick(scores):
+        m = scores.max(axis=-1, keepdims=True)
+        factor = jnp.maximum(jnp.abs(scores), m)
+        mask = ((m - scores) / factor) > (2 * jitter_eps)
+        gates = jax.nn.softmax(jnp.where(mask, -jnp.inf, scores), axis=-1)
+        idx = scores.argmax(axis=-1)
+        w = jnp.take_along_axis(gates, idx[:, None], axis=-1)[:, 0]
+        return idx, w
+
+    i1, w1 = pick(logits)
+    masked = jnp.where(
+        jax.nn.one_hot(i1, logits.shape[-1], dtype=bool), -jnp.inf, logits
+    )
+    i2, w2 = pick(masked)
+    return jnp.stack([w1, w2], axis=-1), jnp.stack([i1, i2], axis=-1)
+
+
 def _sorted_dispatch(topk_idx, topk_weights, num_experts):
     """Shared dispatch prelude: (flat_weight, flat_token, order, gs) for the
     expert-sorted row layout both the ragged and bucketed paths consume."""
@@ -327,10 +360,17 @@ class MoEMLP(nn.Module):
             ),
             name="gate",
         )
-        probs = jax.nn.softmax(router(x).astype(jnp.float32), axis=-1)  # [T, E]
-        topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # [T, K]
-        if cfg.norm_topk_prob:
-            topk_probs = topk_probs / topk_probs.sum(axis=-1, keepdims=True)
+        logits = router(x).astype(jnp.float32)  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)  # full softmax (router stats)
+        if getattr(cfg, "moe_router_impl", "softmax") == "sparsemixer":
+            # Phi-3.5-MoE's deterministic (eval-mode) SparseMixer selection
+            topk_probs, topk_idx = sparsemixer_topk(
+                logits, getattr(cfg, "router_jitter_eps", 0.01), top_k
+            )
+        else:
+            topk_probs, topk_idx = jax.lax.top_k(probs, top_k)  # [T, K]
+            if cfg.norm_topk_prob:
+                topk_probs = topk_probs / topk_probs.sum(axis=-1, keepdims=True)
         topk_probs = topk_probs.astype(compute_dtype)
 
         # ---- stacked expert weights

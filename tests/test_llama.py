@@ -1305,3 +1305,112 @@ def test_cohere2_export_round_trip(tmp_path):
         hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
     ours = model.apply(params, ids).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_logits_parity_with_hf_phimoe():
+    """Phi-3.5-MoE routes to the Llama module + MoEMLP: mixtral expert
+    naming, biased LayerNorms, attention/lm_head biases, and SparseMixer
+    routing — sequential argmax picks weighted by a band-masked softmax,
+    weights NOT renormalized across the two picks (models/moe.py:
+    sparsemixer_topk matches HF's eval-mode sparsemixer exactly)."""
+    torch = pytest.importorskip("torch")
+    from transformers import PhimoeConfig, PhimoeForCausalLM
+
+    hf_config = PhimoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2, rms_norm_eps=1e-5,
+        attention_bias=True, lm_head_bias=True,
+        router_jitter_noise=0.01, input_jitter_noise=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = PhimoeForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "model.layers.0.block_sparse_moe.experts.0.w1.weight" in sd
+    assert "model.layers.0.input_layernorm.bias" in sd
+    assert "lm_head.bias" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_type == "layernorm" and cfg.moe_style == "mixtral"
+    assert cfg.moe_router_impl == "sparsemixer" and not cfg.norm_topk_prob
+    assert cfg.attention_bias and cfg.lm_head_bias
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(20).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_cohere2_imports_r7b_style_raw_config():
+    """The published Command R7B config.json predates layer_types (it
+    carries sliding_window_pattern=4 only) and arrives as a raw dict —
+    the pattern must resolve to the derived sliding/full list + NoPE."""
+    raw = dict(
+        model_type="cohere2", vocab_size=128, hidden_size=64,
+        intermediate_size=112, num_hidden_layers=8, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=64,
+        logit_scale=0.125, layer_norm_eps=1e-5, sliding_window=8,
+        sliding_window_pattern=4, rope_theta=50000.0,
+    )
+    cfg = config_from_hf(raw, compute_dtype="float32")
+    assert cfg.layer_types == (
+        ["sliding_attention"] * 3 + ["full_attention"]
+    ) * 2
+    assert cfg.no_rope_layers == [1, 1, 1, 0] * 2
+    assert cfg.sliding_window == 8 and not cfg.scan_layers
+
+
+@pytest.mark.slow
+def test_phimoe_export_round_trip(tmp_path):
+    """A SparseMixer MoE config must export as Phimoe and reload in
+    transformers with matching logits (routing weights un-renormalized)."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **{**TINY, "num_hidden_layers": 2},
+        norm_type="layernorm", attention_bias=True, lm_head_bias=True,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=96,
+        norm_topk_prob=False, moe_style="mixtral",
+        moe_router_impl="sparsemixer",
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(21).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(6), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "PhimoeForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_sparsemixer_and_cohere_window_exports_guarded():
+    """Silent-fallthrough refusals: sparsemixer outside the Phimoe shape,
+    and a cohere graph with a uniform window but no layer pattern."""
+    import pytest as _pytest
+
+    from llm_training_tpu.models.llama.hf_conversion import config_to_hf
+
+    with _pytest.raises(ValueError, match="sparsemixer"):
+        config_to_hf(LlamaConfig(
+            **TINY, num_experts=4, num_experts_per_tok=2,
+            moe_intermediate_size=32, moe_router_impl="sparsemixer",
+        ))  # qwen-style naming + rmsnorm: would reload with softmax routing
+    with _pytest.raises(ValueError, match="cohere"):
+        config_to_hf(LlamaConfig(
+            **TINY, norm_scheme="parallel", norm_type="layernorm_nobias",
+            rope_interleaved=True, sliding_window=8,
+        ))  # uniform window: HF Cohere would silently run full attention
