@@ -350,6 +350,7 @@ _ARCH_TO_FAMILY = {
     "hunyuan_v1_dense": "llm_training_tpu.models.Llama",  # post-rope qk-norm
     "hunyuan_v1_moe": "llm_training_tpu.models.HunYuanMoe",  # + softmax top-k MoE
     "gpt2": "llm_training_tpu.models.Llama",  # learned positions, fused qkv
+    "gpt_neox": "llm_training_tpu.models.Llama",  # Pythia: two-norm parallel, interleaved fused qkv
     "smollm3": "llm_training_tpu.models.Llama",  # per-layer NoPE
     "exaone4": "llm_training_tpu.models.Llama",  # post-norm + head qk-norm + hybrid NoPE
     "apertus": "llm_training_tpu.models.Llama",  # non-gated xIELU MLP + head qk-norm

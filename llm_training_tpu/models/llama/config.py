@@ -63,11 +63,21 @@ class LlamaConfig(BaseModelConfig):
     # projections (and qk-norm), before the head reshape
     clip_qkv: float | None = None
     # 'pre' = Llama pre-norm blocks; 'post' = OLMo-2 reordering
-    # (x + norm(block(x)) with NO input norms); 'parallel' = Cohere's single
-    # input norm feeding attention AND mlp, summed into one residual add;
-    # 'sandwich' = GLM-4's four norms (input norm AND output norm around
-    # both the attention and the mlp)
-    norm_scheme: Literal["pre", "post", "parallel", "sandwich"] = "pre"
+    # (x + norm(block(x)) with NO input norms); 'parallel' = Cohere/Phi's
+    # single input norm feeding attention AND mlp, summed into one residual
+    # add; 'parallel2' = GPT-NeoX's TWO norms (input_layernorm ->
+    # attention, post_attention_layernorm -> mlp) over the SAME block
+    # input, one residual join; 'sandwich' = GLM-4's four norms (input
+    # norm AND output norm around both the attention and the mlp)
+    norm_scheme: Literal["pre", "post", "parallel", "parallel2", "sandwich"] = "pre"
+    # exact (erf) vs tanh-approximate gelu for mlp_type='gelu'
+    # (Starcoder2/Phi use tanh; GPT-NeoX's 'gelu' is exact)
+    gelu_approximate: bool = True
+    # GPT-NeoX checkpoint naming (gpt_neox. prefix, fused interleaved
+    # query_key_value, embed_in/embed_out) — needed explicitly for the
+    # use_parallel_residual=False variant, whose pre-norm graph would
+    # otherwise be indistinguishable from Starcoder2 naming
+    neox_naming: bool = False
     # Starcoder2: biased LayerNorm instead of RMSNorm (rms_norm_eps doubles
     # as its epsilon), and a non-gated c_fc -> gelu_tanh -> c_proj MLP.
     # 'layernorm_nobias' is Cohere's mean-centered weight-only norm;

@@ -127,6 +127,104 @@ _PHI_LAYER_RENAMES = [
 ]
 
 
+def _uses_neox_naming(config: LlamaConfig) -> bool:
+    """GPT-NeoX / Pythia: the two-norm parallel graph, whose HF checkpoints
+    live under a 'gpt_neox.' prefix with embed_in/embed_out, a per-head
+    INTERLEAVED fused query_key_value, attention.dense, and
+    dense_h_to_4h/dense_4h_to_h MLP names."""
+    return getattr(config, "neox_naming", False) or (
+        config.norm_scheme == "parallel2"
+        and config.norm_type == "layernorm"
+        and config.mlp_type == "gelu"
+    )
+
+
+_NEOX_LAYER_RENAMES = [
+    (".self_attn.o_proj.", ".attention.dense."),
+    (".mlp.c_fc.", ".mlp.dense_h_to_4h."),
+    (".mlp.c_proj.", ".mlp.dense_4h_to_h."),
+]
+
+# buffers old Pythia checkpoints persist that carry no weights
+_NEOX_DROPPED_KEY_PARTS = (
+    ".attention.bias", ".attention.masked_bias", ".rotary_emb.inv_freq",
+)
+
+
+def _neox_state_dict(sd: Mapping, config: LlamaConfig) -> dict:
+    """'gpt_neox.'-prefixed NeoX keys -> our canonical naming, with the
+    fused query_key_value split into q/k/v. The fusion is PER-HEAD
+    interleaved ([heads, (q|k|v), head_dim] rows), unlike Phi-3's
+    block-contiguous fusion, so the split must reshape through the head
+    axis."""
+    heads = config.num_attention_heads
+    hd = config.resolved_head_dim
+    out: dict = {}
+    for key, value in sd.items():
+        key = key.removeprefix("gpt_neox.")
+        if any(part in key for part in _NEOX_DROPPED_KEY_PARTS):
+            continue
+        if ".attention.query_key_value." in key:
+            v = _to_numpy(value)
+            prefix, kind = key.rsplit(".", 1)
+            base = prefix.replace(
+                ".attention.query_key_value", ".self_attn.{}_proj"
+            )
+            fused = v.reshape((heads, 3, hd) + v.shape[1:])
+            for i, name in enumerate(("q", "k", "v")):
+                part = fused[:, i].reshape((heads * hd,) + v.shape[1:])
+                out[f"{base.format(name)}.{kind}"] = part
+            continue
+        if key == "embed_in.weight":
+            key = "embed_tokens.weight"
+        elif key == "embed_out.weight":
+            key = "lm_head.weight"
+        elif key.startswith("final_layer_norm."):
+            key = "norm." + key.removeprefix("final_layer_norm.")
+        else:
+            for ours, hf in _NEOX_LAYER_RENAMES:
+                key = key.replace(hf, ours)
+        out[key] = value
+    return out
+
+
+def _canonical_to_neox_state_dict(sd: dict, config: LlamaConfig) -> dict:
+    """Inverse of _neox_state_dict for export ('model.'-prefixed input)."""
+    heads = config.num_attention_heads
+    hd = config.resolved_head_dim
+    out: dict = {}
+    fused: dict = {}
+    for key, value in sd.items():
+        key = key.removeprefix("model.")
+        m = None
+        for name in ("q", "k", "v"):
+            tag = f".self_attn.{name}_proj."
+            if tag in key:
+                m = (key.replace(tag, ".attention.query_key_value."), name)
+        if m is not None:
+            fused.setdefault(m[0], {})[m[1]] = np.asarray(value)
+            continue
+        if key == "embed_tokens.weight":
+            key = "embed_in.weight"
+        elif key == "lm_head.weight":
+            key = "embed_out.weight"
+        elif key.startswith("norm."):
+            key = "final_layer_norm." + key.removeprefix("norm.")
+        else:
+            for ours, hf in _NEOX_LAYER_RENAMES:
+                key = key.replace(ours, hf)
+        out["gpt_neox." + key if not key.startswith("embed_out") else key] = value
+    for key, parts in fused.items():
+        stacked = np.stack(
+            [parts[n].reshape((heads, hd) + parts[n].shape[1:]) for n in ("q", "k", "v")],
+            axis=1,
+        )
+        out["gpt_neox." + key] = stacked.reshape(
+            (heads * 3 * hd,) + parts["q"].shape[1:]
+        )
+    return out
+
+
 def _phi_key_to_canonical(key: str) -> str:
     """stripped-of-'model.' HF key -> our canonical naming."""
     if key.startswith("final_layernorm."):
@@ -183,6 +281,8 @@ def _layer_params(config: LlamaConfig) -> list:
     norms = {
         "post": _POST_NORM_PARAMS,
         "parallel": _PARALLEL_NORM_PARAMS,
+        # NeoX's two parallel norms carry the same names as the pre scheme
+        "parallel2": _PRE_NORM_PARAMS,
         "sandwich": _SANDWICH_NORM_PARAMS,
         "pre": _PRE_NORM_PARAMS,
     }[config.norm_scheme]
@@ -455,7 +555,10 @@ def params_from_hf(
     if config.position_embedding_type == "learned":
         return _gpt2_params_from_hf(state_dict, config, leaf_fn)
     params: dict = {}
-    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    if _uses_neox_naming(config):
+        sd = _neox_state_dict(state_dict, config)
+    else:
+        sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
     if _uses_phi_naming(config):
         sd = {_phi_key_to_canonical(k): v for k, v in sd.items()}
 
@@ -582,6 +685,8 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
             )
     if _uses_phi_naming(config):
         out = {_canonical_key_to_phi(k): v for k, v in out.items()}
+    if _uses_neox_naming(config):
+        out = _canonical_to_neox_state_dict(out, config)
     return out
 
 
@@ -645,7 +750,7 @@ def _check_exportable(config: LlamaConfig) -> None:
         and config.num_experts is not None
         and config.moe_style == "mixtral"
         and config.moe_router_impl == "sparsemixer"
-        and config.sliding_window is None and config.layer_types is None
+        and config.layer_types is None
         and not config.rope_interleaved
     )
     if config.moe_router_impl == "sparsemixer" and not is_phimoe:
@@ -740,6 +845,35 @@ def _check_exportable(config: LlamaConfig) -> None:
             "(layernorm_nobias + swiglu) or Phi (layernorm + gelu); this "
             "combination cannot be exported"
         )
+    is_neox = _uses_neox_naming(config)
+    if is_neox and not (
+        config.norm_type == "layernorm" and config.mlp_type == "gelu"
+        and config.norm_scheme in ("parallel2", "pre")
+        and config.attention_bias and config.attention_out_bias
+        and config.mlp_bias
+        and config.num_experts is None and config.sliding_window is None
+        and not config.qk_norm and not config.rope_interleaved
+        # the fused query_key_value layout has no GQA and no detached
+        # head_dim
+        and config.num_key_value_heads == config.num_attention_heads
+        and config.resolved_head_dim * config.num_attention_heads
+        == config.hidden_size
+    ):
+        raise ValueError(
+            "GPT-NeoX checkpoints are biased LayerNorm + biased non-gated "
+            "gelu MLP, dense, no GQA, default head_dim (two-norm parallel "
+            "or sequential residual); this combination cannot be exported"
+        )
+    if config.norm_scheme == "parallel2" and not is_neox:
+        raise ValueError(
+            "norm_scheme='parallel2' only exists in HF as GPT-NeoX "
+            "(layernorm + gelu); this combination cannot be exported"
+        )
+    if not config.gelu_approximate and not is_neox:
+        raise ValueError(
+            "exact (erf) gelu only exists in HF as GPT-NeoX's hidden_act="
+            "'gelu'; Starcoder2/Phi exports assume the tanh approximation"
+        )
     is_glm = (
         config.fused_gate_up
         and config.rope_interleaved
@@ -782,11 +916,12 @@ def _check_exportable(config: LlamaConfig) -> None:
             "dropped by any other export"
         )
     if config.partial_rotary_factor != 1.0 and not (
-        is_phi or is_glm or is_nemotron or is_stablelm
+        is_phi or is_glm or is_nemotron or is_stablelm or is_neox
     ):
         raise ValueError(
-            "partial_rotary_factor only exists in HF on Phi, GLM/GLM-4, and "
-            "Nemotron; it would be silently dropped otherwise"
+            "partial_rotary_factor only exists in HF on Phi, GLM/GLM-4, "
+            "Nemotron, StableLM, and GPT-NeoX (rotary_pct); it would be "
+            "silently dropped otherwise"
         )
     if config.lm_head_bias and not (is_phi or is_phimoe):
         raise ValueError(
@@ -1189,7 +1324,36 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "sliding_window": config.sliding_window,
              "hidden_act": "gelu_pytorch_tanh"}
             if config.norm_type == "layernorm" and config.mlp_type == "gelu"
-            and config.norm_scheme == "pre"
+            and config.norm_scheme == "pre" and not config.neox_naming
+            else {}
+        ),
+        # the two-norm parallel graph only exists as GPT-NeoX in HF
+        **(
+            {"model_type": "gpt_neox",
+             "architectures": ["GPTNeoXForCausalLM"],
+             "rotary_pct": config.partial_rotary_factor,
+             "rotary_emb_base": config.rope_theta,
+             "layer_norm_eps": config.rms_norm_eps,
+             "use_parallel_residual": True,
+             "attention_bias": config.attention_bias,
+             "hidden_act": (
+                 "gelu_new" if config.gelu_approximate else "gelu"
+             )}
+            if config.norm_scheme == "parallel2"
+            else {}
+        ),
+        **(
+            {"model_type": "gpt_neox",
+             "architectures": ["GPTNeoXForCausalLM"],
+             "rotary_pct": config.partial_rotary_factor,
+             "rotary_emb_base": config.rope_theta,
+             "layer_norm_eps": config.rms_norm_eps,
+             "use_parallel_residual": False,
+             "attention_bias": config.attention_bias,
+             "hidden_act": (
+                 "gelu_new" if config.gelu_approximate else "gelu"
+             )}
+            if config.neox_naming and config.norm_scheme == "pre"
             else {}
         ),
         # a non-gated xIELU MLP only exists as Apertus in HF
@@ -1210,6 +1374,7 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "use_parallel_residual": False,
              "hidden_act": "silu"}
             if config.norm_type == "layernorm" and config.mlp_type == "swiglu"
+            and config.num_experts is None
             else {}
         ),
         # per-layer NoPE only exists as SmolLM3 in HF
@@ -1307,6 +1472,7 @@ def _moe_to_hf(config: LlamaConfig) -> dict[str, Any]:
                 "input_jitter_noise": 0.0,
                 "lm_head_bias": config.lm_head_bias,
                 "attention_bias": config.attention_bias,
+                "sliding_window": config.sliding_window,
                 **common,
             }
         return {
@@ -1556,7 +1722,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         rms_norm_eps=(
             get("norm_epsilon", 1e-5) if model_type == "starcoder2"
             else get("layer_norm_eps", 1e-5)
-            if model_type in ("cohere", "cohere2", "phi", "stablelm")
+            if model_type in ("cohere", "cohere2", "phi", "stablelm",
+                              "gpt_neox")
             else get("norm_eps", 1e-5) if model_type == "nemotron"
             else get("rms_norm_eps", 1e-6)
         ),
@@ -1564,12 +1731,21 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         bos_token_id=get("bos_token_id", 1),
         eos_token_id=get("eos_token_id", 2),
         tie_word_embeddings=get("tie_word_embeddings", False),
-        rope_theta=get("rope_theta", 10000.0),
+        # raw Pythia config.json stores the base as rotary_emb_base
+        # (GPTNeoXConfig objects alias it to rope_theta; raw dicts do not)
+        rope_theta=(
+            get("rope_theta") or get("rotary_emb_base", 10000.0)
+            if model_type == "gpt_neox"
+            else get("rope_theta", 10000.0)
+        ),
         # Qwen2 / Qwen2-MoE hardcode q/k/v biases with no o_proj bias (no
         # config field in their HF configs); explicit attention_bias wins.
         # Present-but-None (our own qwen2-style exports) counts as absent.
         attention_bias=(
             get("use_bias", True) if model_type == "starcoder2"
+            # published Pythia config.json files predate the field; NeoX
+            # projections are always biased
+            else get("attention_bias", True) if model_type == "gpt_neox"
             else True if model_type == "phi"
             else get("use_bias", False) if model_type == "ernie4_5"
             else get("use_qkv_bias", False) if model_type == "stablelm"
@@ -1579,6 +1755,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         ),
         attention_out_bias=(
             get("use_bias", True) if model_type == "starcoder2"
+            else get("attention_bias", True) if model_type == "gpt_neox"
             else True if model_type == "phi"
             else get("use_bias", False) if model_type == "ernie4_5"
             # Seed-OSS carries an explicit separate o_proj flag
@@ -1593,7 +1770,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         attention_dropout=get("attention_dropout", 0.0),
         mlp_bias=(
             get("use_bias", True) if model_type == "starcoder2"
-            else True if model_type == "phi"
+            else True if model_type in ("phi", "gpt_neox")
             else get("mlp_bias", False)
         ),
         rope_scaling=get("rope_scaling"),
@@ -1640,6 +1817,10 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             "post" if model_type in ("olmo2", "olmo3", "flex_olmo",
                                      "exaone4")
             else "parallel" if model_type in ("cohere", "cohere2", "phi")
+            else (
+                "parallel2" if get("use_parallel_residual", True) else "pre"
+            )
+            if model_type == "gpt_neox"
             else "sandwich" if model_type == "glm4"
             else "pre"
         ),
@@ -1649,13 +1830,20 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         # norm, parallel blocks, interleaved rope, multiplicative logit scale.
         norm_type=(
             "layernorm" if model_type in ("starcoder2", "phi", "stablelm",
-                                          "phimoe")
+                                          "phimoe", "gpt_neox")
             else "layernorm_nobias" if model_type in ("cohere", "cohere2")
             else "layernorm1p" if model_type == "nemotron"
             else "rmsnorm"
         ),
+        gelu_approximate=(
+            get("hidden_act", "gelu")
+            in ("gelu_new", "gelu_fast", "gelu_pytorch_tanh")
+            if model_type == "gpt_neox"
+            else True
+        ),
+        neox_naming=(model_type == "gpt_neox"),
         mlp_type=(
-            "gelu" if model_type in ("starcoder2", "phi")
+            "gelu" if model_type in ("starcoder2", "phi", "gpt_neox")
             # Arcee: the Nemotron-style non-gated up -> relu^2 -> down MLP
             # under standard RMSNorm pre-norm blocks
             else "relu2" if model_type in ("nemotron", "arcee")
@@ -1664,7 +1852,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
             else "swiglu"
         ),
         partial_rotary_factor=(
-            get("partial_rotary_factor", 0.5)
+            get("rotary_pct", 0.25) if model_type == "gpt_neox"
+            else get("partial_rotary_factor", 0.5)
             if model_type in ("phi", "glm", "glm4", "nemotron")
             else get("partial_rotary_factor", 0.25)
             if model_type == "stablelm"

@@ -282,7 +282,7 @@ class LlamaMLP(nn.Module):
         if getattr(cfg, "mlp_type", "swiglu") == "gelu":
             up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "c_fc", cfg.mlp_bias)(hidden)
             return _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "c_proj", cfg.mlp_bias)(
-                nn.gelu(up, approximate=True)
+                nn.gelu(up, approximate=getattr(cfg, "gelu_approximate", True))
             )
         if getattr(cfg, "mlp_type", "swiglu") == "relu2":
             up = _dense(cfg, cfg.intermediate_size, ("embed", "mlp"), "up_proj", cfg.mlp_bias)(hidden)
@@ -371,6 +371,15 @@ class LlamaDecoderLayer(nn.Module):
             normed = norm("input_layernorm")(hidden)
             attn = attention("self_attn")(normed, segment_ids, cos, sin)
             mlp_out, aux = mlp(normed)
+            hidden = hidden + join(attn) + join(mlp_out)
+            return hidden, aux
+        if cfg.norm_scheme == "parallel2":
+            # GPT-NeoX: TWO norms over the SAME block input feed attention
+            # and mlp in parallel; one residual join
+            attn = attention("self_attn")(
+                norm("input_layernorm")(hidden), segment_ids, cos, sin
+            )
+            mlp_out, aux = mlp(norm("post_attention_layernorm")(hidden))
             hidden = hidden + join(attn) + join(mlp_out)
             return hidden, aux
         if cfg.norm_scheme == "sandwich":

@@ -1414,3 +1414,71 @@ def test_sparsemixer_and_cohere_window_exports_guarded():
             **TINY, norm_scheme="parallel", norm_type="layernorm_nobias",
             rope_interleaved=True, sliding_window=8,
         ))  # uniform window: HF Cohere would silently run full attention
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("parallel", [True, False])
+def test_logits_parity_with_hf_gpt_neox(parallel):
+    """GPT-NeoX (Pythia) routes to the Llama module: two biased LayerNorms
+    feeding attention and mlp in parallel over the same block input
+    (norm_scheme='parallel2'; use_parallel_residual=False is plain
+    pre-norm), a per-head INTERLEAVED fused query_key_value split at
+    conversion, biased gelu MLP with EXACT (erf) gelu, partial rotary
+    0.25, untied embed_out."""
+    torch = pytest.importorskip("torch")
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_config = GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=parallel, layer_norm_eps=1e-5,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = GPTNeoXForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert "gpt_neox.layers.0.attention.query_key_value.weight" in sd
+    assert "embed_out.weight" in sd
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_scheme == ("parallel2" if parallel else "pre")
+    assert cfg.norm_type == "layernorm" and cfg.mlp_type == "gelu"
+    assert cfg.mlp_bias and cfg.attention_bias and not cfg.gelu_approximate
+    assert cfg.partial_rotary_factor == 0.25
+    params = params_from_hf(sd, cfg)
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(22).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_gpt_neox_export_round_trip(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **{**TINY, "num_hidden_layers": 2, "num_key_value_heads": TINY["num_attention_heads"]},
+        norm_scheme="parallel2", norm_type="layernorm", mlp_type="gelu",
+        gelu_approximate=False, attention_bias=True, mlp_bias=True,
+        lm_head_bias=False, partial_rotary_factor=0.25,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(23).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(7), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "GPTNeoXForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
