@@ -335,6 +335,7 @@ _ARCH_TO_FAMILY = {
     "seed_oss": "llm_training_tpu.models.Llama",  # qkv bias + separate o-bias flag
     "qwen2": "llm_training_tpu.models.Llama",  # + attention_bias (in config.json)
     "qwen3": "llm_training_tpu.models.Llama",  # + per-head qk-norm
+    "olmo": "llm_training_tpu.models.Llama",  # OLMo-1: non-parametric LayerNorm, clip_qkv
     "olmo2": "llm_training_tpu.models.Llama",  # + post-norm blocks, full qk-norm
     "olmo3": "llm_training_tpu.models.Llama",  # + per-layer sliding, dual rope
     "granite": "llm_training_tpu.models.Llama",  # + 4 scalar multipliers

@@ -85,8 +85,11 @@ class LlamaConfig(BaseModelConfig):
     # 'relu2' is Nemotron's non-gated up_proj -> relu^2 -> down_proj MLP.
     # 'xielu' is Apertus' non-gated up -> xIELU -> down MLP with two
     # learnable activation scalars per layer.
+    # 'layernorm_nonparam' is OLMo-1's fully non-parametric F.layer_norm
+    # (no weight, no bias — zero norm keys in the checkpoint)
     norm_type: Literal[
-        "rmsnorm", "layernorm", "layernorm_nobias", "layernorm1p"
+        "rmsnorm", "layernorm", "layernorm_nobias", "layernorm1p",
+        "layernorm_nonparam",
     ] = "rmsnorm"
     mlp_type: Literal["swiglu", "gelu", "relu2", "xielu"] = "swiglu"
     # Cohere/GLM/Ernie: interleaved (GPT-J) rope pairing; Cohere also has a

@@ -286,6 +286,8 @@ def _layer_params(config: LlamaConfig) -> list:
         "sandwich": _SANDWICH_NORM_PARAMS,
         "pre": _PRE_NORM_PARAMS,
     }[config.norm_scheme]
+    if config.norm_type == "layernorm_nonparam":
+        norms = []  # OLMo-1: the norms own no parameters
     if config.mlp_type == "xielu":
         # Apertus names its pre-norms attention_/feedforward_layernorm
         norms = [
@@ -566,7 +568,8 @@ def params_from_hf(
         _set_path(params, path, leaf_fn(path, value) if leaf_fn else value)
 
     put(("embed_tokens", "embedding"), _to_numpy(sd["embed_tokens.weight"]))
-    put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
+    if config.norm_type != "layernorm_nonparam":
+        put(("norm", "weight"), _to_numpy(sd["norm.weight"]))
     if config.norm_type in ("layernorm", "layernorm1p"):
         put(("norm", "bias"), _to_numpy(sd["norm.bias"]))
     if not config.tie_word_embeddings:
@@ -624,7 +627,8 @@ def params_to_hf(params: Mapping, config: LlamaConfig) -> dict[str, np.ndarray]:
     p = nn.meta.unbox(p)  # strip Partitioned boxes if the tree came from init()
     out: dict[str, np.ndarray] = {}
     out["model.embed_tokens.weight"] = np.asarray(_get_path(p, ("embed_tokens", "embedding")))
-    out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
+    if config.norm_type != "layernorm_nonparam":
+        out["model.norm.weight"] = np.asarray(_get_path(p, ("norm", "weight")))
     if config.norm_type in ("layernorm", "layernorm1p"):
         out["model.norm.bias"] = np.asarray(_get_path(p, ("norm", "bias")))
     if not config.tie_word_embeddings:
@@ -1083,11 +1087,28 @@ def _check_exportable(config: LlamaConfig) -> None:
             "combination cannot be exported"
         )
     if config.clip_qkv is not None and not (
-        config.num_experts and config.qk_norm and config.qk_norm_scope == "full"
+        (config.num_experts and config.qk_norm and config.qk_norm_scope == "full")
+        or config.norm_type == "layernorm_nonparam"
     ):
         raise ValueError(
-            "clip_qkv only exists in HF on OLMoE (full qk-norm + MoE); it "
-            "would be silently dropped by any other export"
+            "clip_qkv only exists in HF on OLMoE (full qk-norm + MoE) and "
+            "OLMo-1 (non-parametric LayerNorm); it would be silently "
+            "dropped by any other export"
+        )
+    if config.norm_type == "layernorm_nonparam" and not (
+        config.norm_scheme == "pre" and config.mlp_type == "swiglu"
+        and not config.qk_norm and not config.rope_interleaved
+        and config.num_experts is None and config.layer_types is None
+        and config.sliding_window is None
+        and not config.attention_bias and not config.attention_out_bias
+        and not config.mlp_bias
+        # OlmoLayerNorm hardcodes F.layer_norm's 1e-5; any other eps
+        # would silently change the normalization on reload
+        and config.rms_norm_eps == 1e-5
+    ):
+        raise ValueError(
+            "non-parametric LayerNorm only exists in HF as OLMo-1 (a plain "
+            "bias-free llama graph); this combination cannot be exported"
         )
 
 
@@ -1325,6 +1346,13 @@ def config_to_hf(config: LlamaConfig, torch_dtype: str = "bfloat16") -> dict[str
              "hidden_act": "gelu_pytorch_tanh"}
             if config.norm_type == "layernorm" and config.mlp_type == "gelu"
             and config.norm_scheme == "pre" and not config.neox_naming
+            else {}
+        ),
+        # the fully non-parametric LayerNorm graph only exists as OLMo-1
+        **(
+            {"model_type": "olmo", "architectures": ["OlmoForCausalLM"],
+             "clip_qkv": config.clip_qkv}
+            if config.norm_type == "layernorm_nonparam"
             else {}
         ),
         # the two-norm parallel graph only exists as GPT-NeoX in HF
@@ -1720,7 +1748,8 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         max_position_embeddings=get("max_position_embeddings"),
         initializer_range=get("initializer_range", 0.02),
         rms_norm_eps=(
-            get("norm_epsilon", 1e-5) if model_type == "starcoder2"
+            1e-5 if model_type == "olmo"  # OlmoLayerNorm's F.layer_norm default
+            else get("norm_epsilon", 1e-5) if model_type == "starcoder2"
             else get("layer_norm_eps", 1e-5)
             if model_type in ("cohere", "cohere2", "phi", "stablelm",
                               "gpt_neox")
@@ -1831,6 +1860,7 @@ def config_from_hf(hf_config: Any, **overrides: Any) -> LlamaConfig:
         norm_type=(
             "layernorm" if model_type in ("starcoder2", "phi", "stablelm",
                                           "phimoe", "gpt_neox")
+            else "layernorm_nonparam" if model_type == "olmo"
             else "layernorm_nobias" if model_type in ("cohere", "cohere2")
             else "layernorm1p" if model_type == "nemotron"
             else "rmsnorm"

@@ -64,10 +64,18 @@ class LayerNorm(nn.Module):
     param_dtype: jnp.dtype
     use_bias: bool = True
     zero_centered: bool = False
+    # OLMo-1: F.layer_norm with NO weight and NO bias at all
+    parametric: bool = True
     weight_shape: tuple[int, ...] | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        normed = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        if not self.parametric:
+            return normed.astype(x.dtype)
         shape = self.weight_shape or (x.shape[-1],)
         axes = (None,) * (len(shape) - 1) + ("norm",)
         weight = self.param(
@@ -82,10 +90,6 @@ class LayerNorm(nn.Module):
         )
         if self.zero_centered:
             weight = weight + jnp.ones_like(weight)
-        x32 = x.astype(jnp.float32)
-        mean = x32.mean(axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
-        normed = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
         out = normed * weight.astype(jnp.float32)
         if self.use_bias:
             bias = self.param(
@@ -103,6 +107,8 @@ _NORM_CLASSES = {
     "layernorm": LayerNorm,
     "layernorm_nobias": _partial(LayerNorm, use_bias=False),
     "layernorm1p": _partial(LayerNorm, zero_centered=True),
+    # OLMo-1: fully non-parametric LayerNorm (no keys in the checkpoint)
+    "layernorm_nonparam": _partial(LayerNorm, use_bias=False, parametric=False),
 }
 
 

@@ -1482,3 +1482,61 @@ def test_gpt_neox_export_round_trip(tmp_path):
         hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
     ours = model.apply(params, ids).logits
     np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_logits_parity_with_hf_olmo1():
+    """OLMo-1 routes to the Llama module: a plain bias-free llama graph
+    whose norms are FULLY non-parametric (F.layer_norm with no weight or
+    bias — zero norm keys in the checkpoint) plus the clip_qkv clamp."""
+    torch = pytest.importorskip("torch")
+    from transformers import OlmoConfig, OlmoForCausalLM
+
+    hf_config = OlmoConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, clip_qkv=1.5,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf_model = OlmoForCausalLM(hf_config).eval()
+    sd = hf_model.state_dict()
+    assert not any("norm" in k for k in sd)  # truly parameter-free norms
+
+    cfg = config_from_hf(hf_config, compute_dtype="float32")
+    assert cfg.norm_type == "layernorm_nonparam" and cfg.clip_qkv == 1.5
+    params = params_from_hf(sd, cfg)
+    assert "input_layernorm" not in str(jax.tree_util.tree_structure(params))
+    model = Llama(cfg)
+
+    ids = np.random.default_rng(24).integers(0, 128, (2, 24))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(params, jnp.asarray(ids)).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.slow
+def test_olmo1_export_round_trip(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    from llm_training_tpu.models.hf_io import save_hf_checkpoint
+
+    cfg = LlamaConfig(
+        **{**TINY, "num_hidden_layers": 2, "rms_norm_eps": 1e-5},
+        norm_type="layernorm_nonparam", clip_qkv=2.0,
+    )
+    model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(25).integers(0, 128, (2, 16)))
+    params = model.init(jax.random.key(8), ids)
+    out_dir = save_hf_checkpoint(params, cfg, tmp_path / "export", dtype="float32")
+
+    hf_model = AutoModelForCausalLM.from_pretrained(
+        out_dir, attn_implementation="eager"
+    ).eval()
+    assert type(hf_model).__name__ == "OlmoForCausalLM"
+    with torch.no_grad():
+        hf_logits = hf_model(torch.tensor(np.asarray(ids))).logits.numpy()
+    ours = model.apply(params, ids).logits
+    np.testing.assert_allclose(np.asarray(ours), hf_logits, rtol=2e-4, atol=2e-4)
