@@ -313,6 +313,10 @@ def main() -> None:
     mfu = tokens_per_sec_chip * flops_per_token / _detect_peak()
 
     watchdog.cancel()
+    # goodput/telemetry extras so BENCH_* rounds can attribute regressions
+    # to compile/data/step shifts, not just the MFU headline
+    goodput = trainer.ledger.summary()
+    snapshot = trainer.telemetry.snapshot()
     print(json.dumps({
         "metric": "llama_clm_train_mfu",
         "value": round(mfu, 4),
@@ -324,6 +328,15 @@ def main() -> None:
         "model": bench_model,
         "n_devices": n_dev,
         "backend": jax.default_backend(),
+        "goodput_pct": round(goodput["goodput/goodput_pct"], 2),
+        "compile_time_s": round(snapshot.get("compile_time_s", 0.0), 2),
+        # global per OPTIMIZER step (the gauge is per-device per train_step
+        # invocation), same units as the estimator's perf/xla_flops_per_step
+        "xla_flops_per_step": (
+            snapshot["xla/flops_per_step"]
+            * trainer.config.accumulate_grad_batches * max(1, n_dev)
+            if "xla/flops_per_step" in snapshot else None
+        ),
     }))
 
 

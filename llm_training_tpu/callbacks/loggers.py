@@ -18,6 +18,27 @@ from pydantic import BaseModel, ConfigDict
 
 logger = logging.getLogger(__name__)
 
+# metric keys routed (additionally) to telemetry.jsonl — the observability
+# record a `report` invocation reads (docs/observability.md)
+TELEMETRY_PREFIXES = ("goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/")
+TELEMETRY_KEYS = ("compile_time_s",)
+
+
+def _is_telemetry_key(key: str) -> bool:
+    return key in TELEMETRY_KEYS or key.startswith(TELEMETRY_PREFIXES)
+
+
+def _primary_host() -> bool:
+    """Run-dir artifacts are written by process 0 only: in multi-host SPMD
+    every host runs the same program, and N hosts appending to one
+    metrics.jsonl (or racing W&B inits) corrupts the run record."""
+    try:
+        import jax
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
 
 class JsonlLoggerConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
@@ -29,22 +50,31 @@ class JsonlLoggerConfig(BaseModel):
 
 class JsonlLogger:
     """Appends one JSON object per logged step to
-    `<save_dir>/<project>/<name>/metrics.jsonl` and writes the resolved run
-    config next to it (the reference embeds it in W&B + checkpoints)."""
+    `<save_dir>/<project>/<name>/metrics.jsonl` (all metrics) and
+    `telemetry.jsonl` (the goodput/device/registry subset `report` reads),
+    and writes the resolved run config next to them (the reference embeds it
+    in W&B + checkpoints). All writes happen on process 0 only."""
 
     def __init__(self, config: JsonlLoggerConfig | None = None):
         self.config = config or JsonlLoggerConfig()
         name = self.config.name or time.strftime("%Y%m%d-%H%M%S")
         self.run_dir = Path(self.config.save_dir) / self.config.project / name
-        self._file = None
+        self._files: dict[str, object] = {}
 
-    def _ensure_open(self):
-        if self._file is None:
+    def _ensure_open(self, filename: str):
+        if filename not in self._files:
             self.run_dir.mkdir(parents=True, exist_ok=True)
-            self._file = open(self.run_dir / "metrics.jsonl", "a")
-        return self._file
+            self._files[filename] = open(self.run_dir / filename, "a")
+        return self._files[filename]
+
+    def _write(self, filename: str, record: dict) -> None:
+        f = self._ensure_open(filename)
+        f.write(json.dumps(record) + "\n")
+        f.flush()
 
     def on_fit_start(self, trainer, objective, datamodule, start_step) -> None:
+        if not _primary_host():
+            return
         self.run_dir.mkdir(parents=True, exist_ok=True)
         # one metadata snapshot per run: reuse the checkpointer's (collected
         # at construction) so the checkpoint meta and the run dir record the
@@ -63,23 +93,43 @@ class JsonlLogger:
             (self.run_dir / "config.json").write_text(json.dumps(run_config, indent=2, default=str))
 
     def on_step_end(self, trainer, step, metrics) -> None:
+        if not _primary_host():
+            return
         record = {"step": step}
         for key, value in metrics.items():
             try:
                 record[key] = float(value)
             except (TypeError, ValueError):
                 record[key] = str(value)
-        f = self._ensure_open()
-        f.write(json.dumps(record) + "\n")
-        f.flush()
+        self._write("metrics.jsonl", record)
+        telemetry = {k: v for k, v in record.items() if _is_telemetry_key(k)}
+        if telemetry:
+            self._write("telemetry.jsonl", {"step": step, **telemetry})
 
     def on_validation_end(self, trainer, step, metrics) -> None:
         self.on_step_end(trainer, step, metrics)
 
+    def on_telemetry(self, trainer, step, record) -> None:
+        """End-of-fit telemetry flush (trainer epilogue): the post-loop
+        checkpoint save/wait lands after the last log step — without this,
+        `report` would render totals missing that tail."""
+        if not _primary_host():
+            return
+        telemetry = {}
+        for key, value in record.items():
+            if not _is_telemetry_key(key):
+                continue
+            try:
+                telemetry[key] = float(value)
+            except (TypeError, ValueError):
+                telemetry[key] = str(value)
+        if telemetry:
+            self._write("telemetry.jsonl", {"step": step, **telemetry})
+
     def on_fit_end(self, trainer, state) -> None:
-        if self._file is not None:
-            self._file.close()
-            self._file = None
+        for f in self._files.values():
+            f.close()
+        self._files = {}
 
 
 class WandbLoggerConfig(BaseModel):
@@ -107,6 +157,8 @@ class WandbLogger:
         self._run = None
 
     def on_fit_start(self, trainer, objective, datamodule, start_step) -> None:
+        if not _primary_host():
+            return
         import wandb
 
         cfg = self.config
@@ -152,6 +204,11 @@ class WandbLogger:
 
     def on_validation_end(self, trainer, step, metrics) -> None:
         self.on_step_end(trainer, step, metrics)
+
+    def on_telemetry(self, trainer, step, record) -> None:
+        # W&B merges re-logs at the same step, so the end-of-fit tail
+        # (final checkpoint save/wait) updates the run's last history row
+        self.on_step_end(trainer, step, record)
 
     def on_fit_end(self, trainer, state) -> None:
         if self._run is not None:

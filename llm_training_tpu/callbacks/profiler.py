@@ -28,16 +28,33 @@ class ProfilerCallback:
     def __init__(self, config: ProfilerCallbackConfig | None = None):
         self.config = config or ProfilerCallbackConfig()
         self._active = False
+        self._stop_step: int | None = None
 
     def on_train_step(self, trainer, step) -> None:
         cfg = self.config
-        if not self._active and step >= cfg.start_step:
-            end = cfg.start_step + cfg.num_steps
-            if step < end:
-                jax.profiler.start_trace(cfg.trace_dir)
-                self._active = True
-                logger.info("profiler trace started at step %d -> %s", step, cfg.trace_dir)
-        elif self._active and step >= cfg.start_step + cfg.num_steps:
+        if not self._active and cfg.start_step <= step < cfg.start_step + cfg.num_steps:
+            # explicit stop boundary, clamped to the fit's last step: when
+            # start_step + num_steps overruns max_steps the trace must still
+            # stop inside the loop (at the final step) rather than relying
+            # on teardown after the fit unwinds
+            stop_step = cfg.start_step + cfg.num_steps
+            max_steps = getattr(getattr(trainer, "config", None), "max_steps", None)
+            if max_steps is not None:
+                stop_step = min(stop_step, max_steps)
+            if step >= stop_step:
+                # zero-length window (e.g. start_step == max_steps): a trace
+                # started now would capture only the fit epilogue — no later
+                # on_train_step exists to close it inside the loop
+                logger.warning(
+                    "profiler window [%d, %d) truncated to nothing at step %d; "
+                    "not tracing", cfg.start_step, cfg.start_step + cfg.num_steps, step,
+                )
+                return
+            self._stop_step = stop_step
+            jax.profiler.start_trace(cfg.trace_dir)
+            self._active = True
+            logger.info("profiler trace started at step %d -> %s", step, cfg.trace_dir)
+        elif self._active and self._stop_step is not None and step >= self._stop_step:
             jax.profiler.stop_trace()
             self._active = False
             logger.info("profiler trace stopped at step %d", step)

@@ -141,6 +141,28 @@ class TrainingTimeEstimator:
             )
             result["model_flops_per_step"] = step_flops
             result["mfu"] = step_flops * steps_per_sec / (peak * n_dev)
+        # cross-check against XLA's own FLOP count for the compiled step
+        # (telemetry gauge set by the trainer's AOT pre-compile). XLA counts
+        # executed FLOPs per device — including remat recompute the analytic
+        # model deliberately excludes — so mfu_xla >= mfu is expected under
+        # gradient checkpointing; a LOWER mfu_xla flags a stale FLOP model
+        telemetry = getattr(trainer, "telemetry", None)
+        if telemetry is not None:
+            # the gauge is PER-DEVICE FLOPs per train_step INVOCATION (one
+            # micro-batch of the SPMD module); scale by accumulation and
+            # device count so the published key is global per OPTIMIZER
+            # step — the same units as model_flops_per_step above
+            xla_flops = telemetry.snapshot().get("xla/flops_per_step")
+            accum = getattr(getattr(trainer, "config", None), "accumulate_grad_batches", 1)
+            if xla_flops and peak:
+                global_xla_flops = xla_flops * accum * n_dev
+                result["xla_flops_per_step"] = global_xla_flops
+                result["mfu_xla"] = global_xla_flops * steps_per_sec / (peak * n_dev)
+            # publish for the log-step metrics merge -> telemetry.jsonl ->
+            # `report` (perf/ prefix routes them)
+            for key, value in result.items():
+                if isinstance(value, (int, float)):
+                    telemetry.gauge(f"perf/{key}").set(float(value))
         self.result = result
         logger.info(
             "training time estimate: %s",

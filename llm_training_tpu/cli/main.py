@@ -1,9 +1,11 @@
-"""Console entry: fit / validate.
+"""Console entry: fit / validate / report.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
 DataModule -> run, with seed_everything, logging-level control, and the
-resolved config handed to the checkpointer for embedding.
+resolved config handed to the checkpointer for embedding. `report` is a
+TPU-native addition: render a finished run's goodput/MFU/HBM summary from
+its run directory (docs/observability.md) — no config or backend needed.
 """
 
 from __future__ import annotations
@@ -74,13 +76,22 @@ def _build(config: dict):
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="llm-training-tpu")
-    parser.add_argument("command", choices=["fit", "validate"])
-    parser.add_argument("--config", required=True)
-    parser.add_argument("--ckpt-path", default=None, help="checkpoint dir/step to resume")
-    parser.add_argument(
-        "overrides", nargs="*", help="dotted config overrides: trainer.max_steps=100"
-    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    for command in ("fit", "validate"):
+        p = sub.add_parser(command)
+        p.add_argument("--config", required=True)
+        p.add_argument("--ckpt-path", default=None, help="checkpoint dir/step to resume")
+        p.add_argument(
+            "overrides", nargs="*", help="dotted config overrides: trainer.max_steps=100"
+        )
+    report = sub.add_parser("report", help="render a run summary from a run directory")
+    report.add_argument("run_dir", help="dir holding metrics.jsonl / telemetry.jsonl")
     args = parser.parse_args(argv)
+
+    if args.command == "report":
+        from llm_training_tpu.telemetry.report import report_main
+
+        return report_main(args.run_dir)
 
     config = load_config(args.config, args.overrides)
     logging.basicConfig(

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Iterator
 
 import jax
@@ -31,14 +32,24 @@ class DevicePrefetcher:
         shardings: Any,
         depth: int = 2,
         host_aux_fn: Any | None = None,
+        registry: Any | None = None,
     ):
-        self._batches = batches
+        self._batches = iter(batches)
         self._shardings = shardings
         # host_aux_fn runs on the HOST batch before transfer; its result is
         # yielded alongside the device batch (the trainer counts consumed
         # samples/tokens there — doing it on the device copy would force a
         # blocking sync every step and undo the prefetch overlap)
         self._host_aux_fn = host_aux_fn
+        # telemetry (optional): producer-side batch production time vs
+        # consumer-side queue waits — the pair that tells whether the input
+        # pipeline or the device is the bottleneck (docs/observability.md)
+        if registry is None:
+            from llm_training_tpu.telemetry import get_registry
+
+            registry = get_registry()
+        self._produce_timer = registry.timer("data/produce")
+        self._wait_timer = registry.timer("data/host_wait")
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._error: BaseException | None = None
         self._stop = threading.Event()
@@ -48,9 +59,17 @@ class DevicePrefetcher:
 
     def _worker(self) -> None:
         try:
-            for batch in self._batches:
+            while True:
+                # time successful productions only — the end-of-stream probe
+                # must not skew the mean produce latency
+                t0 = time.perf_counter()
+                try:
+                    batch = next(self._batches)
+                except StopIteration:
+                    break
                 aux = self._host_aux_fn(batch) if self._host_aux_fn else None
                 placed = (jax.device_put(batch, self._shardings), aux)
+                self._produce_timer.add(time.perf_counter() - t0)
                 while not self._stop.is_set():
                     try:
                         self._queue.put(placed, timeout=0.1)
@@ -86,7 +105,8 @@ class DevicePrefetcher:
     def __next__(self):
         if self._stop.is_set() or self._finished:
             raise StopIteration
-        item = self._queue.get()
+        with self._wait_timer.time():
+            item = self._queue.get()
         if item is _SENTINEL:
             self._finished = True
             if self._error is not None:

@@ -1,0 +1,25 @@
+"""Telemetry & goodput subsystem.
+
+One registry + one goodput ledger per fit (owned by the Trainer), device
+gauges sampled on log steps, `jax.profiler` annotations naming the same
+phases, and a `report` CLI that renders the persisted artifacts. See
+docs/observability.md for the schema and phase definitions.
+"""
+
+from llm_training_tpu.telemetry.device import compiled_cost_gauges, hbm_gauges
+from llm_training_tpu.telemetry.goodput import PHASES, GoodputLedger
+from llm_training_tpu.telemetry.registry import (
+    TelemetryRegistry,
+    get_registry,
+    set_registry,
+)
+
+__all__ = [
+    "PHASES",
+    "GoodputLedger",
+    "TelemetryRegistry",
+    "compiled_cost_gauges",
+    "get_registry",
+    "hbm_gauges",
+    "set_registry",
+]

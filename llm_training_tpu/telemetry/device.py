@@ -1,0 +1,111 @@
+"""Device gauges: HBM occupancy and XLA cost/memory analysis.
+
+`hbm_gauges()` reads `device.memory_stats()` (PJRT allocator stats — the
+source of truth for how close a run is to the HBM cliff). Backends without
+allocator stats (the CPU test mesh) fall back to host RSS so the gauges —
+and the tests/smoke runs that assert on them — always exist; the `hbm/`
+prefix then means "process memory", which docs/observability.md spells out.
+
+`compiled_cost_gauges()` pulls XLA's own FLOPs estimate and buffer sizes
+from an AOT-compiled step — the cross-check for the analytic 6N+attention
+MFU model in callbacks/time_estimator.py (XLA counts what was actually
+compiled, including remat recompute; the analytic model deliberately
+doesn't credit recompute).
+
+jax is imported lazily so `llm_training_tpu report` (which imports this
+package) stays usable without touching an accelerator backend.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+_MEMORY_STAT_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "largest_alloc_size",
+)
+
+
+def _host_rss_bytes() -> tuple[float | None, float | None]:
+    """(current, peak) resident set size of this process, or Nones."""
+    current = peak = None
+    try:
+        import resource
+        import sys
+
+        # ru_maxrss is KiB on Linux but bytes on macOS
+        scale = 1.0 if sys.platform == "darwin" else 1024.0
+        peak = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * scale
+    except Exception:  # pragma: no cover - non-POSIX
+        pass
+    try:
+        import os
+
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        current = float(pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:  # pragma: no cover - non-Linux
+        pass
+    return current, peak
+
+
+def hbm_gauges() -> dict[str, float]:
+    """`hbm/*` gauges from the first local device's allocator stats, with a
+    host-RSS fallback when the backend exposes none."""
+    out: dict[str, float] = {}
+    stats = None
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception as e:  # backend not initialized / no devices
+        logger.debug("memory_stats unavailable: %s", e)
+    if stats:
+        for key in _MEMORY_STAT_KEYS:
+            if key in stats:
+                out[f"hbm/{key}"] = float(stats[key])
+        return out
+    current, peak = _host_rss_bytes()
+    if current is not None:
+        out["hbm/bytes_in_use"] = current
+    if peak is not None:
+        out["hbm/peak_bytes_in_use"] = peak
+    if out:
+        out["hbm/host_fallback"] = 1.0
+    return out
+
+
+def compiled_cost_gauges(compiled) -> dict[str, float]:
+    """`xla/*` gauges from a `jax.stages.Compiled` train step."""
+    out: dict[str, float] = {}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        for key, name in (
+            ("flops", "xla/flops_per_step"),
+            ("bytes accessed", "xla/bytes_accessed_per_step"),
+        ):
+            value = float(cost.get(key, 0.0) or 0.0)
+            if value > 0:
+                out[name] = value
+    except Exception as e:
+        logger.debug("cost_analysis unavailable: %s", e)
+    try:
+        mem = compiled.memory_analysis()
+        for attr in (
+            "temp_size_in_bytes",
+            "argument_size_in_bytes",
+            "output_size_in_bytes",
+            "generated_code_size_in_bytes",
+        ):
+            value = getattr(mem, attr, None)
+            if value is not None:
+                out[f"xla/{attr}"] = float(value)
+    except Exception as e:
+        logger.debug("memory_analysis unavailable: %s", e)
+    return out

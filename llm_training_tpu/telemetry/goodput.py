@@ -1,0 +1,74 @@
+"""Goodput ledger: classify fit wall time into phases.
+
+Every second between `start()` and now is attributed to exactly one of
+{compile, data_wait, step_compute, checkpoint_save, validation, other}:
+the trainer brackets each activity with `measure(phase)` and `other` is
+the unexplained remainder (setup, host-side bookkeeping), so the phases
+always sum to the total by construction. Goodput is the step-compute share
+of the total — the fraction of wall time the run spent doing the work it
+exists to do. JAX dispatch is asynchronous, so host-side brackets attribute
+*blocking* time: the device_get on log steps bills accumulated device step
+time to `step_compute`, and a stalled input pipeline surfaces as
+`data_wait` (the host blocking on the prefetcher queue).
+
+The clock is injectable so phase classification is unit-testable without
+real sleeps (see tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+PHASES = ("compile", "data_wait", "step_compute", "checkpoint_save", "validation")
+
+
+class GoodputLedger:
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0: float | None = None
+        self._phase_s: dict[str, float] = {p: 0.0 for p in PHASES}
+
+    def start(self) -> None:
+        """Begin (or restart) accounting; zeroes all phases."""
+        with self._lock:
+            self._t0 = self._clock()
+            self._phase_s = {p: 0.0 for p in PHASES}
+
+    def note(self, phase: str, seconds: float) -> None:
+        """Attribute externally measured seconds to a phase."""
+        if phase not in self._phase_s:
+            raise KeyError(f"unknown goodput phase {phase!r}; expected one of {PHASES}")
+        with self._lock:
+            self._phase_s[phase] += seconds
+
+    @contextmanager
+    def measure(self, phase: str) -> Iterator[None]:
+        """Time the enclosed block into `phase`."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.note(phase, self._clock() - t0)
+
+    def elapsed(self) -> float:
+        with self._lock:
+            return 0.0 if self._t0 is None else self._clock() - self._t0
+
+    def summary(self) -> dict[str, float]:
+        """`goodput/<phase>_s` for every phase (incl. the `other` remainder),
+        `goodput/total_s`, and `goodput/goodput_pct`. Phases sum to total
+        exactly."""
+        with self._lock:
+            total = 0.0 if self._t0 is None else self._clock() - self._t0
+            tracked = sum(self._phase_s.values())
+            out = {f"goodput/{p}_s": s for p, s in self._phase_s.items()}
+            out["goodput/other_s"] = max(0.0, total - tracked)
+            out["goodput/total_s"] = total
+            out["goodput/goodput_pct"] = (
+                100.0 * self._phase_s["step_compute"] / total if total > 0 else 0.0
+            )
+            return out

@@ -1,0 +1,179 @@
+"""Render a post-hoc run summary from a run directory.
+
+`llm-training-tpu report <run_dir>` reads the artifacts the loggers wrote
+(`metrics.jsonl`, `telemetry.jsonl`, `run_metadata.json`) and prints a
+human-readable summary: loss/throughput stats, the goodput breakdown table,
+HBM peak, and MFU when the run recorded it. Pure stdlib — no jax import —
+so it runs anywhere the run dir is mounted.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+from pathlib import Path
+
+from llm_training_tpu.telemetry.goodput import PHASES
+
+_GIB = 1024.0**3
+
+
+def _read_jsonl(path: Path) -> list[dict]:
+    records = []
+    if not path.exists():
+        return records
+    for line in path.read_text().splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue  # tolerate a torn tail from a killed run
+    return records
+
+
+def _fmt_seconds(s: float) -> str:
+    return f"{s:,.2f}"
+
+
+def _last_with(records: list[dict], key: str) -> dict | None:
+    for record in reversed(records):
+        if key in record:
+            return record
+    return None
+
+
+def _last_run_segment(records: list[dict]) -> list[dict]:
+    """Run dirs are opened in append mode (a legitimate resume continues the
+    step sequence), so re-running a fixed-name config stacks multiple runs
+    in one file. A step-number RESET marks a new run — summarize only the
+    newest segment rather than silently pooling runs."""
+    start = 0
+    previous = None
+    for i, record in enumerate(records):
+        step = record.get("step")
+        if step is None:
+            continue
+        if previous is not None and step < previous:
+            start = i
+        previous = step
+    return records[start:]
+
+
+def _goodput_table(telemetry: dict) -> list[str]:
+    total = float(telemetry.get("goodput/total_s", 0.0))
+    lines = [
+        "== Goodput ==",
+        f"{'phase':<16} {'seconds':>12} {'share':>8}",
+    ]
+    for phase in PHASES + ("other",):
+        seconds = float(telemetry.get(f"goodput/{phase}_s", 0.0))
+        share = 100.0 * seconds / total if total > 0 else 0.0
+        lines.append(f"{phase:<16} {_fmt_seconds(seconds):>12} {share:>7.1f}%")
+    lines.append(f"{'total':<16} {_fmt_seconds(total):>12} {100.0 if total > 0 else 0.0:>7.1f}%")
+    lines.append(f"goodput: {float(telemetry.get('goodput/goodput_pct', 0.0)):.1f}% of wall time in step compute")
+    return lines
+
+
+def render_report(run_dir: str | Path) -> str:
+    run_dir = Path(run_dir)
+    metrics = _read_jsonl(run_dir / "metrics.jsonl")
+    if not metrics:
+        raise FileNotFoundError(
+            f"no metrics.jsonl records under {run_dir} — is this a run directory?"
+        )
+    metrics = _last_run_segment(metrics)
+    telemetry_records = _last_run_segment(_read_jsonl(run_dir / "telemetry.jsonl"))
+    # the ledger is cumulative, so the newest record is the run total; fall
+    # back to goodput keys embedded in metrics.jsonl (older runs / W&B-only)
+    telemetry = (
+        telemetry_records[-1]
+        if telemetry_records
+        else (_last_with(metrics, "goodput/total_s") or {})
+    )
+
+    lines = [f"Run report: {run_dir}"]
+    meta_path = run_dir / "run_metadata.json"
+    if meta_path.exists():
+        try:
+            meta = json.loads(meta_path.read_text())
+            world = meta.get("world", meta)
+            parts = [
+                f"{key}={world[key]}"
+                for key in ("backend", "device_kind", "device_count", "num_processes")
+                if key in world
+            ]
+            if parts:
+                lines.append("env: " + "  ".join(parts))
+        except Exception:
+            pass
+
+    train = [r for r in metrics if "loss" in r]
+    lines.append("")
+    lines.append("== Training ==")
+    if train:
+        steps = [int(r["step"]) for r in train if "step" in r]
+        lines.append(f"logged steps: {min(steps)}..{max(steps)} ({len(train)} records)")
+        losses = [float(r["loss"]) for r in train]
+        lines.append(
+            f"loss: first {losses[0]:.4f} -> last {losses[-1]:.4f} (min {min(losses):.4f})"
+        )
+        sps = [float(r["steps_per_sec"]) for r in train if "steps_per_sec" in r]
+        if sps:
+            lines.append(
+                f"steps_per_sec: median {statistics.median(sps):.3f} (last {sps[-1]:.3f})"
+            )
+        last_tokens = _last_with(metrics, "consumed_tokens")
+        if last_tokens:
+            lines.append(
+                f"consumed: {int(last_tokens['consumed_tokens']):,} tokens, "
+                f"{int(last_tokens.get('consumed_samples', 0)):,} samples"
+            )
+    val = _last_with(metrics, "val_loss")
+    if val:
+        lines.append(f"val_loss: {float(val['val_loss']):.4f} (step {val.get('step', '?')})")
+
+    # MFU: the time estimator publishes perf/* gauges into telemetry
+    for key, label in (
+        ("perf/mfu", "MFU (analytic 6N+attention)"),
+        ("perf/mfu_xla", "MFU (XLA cost_analysis)"),
+        ("perf/tokens_per_sec", "tokens/sec"),
+        ("perf/tokens_per_sec_per_device", "tokens/sec/device"),
+    ):
+        if key in telemetry:
+            value = float(telemetry[key])
+            lines.append(
+                f"{label}: {value:.4f}" if "mfu" in key else f"{label}: {value:,.1f}"
+            )
+    if "compile_time_s" in telemetry:
+        lines.append(f"compile_time_s: {float(telemetry['compile_time_s']):.2f}")
+
+    lines.append("")
+    lines.extend(_goodput_table(telemetry))
+
+    hbm_peak = telemetry.get("hbm/peak_bytes_in_use")
+    hbm_limit = telemetry.get("hbm/bytes_limit")
+    if hbm_peak is not None:
+        lines.append("")
+        lines.append("== Device memory ==")
+        source = "host RSS fallback" if telemetry.get("hbm/host_fallback") else "HBM"
+        peak_line = f"peak: {float(hbm_peak) / _GIB:.2f} GiB ({source})"
+        if hbm_limit:
+            peak_line += (
+                f" of {float(hbm_limit) / _GIB:.2f} GiB limit"
+                f" ({100.0 * float(hbm_peak) / float(hbm_limit):.0f}%)"
+            )
+        lines.append(peak_line)
+    return "\n".join(lines)
+
+
+def report_main(run_dir: str) -> int:
+    """`llm-training-tpu report <run_dir>` entry point."""
+    try:
+        print(render_report(run_dir))
+    except FileNotFoundError as e:
+        print(f"report: {e}", file=sys.stderr)
+        return 2
+    return 0

@@ -82,14 +82,19 @@ class Checkpointer:
             "config": self.run_config,
             "run_metadata": self.run_metadata,
         }
-        self.manager.save(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(_pack(state)),
-                meta=ocp.args.JsonSave(meta),
-            ),
-            force=force,
-        )
+        from llm_training_tpu.telemetry import get_registry
+
+        # with async_save this times only the blocking handoff (serialize +
+        # background-thread launch); wait() below captures the barrier
+        with get_registry().timer("checkpoint/save").time():
+            self.manager.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(_pack(state)),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+                force=force,
+            )
         logger.info("checkpoint saved at step %d -> %s", step, self.directory)
 
     def maybe_restore(
@@ -125,7 +130,10 @@ class Checkpointer:
         return self.manager.latest_step()
 
     def wait(self) -> None:
-        self.manager.wait_until_finished()
+        from llm_training_tpu.telemetry import get_registry
+
+        with get_registry().timer("checkpoint/wait").time():
+            self.manager.wait_until_finished()
 
     def close(self) -> None:
         self.manager.close()

@@ -35,6 +35,13 @@ from llm_training_tpu.parallel.sharding import (
     DEFAULT_LOGICAL_AXIS_RULES,
     logical_to_spec,
 )
+from llm_training_tpu.telemetry import (
+    GoodputLedger,
+    TelemetryRegistry,
+    compiled_cost_gauges,
+    hbm_gauges,
+    set_registry,
+)
 from llm_training_tpu.trainer.state import TrainState
 
 logger = logging.getLogger(__name__)
@@ -145,6 +152,11 @@ class Trainer:
         self.abstract_state = None
         self.last_step: int | None = None
         self.last_seq_len: int | None = None
+        # per-fit telemetry: a thread-safe metric registry (prefetcher and
+        # checkpointer record into it) + the goodput wall-time ledger; both
+        # flow into the metrics dict on log steps (docs/observability.md)
+        self.telemetry = TelemetryRegistry()
+        self.ledger = GoodputLedger()
         # blocked optimizer offload (decided at fit start): the optimizer
         # state is a TUPLE of per-param-leaf states, each running its own
         # copy-in -> update -> copy-out chain with global grad clipping
@@ -382,10 +394,16 @@ class Trainer:
         self.mesh = build_mesh(cfg.mesh, self.devices)
         datamodule.setup()
 
+        # fresh telemetry per fit, installed as the process-current registry
+        # so components constructed elsewhere (the checkpointer) find it
+        self.telemetry = TelemetryRegistry()
+        self.ledger.start()
+        previous_registry = set_registry(self.telemetry)
         try:
             with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
                 return self._fit_inner(objective, datamodule, resume_step, state)
         finally:
+            set_registry(previous_registry)
             # callbacks that alter process state (output tees, profiler
             # traces) must restore it even when fit raises mid-run
             for cb in self.callbacks:
@@ -533,6 +551,24 @@ class Trainer:
             in_shardings=(self.state_shardings, batch_shardings),
         )
 
+        # AOT-compile the hot step up front: the compile lands in its own
+        # goodput phase (and compile_time_s gauge) instead of skewing the
+        # first step, and the Compiled object exposes XLA's cost/memory
+        # analysis — the cross-check for the analytic MFU model. The jitted
+        # callable stays as fallback (same avals/shardings, same semantics).
+        aot_step = None
+        t_compile = time.perf_counter()
+        with self.ledger.measure("compile"):
+            try:
+                aot_step = train_step.lower(state, sample_batch).compile()
+            except Exception as e:
+                logger.info("AOT pre-compile unavailable (%s); compiling on first step", e)
+        if aot_step is not None:
+            self.telemetry.gauge("compile_time_s").set(time.perf_counter() - t_compile)
+            for name, value in compiled_cost_gauges(aot_step).items():
+                self.telemetry.gauge(name).set(value)
+        step_fn = aot_step if aot_step is not None else train_step
+
         # state.step counts micro-steps (train_step invocations): resume
         # continues the data stream exactly where it stopped, independent of
         # the accumulation factor
@@ -554,7 +590,12 @@ class Trainer:
         self.last_seq_len = (
             sample_batch["input_ids"].shape[1] if "input_ids" in sample_batch else None
         )
-        step_time = time.perf_counter()
+        # throughput window: (start time, start step). Reset after the first
+        # optimizer step of this process so JIT compile/warmup never skews
+        # steps_per_sec (compile is its own telemetry gauge + goodput phase).
+        start_step0 = start_micro // cfg.accumulate_grad_batches
+        first_process_step = start_step0 + 1
+        window_time, window_step = time.perf_counter(), start_step0
         try:
             # constructed inside the try so an exception anywhere after the
             # worker thread starts still reaches prefetcher.close()
@@ -566,15 +607,53 @@ class Trainer:
                     batch_shardings,
                     depth=cfg.prefetch_batches,
                     host_aux_fn=self._batch_counts,
+                    registry=self.telemetry,
                 )
                 batches = iter(prefetcher)
             for micro in range(start_micro, micro_steps):
-                if prefetcher is not None:
-                    batch, counts = next(batches)
-                else:
-                    batch = next(batches)
-                    counts = self._batch_counts(batch)
-                state, metrics = train_step(state, batch)
+                with jax.profiler.StepTraceAnnotation("train", step_num=micro):
+                    with self.ledger.measure("data_wait"), \
+                            jax.profiler.TraceAnnotation("data_load"):
+                        if prefetcher is not None:
+                            batch, counts = next(batches)
+                        else:
+                            batch = next(batches)
+                            counts = self._batch_counts(batch)
+                    # without the AOT pre-compile, the first invocation blocks
+                    # on trace+compile — bill it to the compile phase
+                    first_compiling = aot_step is None and micro == start_micro
+                    phase = "compile" if first_compiling else "step_compute"
+                    t_step = time.perf_counter()
+                    try:
+                        with self.ledger.measure(phase), \
+                                jax.profiler.TraceAnnotation("train_step"):
+                            state, metrics = step_fn(state, batch)
+                    except TypeError:
+                        # the AOT executable is pinned to sample_batch's
+                        # shapes; pad-to-longest collators emit variable
+                        # sequence lengths. The mismatch raises BEFORE
+                        # execution (donated buffers intact), so fall back
+                        # permanently to the jitted callable, which
+                        # recompiles per shape like it always did. The retry
+                        # (jit trace + compile) bills to the compile phase;
+                        # LATER new-shape recompiles are invisible inside
+                        # the jit call and land in step_compute — the
+                        # warning below is the flag that this is happening
+                        if step_fn is train_step:
+                            raise
+                        logger.warning(
+                            "AOT train step rejected batch shapes at micro "
+                            "step %d (variable-length batches?); falling "
+                            "back to jit recompilation", micro,
+                        )
+                        step_fn = train_step
+                        with self.ledger.measure("compile"), \
+                                jax.profiler.TraceAnnotation("train_step"):
+                            state, metrics = step_fn(state, batch)
+                    if first_compiling:
+                        self.telemetry.gauge("compile_time_s").set(
+                            time.perf_counter() - t_step
+                        )
 
                 self._apply_counts(counts)
 
@@ -594,25 +673,46 @@ class Trainer:
                 if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
                     # ONE batched transfer: per-value device_get pays one
                     # host<->device round trip per metric, which on a
-                    # remote-attached TPU leaves the chip idle between steps
-                    metrics = {
-                        k: np.asarray(v) for k, v in jax.device_get(metrics).items()
-                    }
+                    # remote-attached TPU leaves the chip idle between steps.
+                    # The blocking fetch drains the async dispatch queue, so
+                    # its wall time is accumulated device step time —
+                    # goodput bills it to step_compute
+                    with self.ledger.measure("step_compute"):
+                        metrics = {
+                            k: np.asarray(v) for k, v in jax.device_get(metrics).items()
+                        }
                     now = time.perf_counter()
                     metrics["lr"] = np.asarray(schedule(step))
-                    metrics["steps_per_sec"] = cfg.log_every_n_steps / (now - step_time)
+                    metrics["steps_per_sec"] = (step - window_step) / max(
+                        now - window_time, 1e-9
+                    )
                     metrics.update(self.counters)
-                    step_time = now
+                    window_time, window_step = now, step
+                    # telemetry rides the metrics dict: JSONL/W&B loggers
+                    # persist the goodput breakdown, device gauges, and
+                    # registry snapshot (compile_time_s, data/*, checkpoint/*)
+                    metrics.update(self.ledger.summary())
+                    metrics.update(hbm_gauges())
+                    metrics.update(self.telemetry.snapshot())
                     logger.info(
-                        "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s",
-                        step, metrics["loss"], metrics["grad_norm"], metrics["steps_per_sec"],
+                        "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s "
+                        "| goodput %.1f%%",
+                        step, metrics["loss"], metrics["grad_norm"],
+                        metrics["steps_per_sec"], metrics["goodput/goodput_pct"],
                     )
                     for cb in self.callbacks:
                         if hasattr(cb, "on_step_end"):
                             cb.on_step_end(self, step, metrics)
 
+                if step == first_process_step:
+                    # drop the compile/warmup-laden first step from the next
+                    # throughput window (after its possible log above)
+                    window_time, window_step = time.perf_counter(), step
+
                 if cfg.val_check_interval and step % cfg.val_check_interval == 0:
-                    self._run_validation(eval_step, state, datamodule, step)
+                    with self.ledger.measure("validation"), \
+                            jax.profiler.TraceAnnotation("validation"):
+                        self._run_validation(eval_step, state, datamodule, step)
 
                 if (
                     self.checkpointer is not None
@@ -625,7 +725,9 @@ class Trainer:
                     # not trust log cadence — check this step's loss directly
                     and self._loss_finite(metrics, step)
                 ):
-                    self.checkpointer.save(step, state, counters=dict(self.counters))
+                    with self.ledger.measure("checkpoint_save"), \
+                            jax.profiler.TraceAnnotation("checkpoint_save"):
+                        self.checkpointer.save(step, state, counters=dict(self.counters))
 
                 if self.should_stop:
                     logger.info("stopping at step %d (callback request)", step)
@@ -642,10 +744,25 @@ class Trainer:
         ):
             # label with the step actually reached: an early stop
             # (should_stop) must not masquerade as a completed run
-            self.checkpointer.save(
-                self.last_step, state, counters=dict(self.counters), force=True
-            )
-            self.checkpointer.wait()
+            with self.ledger.measure("checkpoint_save"), \
+                    jax.profiler.TraceAnnotation("checkpoint_save"):
+                self.checkpointer.save(
+                    self.last_step, state, counters=dict(self.counters), force=True
+                )
+                self.checkpointer.wait()
+        # one final telemetry record: the post-loop checkpoint save/wait
+        # landed after the last log step, so without this flush every
+        # logger's totals would miss that tail (report reads the last
+        # telemetry record as the run total)
+        if self.last_step is not None:
+            record = {
+                **self.ledger.summary(),
+                **hbm_gauges(),
+                **self.telemetry.snapshot(),
+            }
+            for cb in self.callbacks:
+                if hasattr(cb, "on_telemetry"):
+                    cb.on_telemetry(self, self.last_step, record)
         for cb in self.callbacks:
             if hasattr(cb, "on_fit_end"):
                 cb.on_fit_end(self, state)

@@ -10,6 +10,17 @@ cd "$(dirname "$0")/.."
 echo "== precommit: not-slow test tier =="
 python -m pytest tests/ -x -q -m "not slow" "$@"
 
+# telemetry/report gate: the tiny CPU config must produce a run dir whose
+# metrics.jsonl/telemetry.jsonl render into a goodput table with exit 0
+echo "== precommit: report smoke (CPU fit -> report) =="
+SMOKE_ROOT=$(mktemp -d)
+trap 'rm -rf "${SMOKE_ROOT}"' EXIT
+JAX_PLATFORMS=cpu python -m llm_training_tpu fit \
+    --config config/examples/smoke/cpu-smoke.yaml "run_root=${SMOKE_ROOT}"
+JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
+    | tee "${SMOKE_ROOT}/report_smoke.log"
+grep -q "goodput" "${SMOKE_ROOT}/report_smoke.log"
+
 # note: under axon the sitecustomize registers the TPU backend at interpreter
 # start, so JAX_PLATFORMS=cpu does NOT demote this to a CPU smoke — when a
 # chip is attached this runs the REAL default bench (and must print rc=0 with

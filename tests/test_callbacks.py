@@ -186,6 +186,98 @@ def test_nan_guard_stop_mode():
     assert trainer.should_stop is True
 
 
+class _TraceRecorder:
+    """Monkeypatch target for jax.profiler start/stop — records transitions."""
+
+    def __init__(self, monkeypatch):
+        self.calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace", lambda d: self.calls.append(("start", d))
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: self.calls.append(("stop",))
+        )
+
+
+def _profiler_trainer(max_steps):
+    class T:
+        config = TrainerConfig(max_steps=max_steps, mesh=MeshConfig())
+
+    return T()
+
+
+def test_profiler_start_stop_window(monkeypatch):
+    from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
+
+    rec = _TraceRecorder(monkeypatch)
+    cb = ProfilerCallback(ProfilerCallbackConfig(start_step=3, num_steps=2))
+    trainer = _profiler_trainer(10)
+    for step in range(1, 8):
+        cb.on_train_step(trainer, step)
+    cb.teardown()
+    # starts at 3, stops at 5 (boundary explicit), teardown adds nothing
+    assert rec.calls == [("start", cb.config.trace_dir), ("stop",)]
+
+
+def test_profiler_window_overrunning_max_steps_stops_in_loop(monkeypatch):
+    """Regression: start_step + num_steps > max_steps used to leave the
+    trace open until teardown; the boundary is now clamped to max_steps."""
+    from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
+
+    rec = _TraceRecorder(monkeypatch)
+    cb = ProfilerCallback(ProfilerCallbackConfig(start_step=4, num_steps=10))
+    trainer = _profiler_trainer(5)
+    for step in range(1, 6):
+        cb.on_train_step(trainer, step)
+    # stopped AT step 5 (the final step), not via teardown
+    assert rec.calls == [("start", cb.config.trace_dir), ("stop",)]
+    assert not cb._active
+    cb.teardown()
+    assert rec.calls.count(("stop",)) == 1
+
+
+def test_profiler_teardown_stops_dangling_trace(monkeypatch):
+    from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
+
+    rec = _TraceRecorder(monkeypatch)
+    cb = ProfilerCallback(ProfilerCallbackConfig(start_step=2, num_steps=5))
+    trainer = _profiler_trainer(10)
+    cb.on_train_step(trainer, 2)  # started; fit dies before the window ends
+    assert cb._active
+    cb.teardown()
+    assert rec.calls == [("start", cb.config.trace_dir), ("stop",)]
+    cb.teardown()  # idempotent
+    assert rec.calls.count(("stop",)) == 1
+
+
+def test_profiler_zero_length_window_never_starts(monkeypatch):
+    """A window that clamps to nothing (start_step == max_steps) must not
+    open a trace that only teardown would close — it would capture the fit
+    epilogue, not steps."""
+    from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
+
+    rec = _TraceRecorder(monkeypatch)
+    cb = ProfilerCallback(ProfilerCallbackConfig(start_step=5, num_steps=10))
+    trainer = _profiler_trainer(5)
+    for step in range(1, 6):
+        cb.on_train_step(trainer, step)
+    assert not cb._active
+    cb.teardown()
+    assert rec.calls == []
+
+
+def test_profiler_never_starts_past_window(monkeypatch):
+    from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
+
+    rec = _TraceRecorder(monkeypatch)
+    cb = ProfilerCallback(ProfilerCallbackConfig(start_step=3, num_steps=2))
+    trainer = _profiler_trainer(10)
+    for step in (6, 7, 8):  # resume landed past the window
+        cb.on_train_step(trainer, step)
+    cb.teardown()
+    assert rec.calls == []
+
+
 def test_extra_config_flags(monkeypatch):
     import jax
 
