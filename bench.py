@@ -224,56 +224,73 @@ def main() -> None:
     # (BENCH_TIMING=pipelined) is ~20% slower — unsynced host run-ahead
     # floods the remote-execute tunnel. Sync mode is also the conservative
     # measure: it bills one host round trip per step.
-    window = {}
-    sync_times = []
     sync_mode = os.environ.get("BENCH_TIMING", "sync") == "sync"
 
-    class Timer:
-        # the fence fetches a real scalar: on the tunnel-attached chip
-        # jax.block_until_ready can return before remote execution finishes
-        # (measured r3), so only a data round trip proves the step completed
-        def on_train_step(self, trainer, step):
-            if sync_mode:
-                jax.device_get(trainer.last_metrics["loss"])
-                sync_times.append(time.perf_counter())
-            elif step == warmup:
-                jax.device_get(trainer.last_metrics["loss"])
-                window["t0"] = time.perf_counter()
+    def timed_fit(health_every=None):
+        """One measured fit; `health_every` turns the model-health layer on
+        (the A/B for `health_overhead_pct`)."""
+        window = {}
+        sync_times = []
 
-        def on_step_end(self, trainer, step, metrics):
-            # fires on log steps only; by config that is the final step, and
-            # metrics arrive here already device_get (i.e. synced)
-            if step == steps:
-                window["t1"] = time.perf_counter()
+        class Timer:
+            # the fence fetches a real scalar: on the tunnel-attached chip
+            # jax.block_until_ready can return before remote execution
+            # finishes (measured r3), so only a data round trip proves the
+            # step completed
+            def on_train_step(self, trainer, step):
+                if sync_mode:
+                    jax.device_get(trainer.last_metrics["loss"])
+                    sync_times.append(time.perf_counter())
+                elif step == warmup:
+                    jax.device_get(trainer.last_metrics["loss"])
+                    window["t0"] = time.perf_counter()
 
-    callbacks = [Timer()]
-    if os.environ.get("BENCH_PROFILE"):  # capture a jax.profiler trace window
-        from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
+            def on_step_end(self, trainer, step, metrics):
+                # fires on log steps only; by config that is the final step,
+                # and metrics arrive here already device_get (i.e. synced)
+                if step == steps:
+                    window["t1"] = time.perf_counter()
 
-        callbacks.append(ProfilerCallback(ProfilerCallbackConfig(
-            trace_dir=os.environ["BENCH_PROFILE"], start_step=4, num_steps=2,
-        )))
-    trainer = Trainer(
-        TrainerConfig(
-            max_steps=steps, log_every_n_steps=steps, mesh=MeshConfig(),
-            # BENCH_OFFLOAD=1 parks fp32 mu/nu in pinned host memory (XLA
-            # host offloading) — frees 8 bytes/param of HBM for bigger
-            # models at a per-step transfer cost (recorded in BASELINE.md)
-            offload_optimizer_state=bool(os.environ.get("BENCH_OFFLOAD")),
-            # BENCH_OFFLOAD_DTYPE=int8|bfloat16 compresses the offloaded
-            # state storage (quantized_state.py) to cut the host round trip
-            offload_state_dtype=os.environ.get("BENCH_OFFLOAD_DTYPE", "float32"),
-        ),
-        callbacks=callbacks,
-    )
-    trainer.fit(objective, datamodule)
+        callbacks = [Timer()]
+        if os.environ.get("BENCH_PROFILE") and health_every is None:
+            # capture a jax.profiler trace window (headline run only)
+            from llm_training_tpu.callbacks import ProfilerCallback, ProfilerCallbackConfig
 
-    if sync_mode:
-        # intervals between consecutive post-warmup syncs; the slice starts
-        # at warmup-1 so the first post-warmup step's interval is kept
-        sec_per_step = float(np.median(np.diff(sync_times[warmup - 1:])))
-    else:
-        sec_per_step = (window["t1"] - window["t0"]) / (steps - warmup)
+            callbacks.append(ProfilerCallback(ProfilerCallbackConfig(
+                trace_dir=os.environ["BENCH_PROFILE"], start_step=4, num_steps=2,
+            )))
+        trainer = Trainer(
+            TrainerConfig(
+                max_steps=steps, log_every_n_steps=steps, mesh=MeshConfig(),
+                # BENCH_OFFLOAD=1 parks fp32 mu/nu in pinned host memory (XLA
+                # host offloading) — frees 8 bytes/param of HBM for bigger
+                # models at a per-step transfer cost (recorded in BASELINE.md)
+                offload_optimizer_state=bool(os.environ.get("BENCH_OFFLOAD")),
+                # BENCH_OFFLOAD_DTYPE=int8|bfloat16 compresses the offloaded
+                # state storage (quantized_state.py) to cut the host round trip
+                offload_state_dtype=os.environ.get("BENCH_OFFLOAD_DTYPE", "float32"),
+                health={"every_n_steps": health_every},
+            ),
+            callbacks=callbacks,
+        )
+        trainer.fit(objective, datamodule)
+
+        if sync_mode:
+            # intervals between consecutive post-warmup syncs; the slice
+            # starts at warmup-1 so the first post-warmup interval is kept
+            sec = float(np.median(np.diff(sync_times[warmup - 1:])))
+        else:
+            sec = (window["t1"] - window["t0"]) / (steps - warmup)
+        return trainer, sec
+
+    trainer, sec_per_step = timed_fit()
+    # perf cost of the health instrumentation (per-layer norms + the host
+    # fetch each health step): same fit with every_n_steps=1 vs disabled.
+    # BENCH_HEALTH=0 skips the second fit (halves bench wall time)
+    health_overhead_pct = None
+    if os.environ.get("BENCH_HEALTH", "1") != "0":
+        _, sec_health = timed_fit(health_every=1)
+        health_overhead_pct = 100.0 * (sec_health - sec_per_step) / sec_per_step
     tokens_per_step = batch * max(1, n_dev) * seq
     tokens_per_sec = tokens_per_step / sec_per_step
     tokens_per_sec_chip = tokens_per_sec / max(1, n_dev)
@@ -330,6 +347,11 @@ def main() -> None:
         "backend": jax.default_backend(),
         "goodput_pct": round(goodput["goodput/goodput_pct"], 2),
         "compile_time_s": round(snapshot.get("compile_time_s", 0.0), 2),
+        # step-time cost of health.every_n_steps=1 vs disabled (None when
+        # BENCH_HEALTH=0 skipped the A/B fit)
+        "health_overhead_pct": (
+            round(health_overhead_pct, 2) if health_overhead_pct is not None else None
+        ),
         # global per OPTIMIZER step (the gauge is per-device per train_step
         # invocation), same units as the estimator's perf/xla_flops_per_step
         "xla_flops_per_step": (

@@ -9,7 +9,12 @@ lacks: MFU reporting and a `jax.profiler` trace hook (SURVEY.md §5.1 notes
 the reference has no profiler integration at all).
 """
 
-from llm_training_tpu.callbacks.nan_guard import NanGuard, NanGuardConfig, NonFiniteLossError
+from llm_training_tpu.callbacks.nan_guard import (
+    LossSpikeError,
+    NanGuard,
+    NanGuardConfig,
+    NonFiniteLossError,
+)
 from llm_training_tpu.callbacks.loggers import JsonlLogger, JsonlLoggerConfig, WandbLogger, WandbLoggerConfig
 from llm_training_tpu.callbacks.output_redirection import OutputRedirection, OutputRedirectionConfig
 from llm_training_tpu.callbacks.progress import ProgressBar, ProgressBarConfig
@@ -17,6 +22,7 @@ from llm_training_tpu.callbacks.profiler import ProfilerCallback, ProfilerCallba
 from llm_training_tpu.callbacks.time_estimator import TrainingTimeEstimator, TrainingTimeEstimatorConfig
 
 __all__ = [
+    "LossSpikeError",
     "NanGuard",
     "NanGuardConfig",
     "NonFiniteLossError",

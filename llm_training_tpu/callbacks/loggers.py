@@ -20,7 +20,10 @@ logger = logging.getLogger(__name__)
 
 # metric keys routed (additionally) to telemetry.jsonl — the observability
 # record a `report` invocation reads (docs/observability.md)
-TELEMETRY_PREFIXES = ("goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/")
+TELEMETRY_PREFIXES = (
+    "goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/",
+    "health/", "nan_guard/",
+)
 TELEMETRY_KEYS = ("compile_time_s",)
 
 

@@ -1,13 +1,24 @@
-"""Divergence detection: stop or raise on non-finite loss / gradients.
+"""Run-health guard: stop or raise on non-finite OR spiking loss/grad_norm,
+with NaN provenance and anomaly dumps.
 
 Capability parity: the reference's failure-detection surface (SURVEY.md
 §5.3) is fp16-specific — DeepSpeed loss-scale underflow with
 `raise_error_at_min_scale` (`deepspeed_strategy.py:104-108`) plus a
 skipped-steps metric (`:131-142`). bf16 training has no loss scale; the
 TPU-native equivalent watches the loss and grad norm directly, counts
-non-finite steps, and kills the run before it burns accelerator-hours on a
-diverged model. Checks run on log steps (host metrics already materialized
-there — no extra device sync)."""
+non-finite steps (published as the `nan_guard/non_finite_steps` registry
+counter — the skipped-steps-metric analogue, persisted to telemetry.jsonl/
+W&B), and kills the run before it burns accelerator-hours on a diverged
+model. Checks run on log steps (host metrics already materialized there —
+no extra device sync).
+
+Beyond the reference: an EMA z-score spike detector
+(`telemetry/anomaly.EmaZScore`) catches divergence precursors while
+everything is still finite — large-scale TPU runs stop-and-rewind on
+exactly this signal (arXiv 2204.06514 §5) — and both the NaN and spike
+paths name the offending layer groups (from the trainer's most recent
+health-step snapshot, `trainer.last_health`) and write an
+`anomaly-<step>.json` dump into the run directory."""
 
 from __future__ import annotations
 
@@ -15,6 +26,8 @@ import logging
 import math
 
 from pydantic import BaseModel, ConfigDict, Field
+
+from llm_training_tpu.telemetry import anomaly as _anomaly
 
 logger = logging.getLogger(__name__)
 
@@ -28,9 +41,28 @@ class NanGuardConfig(BaseModel):
     # raise (crash the run, let the scheduler restart from the checkpoint)
     # vs stop (end the fit cleanly)
     action: str = Field("raise", pattern="^(raise|stop)$")
+    # spike guard: z-score threshold on loss/grad_norm vs their EMA
+    # mean/std — trips on UPWARD excursions only (a sharp loss improvement
+    # scores negative and never aborts); None (default) disables spike
+    # detection entirely. 6-8 is a sane band for pretraining loss curves
+    # (log-step cadence smooths the per-step noise the threshold sees)
+    spike_zscore: float | None = Field(None, gt=0)
+    # log-steps of EMA warmup before the z-score arms — early-training
+    # descent is steep and would false-positive against a cold EMA
+    spike_warmup_steps: int = Field(20, ge=2)
+    spike_ema_beta: float = Field(0.98, gt=0, lt=1)
+    # consecutive spiking log-steps tolerated before acting
+    spike_patience: int = Field(0, ge=0)
+    # write anomaly-<step>.json into the run dir on abort (skipped when the
+    # run has no artifact directory — no logger run_dir / checkpoint dir)
+    dump_anomalies: bool = True
 
 
 class NonFiniteLossError(RuntimeError):
+    pass
+
+
+class LossSpikeError(RuntimeError):
     pass
 
 
@@ -38,25 +70,50 @@ class NanGuard:
     def __init__(self, config: NanGuardConfig | None = None):
         self.config = config or NanGuardConfig()
         self.non_finite_steps = 0  # total, the skipped-steps metric analogue
+        self.spike_steps = 0
         self._streak = 0
+        self._spike_streak = 0
+        self._detectors: dict[str, _anomaly.EmaZScore] = {}
+        if self.config.spike_zscore:
+            self._detectors = {
+                name: _anomaly.EmaZScore(
+                    beta=self.config.spike_ema_beta,
+                    warmup=self.config.spike_warmup_steps,
+                )
+                for name in ("loss", "grad_norm")
+            }
 
     def on_step_end(self, trainer, step, metrics) -> None:
         loss = float(metrics.get("loss", 0.0))
         grad_norm = float(metrics.get("grad_norm", 0.0))
         if math.isfinite(loss) and math.isfinite(grad_norm):
             self._streak = 0
+            self._check_spikes(
+                trainer, step, {"loss": loss, "grad_norm": grad_norm}, metrics
+            )
             return
         self.non_finite_steps += 1
         self._streak += 1
+        self._count(trainer, "nan_guard/non_finite_steps")
+        offending = _anomaly.offending_layers(getattr(trainer, "last_health", None))
         logger.warning(
-            "non-finite training signal at step %d (loss=%s grad_norm=%s), streak %d",
+            "non-finite training signal at step %d (loss=%s grad_norm=%s), "
+            "streak %d%s",
             step, loss, grad_norm, self._streak,
+            f"; non-finite grad layers: {', '.join(offending)}" if offending else "",
         )
         if self._streak > self.config.patience:
+            dump = self._dump(trainer, step, "non_finite", metrics, offending)
             message = (
                 f"training diverged: non-finite loss/grad_norm for "
                 f"{self._streak} consecutive log steps (step {step})"
             )
+            if offending:
+                message += (
+                    "; first non-finite gradient layer(s): " + ", ".join(offending)
+                )
+            if dump is not None:
+                message += f" [anomaly dump: {dump}]"
             if self.config.action == "raise":
                 raise NonFiniteLossError(message)
             logger.error("%s — stopping", message)
@@ -64,3 +121,78 @@ class NanGuard:
             # the diverged state must not become the newest checkpoint: a
             # resume would restart from NaN weights
             trainer.abort_final_save = True
+
+    # ------------------------------------------------------------ spikes
+
+    def _check_spikes(self, trainer, step, values, metrics) -> None:
+        if not self._detectors:
+            return
+        spiking: list[tuple[str, float]] = []
+        for name, detector in self._detectors.items():
+            z = detector.score(values[name])
+            if z is not None and z > self.config.spike_zscore:
+                # the excursion is NOT folded into the EMA — the tracker
+                # models the healthy process, so a sustained spike keeps
+                # scoring against the pre-spike statistics
+                spiking.append((name, z))
+            else:
+                detector.update(values[name])
+        if not spiking:
+            self._spike_streak = 0
+            return
+        self.spike_steps += 1
+        self._spike_streak += 1
+        self._count(trainer, "nan_guard/spike_steps")
+        described = ", ".join(f"{name} z={z:.1f}" for name, z in spiking)
+        suspects = _anomaly.top_layers(getattr(trainer, "last_health", None))
+        logger.warning(
+            "loss-spike signal at step %d (%s), streak %d%s",
+            step, described, self._spike_streak,
+            f"; fastest-moving layers: {', '.join(suspects)}" if suspects else "",
+        )
+        if self._spike_streak > self.config.spike_patience:
+            dump = self._dump(
+                trainer, step, "spike", metrics, suspects,
+                extra={"zscores": {name: z for name, z in spiking}},
+            )
+            message = (
+                f"training spiked: {described} exceeded spike_zscore="
+                f"{self.config.spike_zscore} for {self._spike_streak} "
+                f"consecutive log steps (step {step})"
+            )
+            if suspects:
+                message += "; fastest-moving layer(s): " + ", ".join(suspects)
+            if dump is not None:
+                message += f" [anomaly dump: {dump}]"
+            if self.config.action == "raise":
+                raise LossSpikeError(message)
+            logger.error("%s — stopping", message)
+            trainer.should_stop = True
+            # unlike the NaN path, the weights are still finite — the final
+            # checkpoint stays useful for post-mortem / rewind, so the save
+            # is NOT aborted
+
+    # ------------------------------------------------------------ plumbing
+
+    @staticmethod
+    def _count(trainer, name: str) -> None:
+        registry = getattr(trainer, "telemetry", None)
+        if registry is not None:
+            registry.counter(name).inc()
+
+    def _dump(self, trainer, step, reason, metrics, offending, extra=None):
+        if not self.config.dump_anomalies:
+            return None
+        run_dir = _anomaly.resolve_run_dir(trainer)
+        if run_dir is None:
+            logger.info(
+                "no run directory (logger/checkpointer) — skipping the "
+                "anomaly dump for step %d", step,
+            )
+            return None
+        return _anomaly.dump_anomaly(
+            run_dir, step, reason, metrics,
+            offending=offending,
+            health=getattr(trainer, "last_health", None),
+            extra=extra,
+        )

@@ -103,9 +103,16 @@ class CLM:
         batch: dict[str, jnp.ndarray],
         rng: jax.Array | None = None,
         train: bool = True,
+        with_health: bool = False,
     ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
         """batch: input_ids [B,S]; optional labels (pre-shift), segment_ids,
-        position_ids. Returns (mean loss fp32, metrics dict)."""
+        position_ids. Returns (mean loss fp32, metrics dict).
+
+        `with_health=True` (the trainer's health-step variant,
+        docs/observability.md) additionally derives per-MoE-layer router
+        health metrics (`health/moe/*`) from the model's `router_stats`;
+        the default False path is trace-identical to before the flag
+        existed."""
         cfg = self.config
         model = self.model
         input_ids = batch["input_ids"]
@@ -186,4 +193,12 @@ class CLM:
             # capacity buffer this step (0 when ep=1 / routing fits): the
             # drop-rate signal for tuning ep_capacity_factor
             metrics["ep_dropped_rows"] = out.ep_dropped_rows
+        if with_health and out.router_stats is not None:
+            from llm_training_tpu.telemetry.health import moe_router_health
+
+            metrics.update(
+                moe_router_health(
+                    out.router_stats, n_tokens=labels.shape[0] * labels.shape[1]
+                )
+            )
         return loss, metrics

@@ -10,7 +10,7 @@ families. Here, models are flax.linen Modules whose parameters carry
 """
 
 from llm_training_tpu.models.bamba import Bamba, BambaConfig
-from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput
+from llm_training_tpu.models.base import BaseModelConfig, CausalLMOutput, RouterStats
 from llm_training_tpu.models.deepseek import Deepseek, DeepseekConfig
 from llm_training_tpu.models.ernie45_moe import Ernie45Moe, Ernie45MoeConfig
 from llm_training_tpu.models.gemma import Gemma, GemmaConfig
@@ -28,6 +28,7 @@ __all__ = [
     "BambaConfig",
     "BaseModelConfig",
     "CausalLMOutput",
+    "RouterStats",
     "Deepseek",
     "DeepseekConfig",
     "Ernie45Moe",

@@ -75,6 +75,30 @@ class BaseModelConfig(BaseModel):
 
 
 @flax.struct.dataclass
+class RouterStats:
+    """Per-MoE-layer router statistics, threaded out of every MoE family
+    for the model-health layer (`telemetry/health.py:moe_router_health`).
+
+    `sel_frac [L, E]`: fraction of (token, slot) assignments routed to each
+    expert per MoE layer (rows sum to ~top_k — each of the K selections per
+    token counts, HF `load_balancing_loss_func` scale). `mean_prob [L, E]`:
+    mean fp32 routing probability per expert (sigmoid-routed families —
+    DeepSeek-V3 — normalize scores per token first so entropy stays
+    meaningful). `dropped`: scalar total of (token, expert) assignments
+    lost to capacity buffers across layers. `layer_ids` is STATIC (not a
+    pytree leaf): the absolute decoder-layer index of each row, so metric
+    keys name real layers even when only a suffix of the stack is MoE
+    (DeepSeek's dense prefix). The arrays already exist pre-pooling in
+    every family's aux-loss computation, so populating this costs nothing
+    when unused — XLA dead-code-eliminates the extra outputs."""
+
+    sel_frac: jnp.ndarray
+    mean_prob: jnp.ndarray
+    dropped: jnp.ndarray
+    layer_ids: tuple[int, ...] = flax.struct.field(pytree_node=False, default=())
+
+
+@flax.struct.dataclass
 class CausalLMOutput:
     """Forward output (reference `modeling_outputs.py:11-13`).
 
@@ -84,9 +108,12 @@ class CausalLMOutput:
     `ep_dropped_rows` counts (token, expert) assignments lost to the
     expert-parallel capacity buffer this step, summed over layers (None for
     dense models; exactly 0 when ep=1 or routing fits the buffer) — the
-    observability VERDICT r4 asked for on the static-capacity EP path."""
+    observability VERDICT r4 asked for on the static-capacity EP path.
+    `router_stats` carries the pre-pooled per-layer router statistics
+    (None for dense models) for the health-metric layer."""
 
     logits: jnp.ndarray | None = None
     last_hidden_states: jnp.ndarray | None = None
     aux_loss: jnp.ndarray | None = None
     ep_dropped_rows: jnp.ndarray | None = None
+    router_stats: RouterStats | None = None

@@ -28,7 +28,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.deepseek.config import DeepseekConfig
 from llm_training_tpu.models.llama.model import RMSNorm, _dense
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
@@ -114,12 +114,16 @@ class DeepseekMLP(nn.Module):
 
 
 class DeepseekMoE(nn.Module):
-    """Router + dropless grouped experts + always-on shared experts."""
+    """Router + dropless grouped experts + always-on shared experts.
+
+    Returns (out, (sel_frac [E], mean_prob [E], dropped scalar)) — the
+    router health triple (`models.moe.router_block_stats` semantics;
+    `pad_mask` excludes padding tokens like MoEMLP)."""
 
     config: DeepseekConfig
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, pad_mask=None):
         cfg = self.config
         num_experts = cfg.n_routed_experts
         top_k = cfg.num_experts_per_tok
@@ -225,14 +229,30 @@ class DeepseekMoE(nn.Module):
             cfg, cfg.moe_intermediate_size * cfg.n_shared_experts,
             name="shared_experts",
         )(hidden)
-        return out + shared, dropped
+        # router health stats (telemetry/health.py) — sigmoid scores (v3)
+        # normalize per token first so the entropy stays a distribution
+        # statistic. DCE'd when unused.
+        if cfg.version == 3:
+            norm_scores = scores / jnp.maximum(
+                scores.sum(axis=-1, keepdims=True), 1e-9
+            )
+        else:
+            norm_scores = scores
+        from llm_training_tpu.models.moe import router_block_stats
+
+        sel_frac, mean_prob = router_block_stats(
+            topk_idx, norm_scores, num_experts, pad_mask
+        )
+        return out + shared, (sel_frac, mean_prob, dropped)
 
 
 class DeepseekDecoderLayer(nn.Module):
     """Pre-norm block (HF DeepseekV2/V3DecoderLayer). Returns
-    (hidden, ep_dropped_rows) — DeepSeek computes no aux loss (the noaux
-    bias balances instead), so the layer ys channel carries only the EP
-    capacity-drop counter (0 on dense layers)."""
+    (hidden, stats) — DeepSeek computes no aux loss (the noaux bias
+    balances instead), so the layer ys channel carries the router health
+    triple (sel_frac [E], mean_prob [E], dropped scalar) on MoE layers and
+    None on dense layers (`is_moe` is static, so the structures are
+    trace-time constants)."""
 
     config: DeepseekConfig
     is_moe: bool
@@ -247,11 +267,12 @@ class DeepseekDecoderLayer(nn.Module):
         hidden = hidden + MLAttention(cfg, name="self_attn")(normed, segment_ids, cos, sin)
         normed = norm("post_attention_layernorm")(hidden)
         if self.is_moe:
-            mlp_out, dropped = DeepseekMoE(cfg, name="mlp")(normed)
+            pad_mask = None if segment_ids is None else segment_ids > 0
+            mlp_out, stats = DeepseekMoE(cfg, name="mlp")(normed, pad_mask)
         else:
             mlp_out = DeepseekMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
-            dropped = jnp.float32(0.0)
-        return hidden + mlp_out, dropped
+            stats = None
+        return hidden + mlp_out, stats
 
 
 class _MoEScanBody(nn.Module):
@@ -264,10 +285,10 @@ class _MoEScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden, dropped = DeepseekDecoderLayer(self.config, True, name="layer")(
+        hidden, stats = DeepseekDecoderLayer(self.config, True, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, dropped
+        return hidden, stats
 
 
 class Deepseek(nn.Module):
@@ -317,14 +338,19 @@ class Deepseek(nn.Module):
         policy = _remat_policy(cfg)
         n_scanned = cfg.num_scanned_layers
         ep_dropped = jnp.float32(0.0)
+        moe_sel, moe_prob, moe_ids = [], [], []
         for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = DeepseekDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(DeepseekDecoderLayer, policy=policy)
-            hidden, dropped = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+            hidden, stats = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
-            ep_dropped = ep_dropped + dropped
+            if stats is not None:
+                moe_sel.append(stats[0])
+                moe_prob.append(stats[1])
+                moe_ids.append(i)
+                ep_dropped = ep_dropped + stats[2]
         if n_scanned:
             body = _MoEScanBody
             if policy is not None:
@@ -337,11 +363,31 @@ class Deepseek(nn.Module):
                 length=n_scanned,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="moe_layers")
-            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            hidden, (sel, prob, dropped) = scanned(hidden, segment_ids, cos, sin)
             ep_dropped = ep_dropped + dropped.sum()
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        # assemble per-MoE-layer router stats in layer order (dense prefix
+        # layers carry none); DeepSeek optimizes no aux loss, but the health
+        # layer still wants the balance signal per layer
+        sel_parts = [jnp.stack(moe_sel)] if moe_sel else []
+        prob_parts = [jnp.stack(moe_prob)] if moe_prob else []
+        if n_scanned:
+            sel_parts.append(sel)
+            prob_parts.append(prob)
+            moe_ids.extend(
+                range(cfg.num_hidden_layers - n_scanned, cfg.num_hidden_layers)
+            )
+        router_stats = None
+        if sel_parts:
+            router_stats = RouterStats(
+                sel_frac=jnp.concatenate(sel_parts),
+                mean_prob=jnp.concatenate(prob_parts),
+                dropped=ep_dropped,
+                layer_ids=tuple(moe_ids),
+            )
 
         logits = None
         if compute_logits:
@@ -355,6 +401,7 @@ class Deepseek(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             ep_dropped_rows=ep_dropped,
+            router_stats=router_stats,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -17,10 +17,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.ernie45_moe.config import Ernie45MoeConfig
 from llm_training_tpu.models.llama.model import RMSNorm, _dense
-from llm_training_tpu.models.moe import dropless_moe_apply
+from llm_training_tpu.models.moe import dropless_moe_apply, router_block_stats
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.ops import apply_rope, dot_product_attention
 from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
@@ -71,12 +71,14 @@ class Ernie45MoeMLP(nn.Module):
 
 
 class Ernie45MoeBlock(nn.Module):
-    """Softmax router with aux-free selection bias + dropless experts."""
+    """Softmax router with aux-free selection bias + dropless experts.
+    Returns (out, (sel_frac, mean_prob, dropped)) — the router health
+    triple; `pad_mask` excludes padding tokens like MoEMLP."""
 
     config: Ernie45MoeConfig
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, pad_mask=None):
         cfg = self.config
         num_experts = cfg.moe_num_experts
         inter = cfg.moe_intermediate_size
@@ -178,7 +180,11 @@ class Ernie45MoeBlock(nn.Module):
                 cfg, cfg.moe_intermediate_size * cfg.moe_num_shared_experts,
                 name="shared_experts",
             )(hidden)
-        return out, dropped
+        # router health stats (telemetry/health.py). DCE'd when unused.
+        sel_frac, mean_prob = router_block_stats(
+            topk_idx, probs, num_experts, pad_mask
+        )
+        return out, (sel_frac, mean_prob, dropped)
 
 
 class Ernie45MoeDecoderLayer(nn.Module):
@@ -196,11 +202,12 @@ class Ernie45MoeDecoderLayer(nn.Module):
         )
         normed = norm("post_attention_layernorm")(hidden)
         if self.is_moe:
-            mlp_out, dropped = Ernie45MoeBlock(cfg, name="mlp")(normed)
+            pad_mask = None if segment_ids is None else segment_ids > 0
+            mlp_out, stats = Ernie45MoeBlock(cfg, name="mlp")(normed, pad_mask)
         else:
             mlp_out = Ernie45MoeMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
-            dropped = jnp.float32(0.0)
-        return hidden + mlp_out, dropped
+            stats = None
+        return hidden + mlp_out, stats
 
 
 class _MoEScanBody(nn.Module):
@@ -210,10 +217,10 @@ class _MoEScanBody(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden, dropped = Ernie45MoeDecoderLayer(self.config, True, name="layer")(
+        hidden, stats = Ernie45MoeDecoderLayer(self.config, True, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, dropped
+        return hidden, stats
 
 
 class Ernie45Moe(nn.Module):
@@ -263,14 +270,19 @@ class Ernie45Moe(nn.Module):
         policy = _remat_policy(cfg)
         n_scanned = cfg.num_scanned_layers
         ep_dropped = jnp.float32(0.0)
+        moe_sel, moe_prob, moe_ids = [], [], []
         for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = Ernie45MoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(Ernie45MoeDecoderLayer, policy=policy)
-            hidden, dropped = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
+            hidden, stats = layer_cls(cfg, cfg.layer_is_moe(i), name=f"layers_{i}")(
                 hidden, segment_ids, cos, sin
             )
-            ep_dropped = ep_dropped + dropped
+            if stats is not None:
+                moe_sel.append(stats[0])
+                moe_prob.append(stats[1])
+                moe_ids.append(i)
+                ep_dropped = ep_dropped + stats[2]
         if n_scanned:
             body = _MoEScanBody
             if policy is not None:
@@ -283,11 +295,30 @@ class Ernie45Moe(nn.Module):
                 length=n_scanned,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="moe_layers")
-            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            hidden, (sel, prob, dropped) = scanned(hidden, segment_ids, cos, sin)
             ep_dropped = ep_dropped + dropped.sum()
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        # per-MoE-layer router stats in layer order for the health layer
+        # (Ernie balances via the aux-free bias — observed, not optimized)
+        sel_parts = [jnp.stack(moe_sel)] if moe_sel else []
+        prob_parts = [jnp.stack(moe_prob)] if moe_prob else []
+        if n_scanned:
+            sel_parts.append(sel)
+            prob_parts.append(prob)
+            moe_ids.extend(
+                range(cfg.num_hidden_layers - n_scanned, cfg.num_hidden_layers)
+            )
+        router_stats = None
+        if sel_parts:
+            router_stats = RouterStats(
+                sel_frac=jnp.concatenate(sel_parts),
+                mean_prob=jnp.concatenate(prob_parts),
+                dropped=ep_dropped,
+                layer_ids=tuple(moe_ids),
+            )
 
         head_bias = None
         if cfg.use_bias:
@@ -312,6 +343,7 @@ class Ernie45Moe(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             ep_dropped_rows=ep_dropped,
+            router_stats=router_stats,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -13,7 +13,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.deepseek.model import DeepseekMLP, DeepseekMoE
 from llm_training_tpu.models.glm4_moe.config import Glm4MoeConfig
 from llm_training_tpu.models.llama.model import RMSNorm, _dense
@@ -75,17 +75,18 @@ class Glm4MoeDecoderLayer(nn.Module):
         )(normed, segment_ids, cos, sin)
         normed = norm("post_attention_layernorm")(hidden)
         if self.is_moe:
-            mlp_out, dropped = DeepseekMoE(cfg, name="mlp")(normed)
+            pad_mask = None if segment_ids is None else segment_ids > 0
+            mlp_out, stats = DeepseekMoE(cfg, name="mlp")(normed, pad_mask)
         else:
             mlp_out = DeepseekMLP(cfg, cfg.intermediate_size, name="mlp")(normed)
-            dropped = jnp.float32(0.0)
-        return hidden + mlp_out, dropped
+            stats = None
+        return hidden + mlp_out, stats
 
 
 class _MoEScanBody(nn.Module):
     """Scan body: one MoE layer (the uniform suffix after the dense prefix —
     GLM-4.5 is 92 layers deep, so scanning is what keeps compile time flat).
-    ys carries the EP capacity-drop counter."""
+    ys carries the router health triple (sel_frac, mean_prob, dropped)."""
 
     config: Glm4MoeConfig
 
@@ -94,11 +95,11 @@ class _MoEScanBody(nn.Module):
         cfg = self.config
         # the scanned suffix is uniform by construction (num_scanned_layers
         # returns 0 for mixed sliding/full suffixes), so one window applies
-        hidden, dropped = Glm4MoeDecoderLayer(
+        hidden, stats = Glm4MoeDecoderLayer(
             cfg, True, cfg.layer_sliding_window(cfg.num_hidden_layers - 1),
             name="layer",
         )(hidden, segment_ids, cos, sin)
-        return hidden, dropped
+        return hidden, stats
 
 
 class Glm4Moe(nn.Module):
@@ -144,15 +145,20 @@ class Glm4Moe(nn.Module):
         policy = _remat_policy(cfg)
         n_scanned = cfg.num_scanned_layers
         ep_dropped = jnp.float32(0.0)
+        moe_sel, moe_prob, moe_ids = [], [], []
         for i in range(cfg.num_hidden_layers - n_scanned):
             layer_cls = Glm4MoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(Glm4MoeDecoderLayer, policy=policy)
-            hidden, dropped = layer_cls(
+            hidden, stats = layer_cls(
                 cfg, cfg.layer_is_moe(i), cfg.layer_sliding_window(i),
                 name=f"layers_{i}",
             )(hidden, segment_ids, cos, sin)
-            ep_dropped = ep_dropped + dropped
+            if stats is not None:
+                moe_sel.append(stats[0])
+                moe_prob.append(stats[1])
+                moe_ids.append(i)
+                ep_dropped = ep_dropped + stats[2]
         if n_scanned:
             body = _MoEScanBody
             if policy is not None:
@@ -165,11 +171,31 @@ class Glm4Moe(nn.Module):
                 length=n_scanned,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="moe_layers")
-            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
+            hidden, (sel, prob, dropped) = scanned(hidden, segment_ids, cos, sin)
             ep_dropped = ep_dropped + dropped.sum()
 
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
+
+        # per-MoE-layer router stats in layer order (dense prefix carries
+        # none) for the health layer; GLM-4.5 balances via the noaux bias,
+        # so no aux loss is optimized — only observed
+        sel_parts = [jnp.stack(moe_sel)] if moe_sel else []
+        prob_parts = [jnp.stack(moe_prob)] if moe_prob else []
+        if n_scanned:
+            sel_parts.append(sel)
+            prob_parts.append(prob)
+            moe_ids.extend(
+                range(cfg.num_hidden_layers - n_scanned, cfg.num_hidden_layers)
+            )
+        router_stats = None
+        if sel_parts:
+            router_stats = RouterStats(
+                sel_frac=jnp.concatenate(sel_parts),
+                mean_prob=jnp.concatenate(prob_parts),
+                dropped=ep_dropped,
+                layer_ids=tuple(moe_ids),
+            )
 
         logits = None
         if compute_logits:
@@ -183,6 +209,7 @@ class Glm4Moe(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             ep_dropped_rows=ep_dropped,
+            router_stats=router_stats,
         )
 
     def get_input_embeddings_path(self) -> str:

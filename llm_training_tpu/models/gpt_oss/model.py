@@ -24,7 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.gpt_oss.config import GptOssConfig
 from llm_training_tpu.models.llama.model import RMSNorm, _dense
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
@@ -321,6 +321,7 @@ class GptOss(nn.Module):
         aux_loss = cfg.num_local_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )
+        ep_dropped = dropped.sum()
 
         logits = None
         if compute_logits:
@@ -331,7 +332,13 @@ class GptOss(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             aux_loss=aux_loss,
-            ep_dropped_rows=dropped.sum(),
+            ep_dropped_rows=ep_dropped,
+            router_stats=RouterStats(
+                sel_frac=sel_frac,
+                mean_prob=mean_prob,
+                dropped=ep_dropped,
+                layer_ids=tuple(range(cfg.num_hidden_layers)),
+            ),
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -14,10 +14,10 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.hunyuan_moe.config import HunYuanMoeConfig
 from llm_training_tpu.models.llama.model import RMSNorm, _dense
-from llm_training_tpu.models.moe import dropless_moe_apply
+from llm_training_tpu.models.moe import dropless_moe_apply, router_block_stats
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.ops import apply_rope, dot_product_attention
 from llm_training_tpu.ops.rope_utils import compute_rope_cos_sin, compute_rope_frequencies
@@ -54,12 +54,14 @@ class HunYuanMoeAttention(nn.Module):
 
 
 class HunYuanMoeBlock(nn.Module):
-    """Softmax top-k router + dropless experts + gate-free shared MLP."""
+    """Softmax top-k router + dropless experts + gate-free shared MLP.
+    Returns (out, (sel_frac, mean_prob, dropped)) — the router health
+    triple; `pad_mask` excludes padding tokens like MoEMLP."""
 
     config: HunYuanMoeConfig
 
     @nn.compact
-    def __call__(self, hidden):
+    def __call__(self, hidden, pad_mask=None):
         cfg = self.config
         num_experts = cfg.num_experts
         inter = cfg.intermediate_size
@@ -127,7 +129,11 @@ class HunYuanMoeBlock(nn.Module):
         shared = _dense(cfg, cfg.hidden_size, ("mlp", "embed"), "shared_down_proj", False)(
             nn.silu(s_gate) * s_up
         )
-        return out + shared, dropped
+        # router health stats (telemetry/health.py). DCE'd when unused.
+        sel_frac, mean_prob = router_block_stats(
+            topk_idx, probs, num_experts, pad_mask
+        )
+        return out + shared, (sel_frac, mean_prob, dropped)
 
 
 class HunYuanMoeDecoderLayer(nn.Module):
@@ -143,8 +149,9 @@ class HunYuanMoeDecoderLayer(nn.Module):
             normed, segment_ids, cos, sin
         )
         normed = norm("post_attention_layernorm")(hidden)
-        mlp_out, dropped = HunYuanMoeBlock(cfg, name="mlp")(normed)
-        return hidden + mlp_out, dropped
+        pad_mask = None if segment_ids is None else segment_ids > 0
+        mlp_out, stats = HunYuanMoeBlock(cfg, name="mlp")(normed, pad_mask)
+        return hidden + mlp_out, stats
 
 
 class _ScannedLayer(nn.Module):
@@ -152,10 +159,10 @@ class _ScannedLayer(nn.Module):
 
     @nn.compact
     def __call__(self, hidden, segment_ids, cos, sin):
-        hidden, dropped = HunYuanMoeDecoderLayer(self.config, name="layer")(
+        hidden, stats = HunYuanMoeDecoderLayer(self.config, name="layer")(
             hidden, segment_ids, cos, sin
         )
-        return hidden, dropped
+        return hidden, stats
 
 
 class HunYuanMoe(nn.Module):
@@ -164,6 +171,9 @@ class HunYuanMoe(nn.Module):
     config: HunYuanMoeConfig
 
     def _layers(self, hidden, segment_ids, cos, sin):
+        """Returns (hidden, ep_dropped, (sel_frac [L, E], mean_prob [L, E]))
+        — per-layer router stats stacked in layer order for the health
+        layer."""
         cfg = self.config
         policy = _remat_policy(cfg)
         if cfg.scan_layers:
@@ -178,16 +188,21 @@ class HunYuanMoe(nn.Module):
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, name="layers")
-            hidden, dropped = scanned(hidden, segment_ids, cos, sin)
-            return hidden, dropped.sum()
+            hidden, (sel, prob, dropped) = scanned(hidden, segment_ids, cos, sin)
+            return hidden, dropped.sum(), (sel, prob)
         ep_dropped = jnp.float32(0.0)
+        stats = []
         for i in range(cfg.num_hidden_layers):
             layer_cls = HunYuanMoeDecoderLayer
             if policy is not None:
                 layer_cls = nn.remat(HunYuanMoeDecoderLayer, policy=policy)
-            hidden, dropped = layer_cls(cfg, name=f"layers_{i}")(hidden, segment_ids, cos, sin)
-            ep_dropped = ep_dropped + dropped
-        return hidden, ep_dropped
+            hidden, layer_stats = layer_cls(cfg, name=f"layers_{i}")(
+                hidden, segment_ids, cos, sin
+            )
+            stats.append(layer_stats)
+            ep_dropped = ep_dropped + layer_stats[2]
+        sel, prob, _ = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+        return hidden, ep_dropped, (sel, prob)
 
     @nn.compact
     def __call__(
@@ -224,7 +239,7 @@ class HunYuanMoe(nn.Module):
         )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
 
-        hidden, ep_dropped = self._layers(hidden, segment_ids, cos, sin)
+        hidden, ep_dropped, layer_stats = self._layers(hidden, segment_ids, cos, sin)
         hidden = RMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
@@ -240,6 +255,12 @@ class HunYuanMoe(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             ep_dropped_rows=ep_dropped,
+            router_stats=RouterStats(
+                sel_frac=layer_stats[0],
+                mean_prob=layer_stats[1],
+                dropped=ep_dropped,
+                layer_ids=tuple(range(cfg.num_hidden_layers)),
+            ),
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.llama.config import LlamaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention, rms_norm
@@ -439,12 +439,14 @@ class Llama(nn.Module):
     config: LlamaConfig
 
     def _layers(self, hidden, segment_ids, cos, sin, local_cos=None, local_sin=None):
-        """Returns (hidden, aux_loss, ep_dropped_rows). For MoE configs the
-        per-layer router stats (sel_frac, mean_prob, dropped) are pooled
-        across depth BEFORE the E * sum(f * P) product — matching HF
+        """Returns (hidden, aux_loss, ep_dropped_rows, layer_stats). For MoE
+        configs the per-layer router stats (sel_frac, mean_prob, dropped) are
+        pooled across depth BEFORE the E * sum(f * P) product — matching HF
         `load_balancing_loss_func`, which concatenates all layers' gate
         logits first, so the loss stays ~top_k when balanced regardless of
-        num_hidden_layers."""
+        num_hidden_layers. `layer_stats` is the PRE-pooled
+        (sel_frac [L, E], mean_prob [L, E]) pair for the health layer
+        (None for dense configs)."""
         cfg = self.config
         policy = _remat_policy(cfg)
         if getattr(cfg, "pipeline_stages", 1) > 1:
@@ -505,12 +507,12 @@ class Llama(nn.Module):
                 stats.append(layer_aux)
             aux = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
         if not cfg.num_experts:
-            return hidden, jnp.float32(0.0), jnp.float32(0.0)
+            return hidden, jnp.float32(0.0), jnp.float32(0.0), None
         sel_frac, mean_prob, dropped = aux  # [L, E], [L, E], [L]
         aux_loss = cfg.num_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )
-        return hidden, aux_loss, dropped.sum()
+        return hidden, aux_loss, dropped.sum(), (sel_frac, mean_prob)
 
     @nn.compact
     def __call__(
@@ -601,7 +603,7 @@ class Llama(nn.Module):
                 half = local_cos.shape[-1] // 2
                 local_cos = jnp.repeat(local_cos[..., :half], 2, axis=-1)
                 local_sin = jnp.repeat(local_sin[..., :half], 2, axis=-1)
-        hidden, aux_loss, ep_dropped = self._layers(
+        hidden, aux_loss, ep_dropped, layer_stats = self._layers(
             hidden, segment_ids, cos, sin, local_cos, local_sin
         )
         hidden = _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
@@ -630,6 +632,14 @@ class Llama(nn.Module):
                 )(hidden)
             logits = nn.with_logical_constraint(logits, ("batch", "act_seq", "act_vocab"))
 
+        router_stats = None
+        if cfg.num_experts and layer_stats is not None:
+            router_stats = RouterStats(
+                sel_frac=layer_stats[0],
+                mean_prob=layer_stats[1],
+                dropped=ep_dropped,
+                layer_ids=tuple(range(cfg.num_hidden_layers)),
+            )
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
@@ -637,6 +647,7 @@ class Llama(nn.Module):
             # router_aux_loss_coef (None for dense models)
             aux_loss=aux_loss if cfg.num_experts else None,
             ep_dropped_rows=ep_dropped if cfg.num_experts else None,
+            router_stats=router_stats,
         )
 
     def get_input_embeddings_path(self) -> str:

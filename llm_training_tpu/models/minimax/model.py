@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.llama.model import RMSNorm, _dense
 from llm_training_tpu.models.minimax.config import MiniMaxConfig
 from llm_training_tpu.models.moe import MoEMLP
@@ -292,6 +292,13 @@ class MiniMax(nn.Module):
         aux_loss = cfg.num_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )
+        ep_dropped = dropped.sum()
+        router_stats = RouterStats(
+            sel_frac=sel_frac,
+            mean_prob=mean_prob,
+            dropped=ep_dropped,
+            layer_ids=tuple(range(cfg.num_hidden_layers)),
+        )
 
         logits = None
         if compute_logits:
@@ -305,7 +312,8 @@ class MiniMax(nn.Module):
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
             aux_loss=aux_loss,
-            ep_dropped_rows=dropped.sum(),
+            ep_dropped_rows=ep_dropped,
+            router_stats=router_stats,
         )
 
     def get_input_embeddings_path(self) -> str:

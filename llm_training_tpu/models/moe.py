@@ -38,6 +38,31 @@ from jax.sharding import PartitionSpec as P
 from llm_training_tpu.parallel.mesh import EXPERT_AXIS, active_mesh
 
 
+def router_block_stats(topk_idx, probs, num_experts: int, pad_mask=None):
+    """Shared per-layer router statistics: (sel_frac [E], mean_prob [E]).
+
+    sel_frac counts each of the K selections per token (sums to ~top_k when
+    balanced — HF `load_balancing_loss_func` scale); mean_prob is the mean
+    fp32 routing probability. Padding tokens are excluded when `pad_mask`
+    (flattenable to [T] bool) is given, like HF's attention-mask weighting —
+    every MoE family routes its stats through here so the health metrics
+    (`health/moe/*`, telemetry/health.py) are comparable across families."""
+    n_tokens, top_k = topk_idx.shape
+    if pad_mask is None:
+        valid = jnp.ones((n_tokens,), jnp.float32)
+    else:
+        valid = pad_mask.reshape(-1).astype(jnp.float32)
+    n_valid = jnp.maximum(valid.sum(), 1.0)
+    sel_frac = (
+        jnp.zeros((num_experts,), jnp.float32)
+        .at[topk_idx.reshape(-1)]
+        .add(jnp.repeat(valid, top_k))
+        / n_valid
+    )
+    mean_prob = (probs.astype(jnp.float32) * valid[:, None]).sum(axis=0) / n_valid
+    return sel_frac, mean_prob
+
+
 def _ep_group_size() -> int:
     """Size of the expert-parallel axis on the active mesh (1 = no EP)."""
     mesh = active_mesh()
@@ -448,23 +473,14 @@ class MoEMLP(nn.Module):
             out = out + shared
 
         # ---- router statistics for the load-balancing loss (fp32),
-        # excluding padding tokens
-        if pad_mask is None:
-            valid = jnp.ones((n_tokens,), jnp.float32)
-        else:
-            valid = pad_mask.reshape(-1).astype(jnp.float32)
-        n_valid = jnp.maximum(valid.sum(), 1.0)
-        # NOT divided by top_k: HF's load_balancing_loss_func counts each of
-        # the K selections per token (its balanced loss value is top_k, not
-        # 1.0), and router_aux_loss_coef is imported verbatim from HF
-        # configs, so the fraction must carry the same scale
-        sel_frac = (
-            jnp.zeros((num_experts,), jnp.float32)
-            .at[topk_idx.reshape(-1)]
-            .add(jnp.repeat(valid, top_k))
-            / n_valid
+        # excluding padding tokens. NOT divided by top_k: HF's
+        # load_balancing_loss_func counts each of the K selections per token
+        # (its balanced loss value is top_k, not 1.0), and
+        # router_aux_loss_coef is imported verbatim from HF configs, so the
+        # fraction must carry the same scale
+        sel_frac, mean_prob = router_block_stats(
+            topk_idx, probs, num_experts, pad_mask
         )
-        mean_prob = (probs * valid[:, None]).sum(axis=0) / n_valid
 
         return (
             out.reshape(batch, seq, embed).astype(hidden.dtype),

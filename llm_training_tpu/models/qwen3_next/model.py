@@ -35,7 +35,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, RouterStats
 from llm_training_tpu.models.moe import MoEMLP
 from llm_training_tpu.models.qwen3_next.config import Qwen3NextConfig
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
@@ -485,13 +485,19 @@ class Qwen3Next(nn.Module):
         )(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
-        aux_loss = ep_dropped = None
+        aux_loss = ep_dropped = router_stats = None
         if cfg.num_experts:
             sel_frac, mean_prob, dropped = pooled
             aux_loss = cfg.num_experts * jnp.sum(
                 sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
             )
             ep_dropped = dropped.sum()
+            router_stats = RouterStats(
+                sel_frac=sel_frac,
+                mean_prob=mean_prob,
+                dropped=ep_dropped,
+                layer_ids=tuple(range(cfg.num_hidden_layers)),
+            )
 
         logits = None
         if compute_logits:
@@ -506,6 +512,7 @@ class Qwen3Next(nn.Module):
             last_hidden_states=hidden if return_last_hidden_states else None,
             aux_loss=aux_loss,
             ep_dropped_rows=ep_dropped,
+            router_stats=router_stats,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -2,12 +2,27 @@
 
 One registry + one goodput ledger per fit (owned by the Trainer), device
 gauges sampled on log steps, `jax.profiler` annotations naming the same
-phases, and a `report` CLI that renders the persisted artifacts. See
-docs/observability.md for the schema and phase definitions.
+phases, a model-health layer (per-layer grad/update norms, MoE router
+health, host-side spike detection + anomaly dumps), and a `report` CLI that
+renders the persisted artifacts. See docs/observability.md for the schema
+and phase definitions.
 """
 
+from llm_training_tpu.telemetry.anomaly import (
+    EmaZScore,
+    dump_anomaly,
+    offending_layers,
+    resolve_run_dir,
+    top_layers,
+)
 from llm_training_tpu.telemetry.device import compiled_cost_gauges, hbm_gauges
 from llm_training_tpu.telemetry.goodput import PHASES, GoodputLedger
+from llm_training_tpu.telemetry.health import (
+    HealthConfig,
+    build_param_groups,
+    layer_health_metrics,
+    moe_router_health,
+)
 from llm_training_tpu.telemetry.registry import (
     TelemetryRegistry,
     get_registry,
@@ -16,10 +31,19 @@ from llm_training_tpu.telemetry.registry import (
 
 __all__ = [
     "PHASES",
+    "EmaZScore",
     "GoodputLedger",
+    "HealthConfig",
     "TelemetryRegistry",
+    "build_param_groups",
     "compiled_cost_gauges",
+    "dump_anomaly",
     "get_registry",
     "hbm_gauges",
+    "layer_health_metrics",
+    "moe_router_health",
+    "offending_layers",
+    "resolve_run_dir",
     "set_registry",
+    "top_layers",
 ]

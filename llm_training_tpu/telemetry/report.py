@@ -77,6 +77,65 @@ def _goodput_table(telemetry: dict) -> list[str]:
     return lines
 
 
+def _health_section(telemetry: dict) -> list[str]:
+    """Model-health summary from the `health/*` + `nan_guard/*` gauges
+    (docs/observability.md): guard counters, the worst layer group by grad
+    norm and update ratio, and the MoE balance extremes. Rendered only when
+    the run recorded health telemetry (health.every_n_steps set)."""
+    numeric: dict[str, float] = {}
+    for key, value in telemetry.items():
+        if not (key.startswith("health/") or key.startswith("nan_guard/")):
+            continue
+        try:
+            numeric[key] = float(value)
+        except (TypeError, ValueError):
+            continue
+    if not numeric:
+        return []
+
+    def by_prefix(prefix: str) -> dict[str, float]:
+        return {
+            key[len(prefix):]: value
+            for key, value in numeric.items()
+            if key.startswith(prefix)
+        }
+
+    lines = ["", "== Health =="]
+    non_finite = numeric.get("nan_guard/non_finite_steps")
+    spikes = numeric.get("nan_guard/spike_steps")
+    if non_finite is not None or spikes is not None:
+        lines.append(
+            f"nan_guard: non_finite_steps {int(non_finite or 0)}  "
+            f"spike_steps {int(spikes or 0)}"
+        )
+    grad = by_prefix("health/grad_norm/")
+    if grad:
+        worst = max(grad, key=grad.get)
+        lines.append(
+            f"layer groups: {len(grad)}  "
+            f"grad_norm max: {grad[worst]:.3g} ({worst})"
+        )
+    ratio = by_prefix("health/update_ratio/")
+    if ratio:
+        worst = max(ratio, key=ratio.get)
+        lines.append(f"update_ratio max: {ratio[worst]:.3g} ({worst})")
+    entropy = by_prefix("health/moe/router_entropy/")
+    if entropy:
+        coldest = min(entropy, key=entropy.get)
+        line = f"moe: router_entropy min {entropy[coldest]:.3f} ({coldest})"
+        share = by_prefix("health/moe/max_expert_share/")
+        if share:
+            hottest = max(share, key=share.get)
+            line += f"  max_expert_share {share[hottest]:.3f} ({hottest})"
+        lines.append(line)
+        if "health/moe/dropped_rows" in numeric:
+            lines.append(
+                f"moe dropped: {numeric['health/moe/dropped_rows']:.0f} rows "
+                f"({100.0 * numeric.get('health/moe/dropped_frac', 0.0):.3f}%)"
+            )
+    return lines
+
+
 def render_report(run_dir: str | Path) -> str:
     run_dir = Path(run_dir)
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
@@ -166,6 +225,8 @@ def render_report(run_dir: str | Path) -> str:
                 f" ({100.0 * float(hbm_peak) / float(hbm_limit):.0f}%)"
             )
         lines.append(peak_line)
+
+    lines.extend(_health_section(telemetry))
     return "\n".join(lines)
 
 

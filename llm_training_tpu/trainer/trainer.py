@@ -37,9 +37,12 @@ from llm_training_tpu.parallel.sharding import (
 )
 from llm_training_tpu.telemetry import (
     GoodputLedger,
+    HealthConfig,
     TelemetryRegistry,
+    build_param_groups,
     compiled_cost_gauges,
     hbm_gauges,
+    layer_health_metrics,
     set_registry,
 )
 from llm_training_tpu.trainer.state import TrainState
@@ -100,6 +103,11 @@ class TrainerConfig(BaseModel):
     # offload_state_dtype=int8; arrays whose last axis is not a multiple
     # stay fp32. 256 = 1.6% scale overhead
     offload_quant_block: int = 256
+    # model-health layer (telemetry/health.py): per-layer-group grad/param/
+    # update norms + MoE router health computed inside a jitted step VARIANT
+    # every `health.every_n_steps` optimizer steps. Default (unset) builds
+    # no variant — the compiled train step is byte-identical to health-off
+    health: HealthConfig = HealthConfig()
     mesh: MeshConfig = MeshConfig()
 
 
@@ -108,14 +116,30 @@ def _batch_shardings(batch: dict[str, np.ndarray], mesh: Mesh) -> dict[str, Name
     return {k: NamedSharding(mesh, spec) for k in batch}
 
 
-def _grads_and_metrics(objective, state: "TrainState", batch):
-    """Shared train-step preamble (both optimizer paths must stay in sync)."""
+def _grads_and_metrics(objective, state: "TrainState", batch, with_health: bool = False):
+    """Shared train-step preamble (both optimizer paths must stay in sync).
+    `with_health` asks the objective for its health extras (MoE router
+    stats) — only passed when the objective's signature supports it."""
     step_rng = jax.random.fold_in(state.rng, state.step)
 
     def loss_fn(params):
+        if with_health:
+            return objective.loss_and_metrics(
+                params, batch, rng=step_rng, train=True, with_health=True
+            )
         return objective.loss_and_metrics(params, batch, rng=step_rng, train=True)
 
     return jax.grad(loss_fn, has_aux=True)(state.params)
+
+
+def _objective_supports_health(objective) -> bool:
+    import inspect
+
+    try:
+        params = inspect.signature(objective.loss_and_metrics).parameters
+    except (TypeError, ValueError):
+        return False
+    return "with_health" in params
 
 
 class Trainer:
@@ -152,6 +176,11 @@ class Trainer:
         self.abstract_state = None
         self.last_step: int | None = None
         self.last_seq_len: int | None = None
+        # host snapshot of the newest health step's metrics (NaN/spike
+        # provenance reads this — callbacks/nan_guard.py); None until the
+        # first health step (or always, with health.every_n_steps unset)
+        self.last_health: dict[str, float] | None = None
+        self._param_groups = None
         # per-fit telemetry: a thread-safe metric registry (prefetcher and
         # checkpointer record into it) + the goodput wall-time ledger; both
         # flow into the metrics dict on log steps (docs/observability.md)
@@ -293,7 +322,19 @@ class Trainer:
         return shardings
 
     def _build_step(self, objective, tx) -> Callable:
+        return self._make_step(objective, tx, with_health=False)
+
+    def _build_health_step(self, objective, tx) -> Callable:
+        """The instrumented step variant: same update math as `_build_step`
+        plus per-layer-group health metrics (and the objective's MoE router
+        health, when it supports the `with_health` flag). Compiled
+        separately and called only on health-cadence steps, so the default
+        step stays byte-identical."""
+        return self._make_step(objective, tx, with_health=True)
+
+    def _make_step(self, objective, tx, with_health: bool) -> Callable:
         offload = self.config.offload_optimizer_state
+        objective_health = with_health and _objective_supports_health(objective)
         if offload:
             # device-resident twins of the (pinned_host) opt-state shardings:
             # the update math runs in HBM, bracketed by explicit copies
@@ -304,11 +345,14 @@ class Trainer:
             opt_host = self.state_shardings.opt_state
         if self._blocked_offload:
             return self._build_blocked_offload_step(
-                objective, tx, opt_device, opt_host
+                objective, tx, opt_device, opt_host,
+                with_health=with_health, objective_health=objective_health,
             )
 
         def train_step(state: TrainState, batch: dict[str, jnp.ndarray]):
-            grads, metrics = _grads_and_metrics(objective, state, batch)
+            grads, metrics = _grads_and_metrics(
+                objective, state, batch, objective_health
+            )
             opt_state = state.opt_state
             if offload:
                 opt_state = self._decode(
@@ -321,6 +365,12 @@ class Trainer:
                 )
             params = optax.apply_updates(state.params, updates)
             metrics["grad_norm"] = optax.global_norm(grads)
+            if with_health:
+                metrics.update(
+                    layer_health_metrics(
+                        self._param_groups, state.params, grads, updates
+                    )
+                )
             new_state = state.replace(
                 step=state.step + 1,
                 params=params,
@@ -330,7 +380,10 @@ class Trainer:
 
         return train_step
 
-    def _build_blocked_offload_step(self, objective, tx, opt_device, opt_host) -> Callable:
+    def _build_blocked_offload_step(
+        self, objective, tx, opt_device, opt_host,
+        with_health: bool = False, objective_health: bool = False,
+    ) -> Callable:
         """Per-leaf offloaded update (VERDICT r4 #5): `tx` here EXCLUDES
         grad clipping (built with grad_clip_norm=None; the global norm
         couples every leaf, so it is applied up front as a scalar re-scale
@@ -344,16 +397,23 @@ class Trainer:
         clip_norm = self._clip_norm
 
         def train_step(state: TrainState, batch: dict[str, jnp.ndarray]):
-            grads, metrics = _grads_and_metrics(objective, state, batch)
+            grads, metrics = _grads_and_metrics(
+                objective, state, batch, objective_health
+            )
             gnorm = optax.global_norm(grads)
             metrics["grad_norm"] = gnorm
+            # health reads the PRE-clip gradients (same semantics as the
+            # non-offload step): the clip rescale is global, so a single
+            # NaN leaf would smear NaN over every group and destroy the
+            # per-layer provenance this exists for
+            raw_grads = grads
             if clip_norm is not None:
                 scale = clip_norm / jnp.maximum(gnorm, clip_norm)
                 grads = jax.tree.map(lambda g: g * scale.astype(g.dtype), grads)
 
             p_leaves, p_def = jax.tree.flatten(state.params)
             g_leaves = jax.tree.flatten(grads)[0]
-            new_params, new_opt = [], []
+            new_params, new_opt, upd_leaves = [], [], []
             for p, g, o_host, sh_dev, sh_host in zip(
                 p_leaves, g_leaves, state.opt_state, opt_device, opt_host
             ):
@@ -362,7 +422,15 @@ class Trainer:
                 new_opt.append(
                     jax.tree.map(jax.device_put, self._encode(o_fp), sh_host)
                 )
+                upd_leaves.append(upd)
                 new_params.append(optax.apply_updates(p, upd))
+            if with_health:
+                metrics.update(
+                    layer_health_metrics(
+                        self._param_groups, state.params, raw_grads,
+                        jax.tree.unflatten(p_def, upd_leaves),
+                    )
+                )
             new_state = state.replace(
                 step=state.step + 1,
                 params=jax.tree.unflatten(p_def, new_params),
@@ -546,6 +614,21 @@ class Trainer:
             out_shardings=(self.state_shardings, None),
             donate_argnums=0,
         )
+        # the instrumented step variant (health.every_n_steps): same update
+        # math + per-layer health metrics; compiled separately so the plain
+        # step (and therefore every non-health step) is byte-identical to a
+        # health-off run. The grouping plan comes from the BOXED abstract
+        # tree (Partitioned names identify scan-stacked leaves).
+        health_every = cfg.health.every_n_steps
+        health_step = None
+        if health_every:
+            self._param_groups = build_param_groups(abstract_boxed.params)
+            health_step = jax.jit(
+                self._build_health_step(objective, tx),
+                in_shardings=(self.state_shardings, batch_shardings),
+                out_shardings=(self.state_shardings, None),
+                donate_argnums=0,
+            )
         eval_step = jax.jit(
             self._build_eval_step(objective),
             in_shardings=(self.state_shardings, batch_shardings),
@@ -556,11 +639,24 @@ class Trainer:
         # first step, and the Compiled object exposes XLA's cost/memory
         # analysis — the cross-check for the analytic MFU model. The jitted
         # callable stays as fallback (same avals/shardings, same semantics).
+        # With health on EVERY optimizer step (and no accumulation) the
+        # plain step would never execute — skip its compile entirely (the
+        # health variant compiles on its first call, billed to the compile
+        # phase) instead of burning a full XLA compile on dead code.
         aot_step = None
+        plain_step_used = not (
+            health_every == 1 and cfg.accumulate_grad_batches == 1
+        )
         t_compile = time.perf_counter()
         with self.ledger.measure("compile"):
             try:
-                aot_step = train_step.lower(state, sample_batch).compile()
+                if plain_step_used:
+                    aot_step = train_step.lower(state, sample_batch).compile()
+                else:
+                    logger.info(
+                        "health.every_n_steps=1: skipping the plain-step AOT "
+                        "compile (the health step variant runs every step)"
+                    )
             except Exception as e:
                 logger.info("AOT pre-compile unavailable (%s); compiling on first step", e)
         if aot_step is not None:
@@ -587,6 +683,8 @@ class Trainer:
         self.abort_final_save = False
         self.last_step = None
         self.last_metrics = None
+        self.last_health = None
+        health_compiled = False
         self.last_seq_len = (
             sample_batch["input_ids"].shape[1] if "input_ids" in sample_batch else None
         )
@@ -619,37 +717,64 @@ class Trainer:
                         else:
                             batch = next(batches)
                             counts = self._batch_counts(batch)
+                    # health cadence: the instrumented variant runs on the
+                    # optimizer steps `health.every_n_steps` selects (its jit
+                    # recompiles per shape natively; first compile bills to
+                    # the compile phase like the AOT step's)
+                    use_health = (
+                        health_step is not None
+                        and (micro + 1) % cfg.accumulate_grad_batches == 0
+                        and ((micro + 1) // cfg.accumulate_grad_batches)
+                        % health_every == 0
+                    )
                     # without the AOT pre-compile, the first invocation blocks
                     # on trace+compile — bill it to the compile phase
                     first_compiling = aot_step is None and micro == start_micro
                     phase = "compile" if first_compiling else "step_compute"
                     t_step = time.perf_counter()
-                    try:
-                        with self.ledger.measure(phase), \
-                                jax.profiler.TraceAnnotation("train_step"):
-                            state, metrics = step_fn(state, batch)
-                    except TypeError:
-                        # the AOT executable is pinned to sample_batch's
-                        # shapes; pad-to-longest collators emit variable
-                        # sequence lengths. The mismatch raises BEFORE
-                        # execution (donated buffers intact), so fall back
-                        # permanently to the jitted callable, which
-                        # recompiles per shape like it always did. The retry
-                        # (jit trace + compile) bills to the compile phase;
-                        # LATER new-shape recompiles are invisible inside
-                        # the jit call and land in step_compute — the
-                        # warning below is the flag that this is happening
-                        if step_fn is train_step:
-                            raise
-                        logger.warning(
-                            "AOT train step rejected batch shapes at micro "
-                            "step %d (variable-length batches?); falling "
-                            "back to jit recompilation", micro,
+                    if use_health:
+                        health_phase = (
+                            "compile" if not health_compiled else "step_compute"
                         )
-                        step_fn = train_step
-                        with self.ledger.measure("compile"), \
+                        with self.ledger.measure(health_phase), \
                                 jax.profiler.TraceAnnotation("train_step"):
-                            state, metrics = step_fn(state, batch)
+                            state, metrics = health_step(state, batch)
+                        if not health_compiled and aot_step is None:
+                            # no plain-step AOT ran: the health compile IS
+                            # the run's train-step compile
+                            self.telemetry.gauge("compile_time_s").set(
+                                time.perf_counter() - t_step
+                            )
+                        health_compiled = True
+                        first_compiling = False
+                    else:
+                        try:
+                            with self.ledger.measure(phase), \
+                                    jax.profiler.TraceAnnotation("train_step"):
+                                state, metrics = step_fn(state, batch)
+                        except TypeError:
+                            # the AOT executable is pinned to sample_batch's
+                            # shapes; pad-to-longest collators emit variable
+                            # sequence lengths. The mismatch raises BEFORE
+                            # execution (donated buffers intact), so fall back
+                            # permanently to the jitted callable, which
+                            # recompiles per shape like it always did. The
+                            # retry (jit trace + compile) bills to the compile
+                            # phase; LATER new-shape recompiles are invisible
+                            # inside the jit call and land in step_compute —
+                            # the warning below is the flag that this is
+                            # happening
+                            if step_fn is train_step:
+                                raise
+                            logger.warning(
+                                "AOT train step rejected batch shapes at "
+                                "micro step %d (variable-length batches?); "
+                                "falling back to jit recompilation", micro,
+                            )
+                            step_fn = train_step
+                            with self.ledger.measure("compile"), \
+                                    jax.profiler.TraceAnnotation("train_step"):
+                                state, metrics = step_fn(state, batch)
                     if first_compiling:
                         self.telemetry.gauge("compile_time_s").set(
                             time.perf_counter() - t_step
@@ -664,6 +789,23 @@ class Trainer:
                 # fresh (non-donated) device arrays; callbacks that need wall-
                 # clock accuracy can jax.block_until_ready(trainer.last_metrics)
                 self.last_metrics = metrics
+                if use_health:
+                    # pull the health metrics to host and publish them as
+                    # registry gauges: telemetry.jsonl, W&B, and `report` get
+                    # them through the registry snapshot on log steps with no
+                    # extra wiring, and NaN/spike provenance (nan_guard)
+                    # reads the stash. The blocking fetch drains the dispatch
+                    # queue, so it bills to step_compute like the log fetch —
+                    # this sync IS the overhead bench.py's
+                    # health_overhead_pct measures.
+                    health_keys = [k for k in metrics if k.startswith("health/")]
+                    with self.ledger.measure("step_compute"):
+                        host = jax.device_get({k: metrics[k] for k in health_keys})
+                    for key in health_keys:
+                        del metrics[key]
+                    self.last_health = {k: float(v) for k, v in host.items()}
+                    for key, value in self.last_health.items():
+                        self.telemetry.gauge(key).set(value)
                 for cb in self.callbacks:
                     # fires EVERY optimizer step (no metrics, no device sync);
                     # on_step_end below fires only on log steps with host metrics

@@ -1,0 +1,103 @@
+"""Forced-NaN micro-fit: the NaN-provenance commit gate.
+
+Runs a tiny MoE fit whose loss is poisoned through the EMBEDDING TABLE
+(`0 * (inf * embed.sum())` — forward NaN, and the chain rule puts NaN into
+exactly the embedding gradients while every other layer's stay finite), with
+the health layer on every step and a `NanGuard(action="raise")`. Asserts the
+whole provenance path end to end (ISSUE 2 acceptance):
+
+1. the fit dies with `NonFiniteLossError`,
+2. the error message names the offending layer path (`embed_tokens`), and
+3. an `anomaly-<step>.json` dump lands in the run dir with that layer in
+   `offending_layers`.
+
+Usage: `python scripts/force_nan_smoke.py <scratch-dir>` (exit 0 = pass).
+`scripts/precommit.sh` runs it on CPU after the report smoke.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp
+
+from llm_training_tpu.callbacks import JsonlLogger, JsonlLoggerConfig, NanGuard, NanGuardConfig, NonFiniteLossError
+from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+from llm_training_tpu.lms.clm import _get_path
+from llm_training_tpu.parallel import MeshConfig
+from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+
+class PoisonedCLM(CLM):
+    """CLM whose loss carries `0 * (inf * sum(embed_table))`: NaN forward,
+    and NaN gradients ONLY for the embedding table — the provenance walk
+    must name it and nothing else."""
+
+    def loss_and_metrics(self, params, batch, rng=None, train=True, with_health=False):
+        loss, metrics = super().loss_and_metrics(
+            params, batch, rng=rng, train=train, with_health=with_health
+        )
+        p = params["params"] if "params" in params else params
+        embed = _get_path(p, self.model.get_input_embeddings_path())
+        poison = jnp.float32(0.0) * (
+            jnp.float32(jnp.inf) * embed.astype(jnp.float32).sum()
+        )
+        loss = loss + poison
+        metrics["loss"] = loss
+        return loss, metrics
+
+
+def main(scratch: str) -> int:
+    objective = PoisonedCLM(
+        CLMConfig(
+            model=ModelProvider(
+                model_class="Llama",
+                model_kwargs=dict(
+                    vocab_size=128, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2, max_position_embeddings=64,
+                    attention_impl="xla", param_dtype="float32",
+                    compute_dtype="float32", num_experts=4,
+                    num_experts_per_tok=2, moe_intermediate_size=32,
+                ),
+            )
+        )
+    )
+    datamodule = DummyDataModule(
+        DummyDataModuleConfig(batch_size=8, max_length=32, num_samples=64, vocab_size=128)
+    )
+    logger = JsonlLogger(JsonlLoggerConfig(save_dir=scratch, name="nan-smoke"))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=3, log_every_n_steps=1, mesh=MeshConfig(),
+            health={"every_n_steps": 1},
+        ),
+        callbacks=[logger, NanGuard(NanGuardConfig(patience=0, action="raise"))],
+    )
+    try:
+        trainer.fit(objective, datamodule)
+    except NonFiniteLossError as e:
+        message = str(e)
+        if "embed_tokens" not in message:
+            print(f"FAIL: NonFiniteLossError does not name embed_tokens: {message}")
+            return 1
+        dumps = sorted(Path(logger.run_dir).glob("anomaly-*.json"))
+        if not dumps:
+            print(f"FAIL: no anomaly-*.json dump under {logger.run_dir}")
+            return 1
+        payload = json.loads(dumps[0].read_text())
+        if not any("embed_tokens" in layer for layer in payload["offending_layers"]):
+            print(f"FAIL: dump offending_layers lacks embed_tokens: {payload['offending_layers']}")
+            return 1
+        print(f"OK: {message.splitlines()[0]}")
+        print(f"OK: dump {dumps[0]} offending_layers={payload['offending_layers']}")
+        return 0
+    print("FAIL: fit completed without NonFiniteLossError")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "runs/nan-smoke"))

@@ -20,6 +20,15 @@ JAX_PLATFORMS=cpu python -m llm_training_tpu fit \
 JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
     | tee "${SMOKE_ROOT}/report_smoke.log"
 grep -q "goodput" "${SMOKE_ROOT}/report_smoke.log"
+# the smoke config sets health.every_n_steps on a tiny MoE model, so the
+# report must render the model-health section (per-layer norms + router
+# stats flowed registry -> telemetry.jsonl -> report)
+grep -q "== Health ==" "${SMOKE_ROOT}/report_smoke.log"
+
+# NaN-provenance gate: a forced non-finite micro-fit must name the offending
+# layer path in the NonFiniteLossError AND write an anomaly-<step>.json dump
+echo "== precommit: forced-NaN anomaly dump smoke =="
+JAX_PLATFORMS=cpu python scripts/force_nan_smoke.py "${SMOKE_ROOT}/nan-smoke"
 
 # note: under axon the sitecustomize registers the TPU backend at interpreter
 # start, so JAX_PLATFORMS=cpu does NOT demote this to a CPU smoke — when a

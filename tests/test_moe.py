@@ -47,6 +47,31 @@ def test_dense_and_ragged_impls_agree():
     np.testing.assert_allclose(out_d.aux_loss, out_r.aux_loss, rtol=1e-6)
 
 
+def test_router_stats_parity_dense_vs_ragged():
+    """The health-layer router stats (load fractions / dropped fraction)
+    must be impl-invariant: dense and ragged on the same params/batch agree
+    exactly on sel_frac/mean_prob, and both truly-dropless single-rank
+    paths report zero drops (guards the EP capacity-buffer accounting —
+    an impl that drifted here would corrupt the telemetry the
+    ep_capacity_factor tuning reads)."""
+    tiny = dict(TINY_MOE, num_hidden_layers=1)
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 128, (2, 16)))
+    model_d = Llama(LlamaConfig(**tiny, moe_impl="dense"))
+    model_r = Llama(LlamaConfig(**tiny, moe_impl="ragged"))
+    params = model_d.init(jax.random.key(3), ids)
+    rs_d = model_d.apply(params, ids).router_stats
+    rs_r = model_r.apply(params, ids).router_stats
+    assert rs_d.layer_ids == rs_r.layer_ids == (0,)
+    np.testing.assert_allclose(rs_d.sel_frac, rs_r.sel_frac, rtol=1e-6)
+    np.testing.assert_allclose(rs_d.mean_prob, rs_r.mean_prob, rtol=1e-6)
+    # each row sums to top_k (each of the K selections per token counts)
+    np.testing.assert_allclose(
+        np.asarray(rs_d.sel_frac.sum(axis=-1)),
+        TINY_MOE["num_experts_per_tok"], rtol=1e-6,
+    )
+    assert float(rs_d.dropped) == 0.0 and float(rs_r.dropped) == 0.0
+
+
 def test_bucketed_impl_matches_dense_at_full_capacity():
     """moe_impl='bucketed' with capacity >= every group size is exact: the
     dense-bmm bucket formulation must reproduce the dense path bit-for-tol,
