@@ -111,7 +111,19 @@ def main(argv: list[str] | None = None) -> int:
 
     resume_step = int(args.ckpt_path) if args.ckpt_path else None
     if args.command == "fit":
-        trainer.fit(objective, datamodule, resume_step=resume_step)
+        from llm_training_tpu.resilience import RESUMABLE_EXIT_CODE, PreemptionInterrupt
+
+        try:
+            trainer.fit(objective, datamodule, resume_step=resume_step)
+        except PreemptionInterrupt as e:
+            # supervisor contract (docs/resilience.md): exit 75 = the run
+            # was preempted AFTER committing a resumable checkpoint —
+            # relaunch this same command to continue; any other non-zero
+            # exit is a real failure
+            logging.getLogger(__name__).warning(
+                "%s — exiting with resumable code %d", e, RESUMABLE_EXIT_CODE
+            )
+            return RESUMABLE_EXIT_CODE
     else:
         trainer.validate_from_checkpoint(objective, datamodule, resume_step=resume_step)
     return 0

@@ -5,7 +5,14 @@ worker processes + CUDA async H2D (`pin_memory`/`prefetch_factor`,
 `base_datamodule_config.py:4-13`). The JAX analogue: a daemon thread runs
 the host-side pipeline (collation, numpy) and `jax.device_put` onto the
 batch shardings a few steps ahead, so the TPU never waits on the host
-between steps. Depth 2 is the classic double buffer."""
+between steps. Depth 2 is the classic double buffer.
+
+Resilience (docs/resilience.md): transient data-source errors (remote
+storage hiccups — OSError and friends) can be retried with backoff before
+surfacing (`retries`, default 0 = historical fail-fast), counted in the
+`data/retries` registry counter; each successful production feeds an
+optional heartbeat so the hang watchdog can tell a stalled input pipeline
+from a stalled device."""
 
 from __future__ import annotations
 
@@ -33,9 +40,24 @@ class DevicePrefetcher:
         depth: int = 2,
         host_aux_fn: Any | None = None,
         registry: Any | None = None,
+        retries: int = 0,
+        retry_backoff_s: float = 0.5,
+        heartbeat: Any | None = None,
     ):
         self._batches = iter(batches)
         self._shardings = shardings
+        # hang-watchdog hook: called (no args) after each successful
+        # production so a stalled data source is distinguishable from a
+        # stalled device in the dump
+        self._heartbeat = heartbeat
+        from llm_training_tpu.resilience import RetryPolicy
+
+        self._retry_policy = RetryPolicy(
+            max_retries=retries, backoff_base_s=retry_backoff_s
+        )
+        self._produced = 0  # production index (chaos site + retry label)
+        self._last_error: BaseException | None = None
+        self._last_pull_s = 0.0  # successful pull time of the newest batch
         # host_aux_fn runs on the HOST batch before transfer; its result is
         # yielded alongside the device batch (the trainer counts consumed
         # samples/tokens there — doing it on the device copy would force a
@@ -50,6 +72,7 @@ class DevicePrefetcher:
             registry = get_registry()
         self._produce_timer = registry.timer("data/produce")
         self._wait_timer = registry.timer("data/host_wait")
+        self._retry_counter = registry.counter("data/retries")
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._error: BaseException | None = None
         self._stop = threading.Event()
@@ -57,19 +80,61 @@ class DevicePrefetcher:
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
+    def _produce_one(self, attempt: int) -> dict:
+        """One data-source pull. The chaos hook sits BEFORE the underlying
+        `next`, so an injected fault leaves the source untouched and the
+        retry really re-pulls the same batch — a generator that raised from
+        inside cannot be resumed (its retry raises StopIteration), so real
+        transient errors are only retryable when the source itself is
+        (remote readers are). The `_last_error` bookkeeping keeps a closed-
+        by-error generator from masquerading as a clean end of stream: the
+        ORIGINAL transient error surfaces once the retries exhaust."""
+        from llm_training_tpu.resilience import chaos_point
+
+        t0 = time.perf_counter()
+        chaos_point("data", step=self._produced)
+        try:
+            batch = next(self._batches)
+        except StopIteration:
+            if attempt > 0 and self._last_error is not None:
+                raise self._last_error
+            raise
+        except Exception as e:
+            self._last_error = e
+            raise
+        self._last_error = None
+        # the successful attempt's pull time only — failed attempts and
+        # retry backoff must not skew the produce latency (they are visible
+        # as data/retries instead)
+        self._last_pull_s = time.perf_counter() - t0
+        return batch
+
     def _worker(self) -> None:
+        from llm_training_tpu.resilience import retry_call
+
         try:
             while True:
-                # time successful productions only — the end-of-stream probe
-                # must not skew the mean produce latency
-                t0 = time.perf_counter()
+                # time successful productions only — the end-of-stream probe,
+                # failed attempts, and retry backoff must not skew the mean
+                # produce latency (the pull part comes from _produce_one)
                 try:
-                    batch = next(self._batches)
+                    batch = retry_call(
+                        self._produce_one,
+                        self._retry_policy,
+                        label=f"data source (batch {self._produced})",
+                        counter=self._retry_counter,
+                    )
                 except StopIteration:
                     break
+                self._produced += 1
+                t0 = time.perf_counter()
                 aux = self._host_aux_fn(batch) if self._host_aux_fn else None
                 placed = (jax.device_put(batch, self._shardings), aux)
-                self._produce_timer.add(time.perf_counter() - t0)
+                self._produce_timer.add(
+                    self._last_pull_s + time.perf_counter() - t0
+                )
+                if self._heartbeat is not None:
+                    self._heartbeat()
                 while not self._stop.is_set():
                     try:
                         self._queue.put(placed, timeout=0.1)

@@ -1,0 +1,94 @@
+"""Resilience subsystem: survive the failures TPU pods actually have.
+
+Four layers (docs/resilience.md):
+
+- **Preemption handling** (`shutdown.py`): SIGTERM/SIGINT → emergency
+  checkpoint at the next step boundary → `PreemptionInterrupt` →
+  `RESUMABLE_EXIT_CODE` from the CLI, so a supervisor relaunches `fit` and
+  the existing `maybe_restore` path resumes exactly.
+- **Durable I/O** (`retry.py` + checkpointer/prefetcher wiring):
+  exponential-backoff retries for transient storage/data-source errors,
+  with `data/retries` / `checkpoint/retries` telemetry counters.
+- **Hang watchdog** (`watchdog.py`): a heartbeat-fed daemon that dumps all
+  thread stacks + the open goodput phase when the train loop stops making
+  progress, optionally aborting so the supervisor can relaunch.
+- **Fault injection** (`chaos.py`): config/env-driven failures at every
+  recovery site, so tests and `scripts/crash_resume_smoke.py` prove the
+  paths above end to end.
+"""
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from llm_training_tpu.resilience.chaos import (
+    Chaos,
+    ChaosConfig,
+    ChaosError,
+    chaos_point,
+    config_from_env,
+    get_chaos,
+    install_chaos,
+    uninstall_chaos,
+)
+from llm_training_tpu.resilience.retry import (
+    TRANSIENT_EXCEPTIONS,
+    RetryPolicy,
+    is_transient,
+    retry_call,
+)
+from llm_training_tpu.resilience.shutdown import (
+    RESUMABLE_EXIT_CODE,
+    GracefulShutdown,
+    PreemptionInterrupt,
+)
+from llm_training_tpu.resilience.watchdog import HangWatchdog
+
+
+class ResilienceConfig(BaseModel):
+    """Trainer-level knobs (`trainer.resilience.*` in run YAML)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # install SIGTERM/SIGINT handlers for the duration of fit (main thread
+    # only; silently unavailable elsewhere)
+    handle_signals: bool = True
+    # no-progress timeout before the watchdog dumps thread stacks;
+    # None/0 disables the watchdog. Size it well above the slowest healthy
+    # step + checkpoint save (docs/resilience.md#watchdog-tuning)
+    watchdog_timeout_s: float | None = None
+    # dump = write hang-dump and keep waiting; abort = dump then SIGABRT so
+    # a supervisor relaunches
+    watchdog_action: str = Field("dump", pattern="^(dump|abort)$")
+    # multihost only: how often (in optimizer steps) hosts enter the
+    # preemption-flag broadcast collective — 1 reacts within a step, larger
+    # values amortize the per-step host sync on pods (a signal waits at
+    # most this many steps; keep it well inside the preemption grace
+    # window). Single-process runs ignore it.
+    preemption_sync_every_n_steps: int = Field(1, ge=1)
+    # transient data-source errors retried by the prefetcher before
+    # surfacing; 0 preserves the historical fail-fast behavior
+    data_retries: int = Field(0, ge=0)
+    data_retry_backoff_s: float = Field(0.5, ge=0)
+    # fault injection (off unless a trigger is set); LLMT_CHAOS_* env vars
+    # overlay this at fit start
+    chaos: ChaosConfig = ChaosConfig()
+
+
+__all__ = [
+    "RESUMABLE_EXIT_CODE",
+    "TRANSIENT_EXCEPTIONS",
+    "Chaos",
+    "ChaosConfig",
+    "ChaosError",
+    "GracefulShutdown",
+    "HangWatchdog",
+    "PreemptionInterrupt",
+    "ResilienceConfig",
+    "RetryPolicy",
+    "chaos_point",
+    "config_from_env",
+    "get_chaos",
+    "install_chaos",
+    "is_transient",
+    "retry_call",
+    "uninstall_chaos",
+]

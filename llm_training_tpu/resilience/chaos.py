@@ -1,0 +1,177 @@
+"""Fault-injection harness: deterministic and probabilistic failures.
+
+Recovery code that is never exercised is broken code. `ChaosConfig` drives
+three injection sites — data-source pulls (`DevicePrefetcher`), checkpoint
+I/O (`Checkpointer.save`), and a simulated preemption SIGTERM (trainer step
+boundary) — either at fixed step numbers (tests, the kill-and-resume smoke)
+or with per-call probabilities (soak runs). Injected I/O faults raise
+`ChaosError`, an `OSError` subclass, so they flow through exactly the
+production retry path (`resilience.retry.TRANSIENT_EXCEPTIONS`).
+
+The active harness is a process-global installed by the trainer at fit
+start (`install_chaos`) and removed in its fit finally; call sites poll
+`chaos_point(site, step)` which is a no-op when nothing is installed —
+zero overhead and zero behavior change for normal runs. Environment
+variables (`LLMT_CHAOS_*`, see `config_from_env`) override the config so a
+supervisor or CI job can inject faults without editing YAML.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import signal
+import threading
+
+from pydantic import BaseModel, ConfigDict, Field
+
+logger = logging.getLogger(__name__)
+
+# injection sites: data-source pull / checkpoint save I/O
+SITES = ("data", "checkpoint_save")
+
+ENV_PREFIX = "LLMT_CHAOS_"
+
+
+class ChaosError(OSError):
+    """An injected transient fault (OSError so retry policies treat it as
+    they would a real storage/network error)."""
+
+
+class ChaosConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    seed: int = 0
+    # deterministic triggers: fire exactly once at these step numbers
+    # (data: prefetcher production index; checkpoint_save: optimizer step)
+    data_error_steps: tuple[int, ...] = ()
+    checkpoint_error_steps: tuple[int, ...] = ()
+    # probabilistic triggers: per-call probability in [0, 1]
+    data_error_prob: float = Field(0.0, ge=0, le=1)
+    checkpoint_error_prob: float = Field(0.0, ge=0, le=1)
+    # deliver a real SIGTERM to this process at this optimizer step —
+    # exercises the GracefulShutdown handler end to end
+    sigterm_step: int | None = None
+
+    def any_active(self) -> bool:
+        return bool(
+            self.data_error_steps
+            or self.checkpoint_error_steps
+            or self.data_error_prob
+            or self.checkpoint_error_prob
+            or self.sigterm_step is not None
+        )
+
+
+def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
+    """Overlay `LLMT_CHAOS_*` environment variables on `base`:
+    LLMT_CHAOS_DATA_ERROR_STEPS / LLMT_CHAOS_CHECKPOINT_ERROR_STEPS
+    (comma-separated ints), LLMT_CHAOS_DATA_ERROR_PROB /
+    LLMT_CHAOS_CHECKPOINT_ERROR_PROB (floats), LLMT_CHAOS_SIGTERM_STEP,
+    LLMT_CHAOS_SEED (ints)."""
+    update: dict = {}
+    for field, cast in (
+        ("data_error_steps", _int_tuple),
+        ("checkpoint_error_steps", _int_tuple),
+        ("data_error_prob", float),
+        ("checkpoint_error_prob", float),
+        ("sigterm_step", int),
+        ("seed", int),
+    ):
+        raw = os.environ.get(ENV_PREFIX + field.upper())
+        if raw is not None and raw != "":
+            update[field] = cast(raw)
+    base = base or ChaosConfig()
+    return base.model_copy(update=update) if update else base
+
+
+def _int_tuple(raw: str) -> tuple[int, ...]:
+    return tuple(int(part) for part in raw.split(",") if part.strip())
+
+
+class Chaos:
+    """Live harness: tracks which deterministic triggers already fired (each
+    fires exactly once, so a retried operation succeeds on its second
+    attempt — the recovery path, not an infinite failure loop)."""
+
+    def __init__(self, config: ChaosConfig, registry=None):
+        self.config = config
+        self._rng = random.Random(config.seed)
+        self._fired: set[tuple[str, int]] = set()
+        self._lock = threading.Lock()
+        self._registry = registry
+
+    def _count(self) -> None:
+        registry = self._registry
+        if registry is None:
+            from llm_training_tpu.telemetry import get_registry
+
+            registry = get_registry()
+        registry.counter("resilience/chaos_injections").inc()
+
+    def maybe_raise(self, site: str, step: int | None = None) -> None:
+        """Raise ChaosError if a trigger for `site` fires at `step`."""
+        if site not in SITES:
+            raise ValueError(f"unknown chaos site {site!r}; expected one of {SITES}")
+        steps = getattr(self.config, f"{site.split('_')[0]}_error_steps")
+        prob = getattr(self.config, f"{site.split('_')[0]}_error_prob")
+        with self._lock:
+            deterministic = (
+                step is not None
+                and step in steps
+                and (site, step) not in self._fired
+            )
+            if deterministic:
+                self._fired.add((site, step))
+            fire = deterministic or (prob > 0 and self._rng.random() < prob)
+        if fire:
+            self._count()
+            logger.warning("chaos: injecting %s failure at step %s", site, step)
+            raise ChaosError(f"chaos: injected {site} failure at step {step}")
+
+    def maybe_sigterm(self, step: int) -> bool:
+        """Deliver SIGTERM to this process when `step` hits the trigger
+        (once). Returns True when the signal was sent."""
+        if self.config.sigterm_step is None:
+            return False
+        with self._lock:
+            if step != self.config.sigterm_step or ("sigterm", step) in self._fired:
+                return False
+            self._fired.add(("sigterm", step))
+        self._count()
+        logger.warning("chaos: delivering SIGTERM to self at step %d", step)
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+
+
+# ---------------------------------------------------------------- current
+_active: Chaos | None = None
+_active_lock = threading.Lock()
+
+
+def install_chaos(config: ChaosConfig | None, registry=None) -> Chaos | None:
+    """Install the process-global harness (None or an all-default config
+    uninstalls). Returns the installed Chaos, or None."""
+    global _active
+    with _active_lock:
+        if config is None or not config.any_active():
+            _active = None
+        else:
+            _active = Chaos(config, registry=registry)
+        return _active
+
+
+def uninstall_chaos() -> None:
+    install_chaos(None)
+
+
+def get_chaos() -> Chaos | None:
+    return _active
+
+
+def chaos_point(site: str, step: int | None = None) -> None:
+    """Call-site hook: no-op unless a harness is installed."""
+    chaos = _active
+    if chaos is not None:
+        chaos.maybe_raise(site, step)

@@ -1,0 +1,79 @@
+"""Exponential-backoff retry for transient I/O.
+
+On TPU pods the storage path (GCS fuse mounts, NFS scratch, object stores)
+throws transient `OSError`s under load; the reference framework inherits
+retry behavior from torch/Lightning internals, while here every durable-I/O
+call site (checkpoint save, data-source pulls) opts in explicitly via
+`retry_call`. The policy is deliberately conservative: only exception types
+listed in `TRANSIENT_EXCEPTIONS` (plus anything the caller adds) are
+retried — a programming error must surface on the first throw.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable
+
+from pydantic import BaseModel, ConfigDict, Field
+
+logger = logging.getLogger(__name__)
+
+# ConnectionError / TimeoutError / InterruptedError are OSError subclasses;
+# chaos-injected faults (resilience.chaos.ChaosError) subclass OSError too,
+# so the injection exercises exactly the production retry path.
+TRANSIENT_EXCEPTIONS: tuple[type[BaseException], ...] = (OSError,)
+
+
+class RetryPolicy(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # additional attempts after the first failure; 0 = fail fast
+    max_retries: int = Field(0, ge=0)
+    backoff_base_s: float = Field(0.5, ge=0)
+    backoff_factor: float = Field(2.0, ge=1)
+    backoff_max_s: float = Field(30.0, ge=0)
+
+    def delay_s(self, attempt: int) -> float:
+        """Backoff before retry number `attempt` (0-indexed)."""
+        return min(self.backoff_base_s * self.backoff_factor**attempt, self.backoff_max_s)
+
+
+def is_transient(
+    exc: BaseException,
+    extra: tuple[type[BaseException], ...] = (),
+) -> bool:
+    return isinstance(exc, TRANSIENT_EXCEPTIONS + tuple(extra))
+
+
+def retry_call(
+    fn: Callable[[int], Any],
+    policy: RetryPolicy,
+    *,
+    label: str = "operation",
+    counter: Any | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    transient: Callable[[BaseException], bool] = is_transient,
+) -> Any:
+    """Call `fn(attempt)` with up to `policy.max_retries` retries on
+    transient errors. `fn` receives the attempt index (0 on the first try)
+    so call sites can escalate — e.g. the checkpointer forces an overwrite
+    on retries in case the failed attempt left a partial step dir. Each
+    retry increments `counter` (a telemetry Counter) when given."""
+    attempt = 0
+    while True:
+        try:
+            return fn(attempt)
+        except Exception as e:
+            if attempt >= policy.max_retries or not transient(e):
+                raise
+            delay = policy.delay_s(attempt)
+            logger.warning(
+                "transient error in %s (attempt %d/%d): %s — retrying in %.2fs",
+                label, attempt + 1, policy.max_retries, e, delay,
+            )
+            if counter is not None:
+                counter.inc()
+            if delay > 0:
+                sleep(delay)
+            attempt += 1

@@ -1,0 +1,160 @@
+"""Preemption-safe graceful shutdown.
+
+On TPU pods the dominant failure mode is not a crash but an eviction: the
+scheduler delivers SIGTERM, waits out a grace window, then kills the
+process. "Scalable Training of Language Models using JAX pjit and TPUv4"
+(arXiv 2204.06514) reports surviving such hardware events via frequent
+checkpoint/restart as essential at scale. `GracefulShutdown` turns the
+signal into a clean, *resumable* exit: the handler only sets a flag, the
+trainer checks it at the next optimizer-step boundary, commits an emergency
+checkpoint (waiting out the async-save barrier), and raises
+`PreemptionInterrupt`, which the CLI maps to `RESUMABLE_EXIT_CODE` so a
+supervisor can distinguish "relaunch me" from a real failure
+(docs/resilience.md has the relaunch recipe).
+
+Multihost coordination: every host receives its own SIGTERM, but slight
+delivery skew could make hosts pick different boundary steps and deadlock
+the collective save. `should_stop` therefore broadcasts process-0's flag to
+all hosts (process-0 coordinated) whenever more than one process is
+present; single-process runs (tests, CPU smokes) read the local flag.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+
+# BSD EX_TEMPFAIL: "temporary failure, retry later" — the supervisor
+# contract for "emergency checkpoint committed, relaunch to resume"
+RESUMABLE_EXIT_CODE = 75
+
+
+class PreemptionInterrupt(RuntimeError):
+    """Raised by Trainer.fit after the emergency checkpoint is committed;
+    the run is resumable from the step it carries."""
+
+    def __init__(self, step: int | None, message: str):
+        super().__init__(message)
+        self.step = step
+
+
+class GracefulShutdown:
+    """Installs SIGTERM/SIGINT handlers that request a checkpoint-then-exit
+    at the next step boundary. A second signal restores the previous
+    handlers and re-raises, so a stuck save can still be interrupted."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self):
+        self._requested = threading.Event()
+        self._signum: int | None = None
+        self._previous: dict[int, object] = {}
+        self.installed = False
+
+    # ------------------------------------------------------------ handlers
+
+    def install(self) -> "GracefulShutdown":
+        try:
+            for signum in self.SIGNALS:
+                self._previous[signum] = signal.signal(signum, self._handler)
+            self.installed = True
+        except ValueError:
+            # signal.signal only works in the main thread — a fit driven
+            # from a worker thread runs without preemption handling
+            self._previous.clear()
+            logger.warning(
+                "not in the main thread: preemption signal handlers "
+                "unavailable for this fit"
+            )
+        return self
+
+    def uninstall(self) -> None:
+        for signum, previous in self._previous.items():
+            try:
+                signal.signal(signum, previous)
+            except (ValueError, TypeError):
+                pass
+        self._previous.clear()
+        self.installed = False
+
+    def _handler(self, signum, frame) -> None:
+        # NO logging in here: the handler runs on whatever frame the signal
+        # interrupted — if that frame was inside a buffered-stream write
+        # (the per-step log line), logger.* would re-enter the stream and
+        # CPython raises "reentrant call inside BufferedWriter" INTO the
+        # train loop, aborting the fit without the grace path. os.write to
+        # stderr is safe; the full warning is logged at the step boundary.
+        if self._requested.is_set():
+            self.uninstall()
+            os.write(
+                2,
+                b"second signal during graceful shutdown - restoring "
+                b"default handlers and re-raising\n",
+            )
+            signal.raise_signal(signum)
+            return
+        self._signum = signum
+        self._requested.set()
+        os.write(
+            2,
+            (
+                f"received {signal.Signals(signum).name}: emergency "
+                f"checkpoint at the next step boundary, then resumable "
+                f"exit (code {RESUMABLE_EXIT_CODE})\n"
+            ).encode(),
+        )
+
+    # ------------------------------------------------------------ queries
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def request(self) -> None:
+        """Programmatic trigger (tests, in-process supervisors)."""
+        self._requested.set()
+
+    @property
+    def reason(self) -> str:
+        if self._signum is None:
+            return "shutdown requested"
+        return signal.Signals(self._signum).name
+
+    def should_stop(self, step: int, sync_every: int = 1) -> bool:
+        """Boundary check the trainer calls once per optimizer step. With
+        multiple processes, process-0's flag is broadcast so every host
+        agrees on the SAME boundary step for the collective emergency save.
+        The broadcast is a blocking collective, so on pods `sync_every`
+        amortizes it: hosts only enter it on steps where
+        `step % sync_every == 0` — the gate must be a pure function of the
+        step (identical on every host), or the collective deadlocks. A
+        signal then waits at most `sync_every` steps, well inside any
+        preemption grace window. Single-process runs check the local flag
+        every step for free."""
+        try:
+            import jax
+
+            num_processes = jax.process_count()
+        except Exception:
+            num_processes = 1
+        if num_processes <= 1:
+            return self._requested.is_set()
+        if sync_every > 1 and step % sync_every != 0:
+            return False
+        try:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            flag = multihost_utils.broadcast_one_to_all(
+                np.int32(1 if self._requested.is_set() else 0)
+            )
+            return bool(int(flag) != 0)
+        except Exception as e:  # pragma: no cover - multihost only
+            logger.warning(
+                "preemption flag broadcast failed (%s); using the local flag", e
+            )
+            return self._requested.is_set()

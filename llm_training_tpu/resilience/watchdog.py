@@ -1,0 +1,157 @@
+"""Hang watchdog: turn silent multihost stalls into diagnosable failures.
+
+A wedged collective (one host lost, a deadlocked barrier, a stuck storage
+mount) leaves a TPU-pod job consuming accelerator-hours while making zero
+progress and printing nothing — the worst failure mode there is. The
+watchdog is a daemon thread fed heartbeats by the train loop (and,
+separately, the prefetcher worker); when the *train-loop* beat goes stale
+past `timeout_s` it writes a `hang-dump-*.txt` into the run dir with every
+Python thread's stack, the goodput ledger's currently-open phase (the
+activity the loop is stuck inside), and per-source beat ages — then, with
+`action="abort"`, kills the process so a supervisor can relaunch instead of
+burning the reservation. Progress re-arms it, so a one-off dump per stall.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+
+class HangWatchdog:
+    def __init__(
+        self,
+        timeout_s: float,
+        run_dir: str | Path | None = None,
+        ledger=None,
+        registry=None,
+        action: str = "dump",
+        poll_interval_s: float | None = None,
+        clock=time.monotonic,
+    ):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout_s must be > 0, got {timeout_s}")
+        if action not in ("dump", "abort"):
+            raise ValueError(f"watchdog action must be dump|abort, got {action!r}")
+        self.timeout_s = timeout_s
+        self.run_dir = Path(run_dir) if run_dir else None
+        self.action = action
+        self._ledger = ledger
+        self._registry = registry
+        self._clock = clock
+        self._poll_s = poll_interval_s or min(max(timeout_s / 4.0, 0.05), 5.0)
+        self._beats: dict[str, float] = {}
+        self._steps: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._dumped = False  # re-armed by the next beat
+        self._thread: threading.Thread | None = None
+        self.dump_paths: list[Path] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "HangWatchdog":
+        self.beat("train_loop")
+        self._thread = threading.Thread(
+            target=self._run, name="hang-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def beat(self, source: str = "train_loop", step: int | None = None) -> None:
+        """Record progress. Only the `train_loop` source arms/disarms the
+        timeout; other sources (prefetcher) are context in the dump."""
+        with self._lock:
+            self._beats[source] = self._clock()
+            if step is not None:
+                self._steps[source] = step
+            if source == "train_loop":
+                self._dumped = False
+
+    # ------------------------------------------------------------ polling
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            with self._lock:
+                last = self._beats.get("train_loop")
+                dumped = self._dumped
+            if last is None or dumped:
+                continue
+            stalled = self._clock() - last
+            if stalled < self.timeout_s:
+                continue
+            with self._lock:
+                self._dumped = True
+            try:
+                self.dump(stalled)
+            except Exception:  # the watchdog must never kill a healthy run
+                logger.exception("hang-dump failed")
+            if self.action == "abort":
+                logger.critical(
+                    "watchdog: no train-loop progress for %.1fs — aborting "
+                    "so the supervisor can relaunch", stalled,
+                )
+                os.kill(os.getpid(), signal.SIGABRT)
+
+    # ------------------------------------------------------------ dumping
+
+    def dump(self, stalled_s: float) -> Path | None:
+        """Write the diagnostic dump; returns its path (None when the run
+        has no artifact directory — then the dump goes to the log)."""
+        content = self._render(stalled_s)
+        if self._registry is not None:
+            self._registry.counter("resilience/watchdog_dumps").inc()
+        if self.run_dir is None:
+            logger.error("watchdog stall (no run dir for the dump):\n%s", content)
+            return None
+        self.run_dir.mkdir(parents=True, exist_ok=True)
+        path = self.run_dir / f"hang-dump-{time.strftime('%Y%m%d-%H%M%S')}.txt"
+        path.write_text(content)
+        self.dump_paths.append(path)
+        logger.error(
+            "watchdog: no train-loop progress for %.1fs — thread stacks "
+            "dumped to %s", stalled_s, path,
+        )
+        return path
+
+    def _render(self, stalled_s: float) -> str:
+        now = self._clock()
+        with self._lock:
+            beats = dict(self._beats)
+            steps = dict(self._steps)
+        lines = [
+            f"HANG DUMP — no train-loop heartbeat for {stalled_s:.1f}s "
+            f"(timeout {self.timeout_s:.1f}s)",
+            f"wall time: {time.strftime('%Y-%m-%d %H:%M:%S')}",
+        ]
+        phase = getattr(self._ledger, "current_phase", None)
+        lines.append(f"goodput phase open at stall: {phase or '<none>'}")
+        for source, t in sorted(beats.items()):
+            step = steps.get(source)
+            lines.append(
+                f"last beat [{source}]: {now - t:.1f}s ago"
+                + (f" (step {step})" if step is not None else "")
+            )
+        lines.append("")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            lines.append(f"--- thread {names.get(tid, '?')} (id {tid}) ---")
+            lines.extend(
+                line.rstrip("\n") for line in traceback.format_stack(frame)
+            )
+            lines.append("")
+        return "\n".join(lines)

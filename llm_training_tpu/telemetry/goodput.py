@@ -31,6 +31,9 @@ class GoodputLedger:
         self._lock = threading.Lock()
         self._t0: float | None = None
         self._phase_s: dict[str, float] = {p: 0.0 for p in PHASES}
+        # stack of currently-open measure() phases: the hang watchdog reads
+        # the innermost one to say what the loop was stuck inside
+        self._open: list[str] = []
 
     def start(self) -> None:
         """Begin (or restart) accounting; zeroes all phases."""
@@ -49,10 +52,24 @@ class GoodputLedger:
     def measure(self, phase: str) -> Iterator[None]:
         """Time the enclosed block into `phase`."""
         t0 = self._clock()
+        with self._lock:
+            self._open.append(phase)
         try:
             yield
         finally:
+            with self._lock:
+                for i in range(len(self._open) - 1, -1, -1):
+                    if self._open[i] == phase:
+                        del self._open[i]
+                        break
             self.note(phase, self._clock() - t0)
+
+    @property
+    def current_phase(self) -> str | None:
+        """The innermost phase currently being measured (None outside any
+        bracket) — a hang dump's 'what was the loop doing' line."""
+        with self._lock:
+            return self._open[-1] if self._open else None
 
     def elapsed(self) -> float:
         with self._lock:

@@ -136,6 +136,32 @@ def _health_section(telemetry: dict) -> list[str]:
     return lines
 
 
+def _resilience_section(telemetry: dict) -> list[str]:
+    """Fault-tolerance event counters (`resilience/*` plus the retry
+    counters — docs/resilience.md): rendered only when the run recorded at
+    least one such event, so a clean run's report stays unchanged."""
+    rows = [
+        ("resilience/preemptions", "preemptions (graceful shutdowns)"),
+        ("resilience/emergency_saves", "emergency checkpoint saves"),
+        ("resilience/restore_fallbacks", "restore fallbacks (corrupt step skipped)"),
+        ("resilience/watchdog_dumps", "watchdog hang dumps"),
+        ("resilience/chaos_injections", "chaos-injected faults"),
+        ("data/retries", "data-source retries"),
+        ("checkpoint/retries", "checkpoint I/O retries"),
+    ]
+    lines = []
+    for key, label in rows:
+        try:
+            value = float(telemetry.get(key, 0.0))
+        except (TypeError, ValueError):
+            continue
+        if value:
+            lines.append(f"{label}: {int(value)}")
+    if not lines:
+        return []
+    return ["", "== Resilience =="] + lines
+
+
 def render_report(run_dir: str | Path) -> str:
     run_dir = Path(run_dir)
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
@@ -227,6 +253,7 @@ def render_report(run_dir: str | Path) -> str:
         lines.append(peak_line)
 
     lines.extend(_health_section(telemetry))
+    lines.extend(_resilience_section(telemetry))
     return "\n".join(lines)
 
 

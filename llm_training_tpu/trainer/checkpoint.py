@@ -11,6 +11,14 @@ Capability parity: reference checkpoint subsystem (SURVEY.md §3.3/§5.4):
   stream is a pure function of (seed, step) — no batch skipping
   (cf. `resumable_dataloader.py:20-25`, which replays O(skipped) batches)
 - async save (orbax background thread) with `wait()` barrier
+
+Durability (docs/resilience.md): transient I/O errors during save are
+retried with exponential backoff (retries escalate to an overwrite in case
+the failed attempt left a partial step dir); async-save failures surface at
+the NEXT save point instead of silently waiting for the next `wait()`; and
+restore falls back to the previous retained step when the newest one is
+corrupt/partial — a run preempted mid-commit must not crash-loop on
+relaunch.
 """
 
 from __future__ import annotations
@@ -35,6 +43,11 @@ class CheckpointConfig(BaseModel):
     max_to_keep: int = 3
     async_save: bool = True
     save_on_exit: bool = True
+    # transient-I/O retries around the blocking part of save (serialize +
+    # handoff; the whole write when async_save=False)
+    save_retries: int = 3
+    retry_backoff_s: float = 0.5
+    retry_backoff_max_s: float = 30.0
 
 
 def _pack(state: TrainState) -> Any:
@@ -66,6 +79,16 @@ class Checkpointer:
             ),
             item_names=("state", "meta"),
         )
+        # newest save launched but not yet confirmed committed (async mode);
+        # wait() logs the commit once the barrier passes
+        self._inflight_step: int | None = None
+
+    def check_errors(self) -> None:
+        """Surface a failed async save NOW (orbax parks background-thread
+        errors until `wait_until_finished` — without this probe a failure
+        stays invisible until the next barrier, which may be the end of
+        fit, silently widening the window of unpersisted work)."""
+        self.manager.check_for_errors()
 
     def save(
         self,
@@ -74,7 +97,10 @@ class Checkpointer:
         counters: dict[str, int] | None = None,
         force: bool = False,
     ) -> None:
-        if step in self.manager.all_steps():
+        # surface a parked async failure even when THIS call dedupes away —
+        # "failures surface at the next save point" must include skipped ones
+        self.check_errors()
+        if step in self.manager.all_steps() and not force:
             return  # e.g. end-of-fit save colliding with an interval save
         meta = {
             "step": step,
@@ -82,31 +108,85 @@ class Checkpointer:
             "config": self.run_config,
             "run_metadata": self.run_metadata,
         }
+        from llm_training_tpu.resilience import RetryPolicy, chaos_point, retry_call
         from llm_training_tpu.telemetry import get_registry
 
-        # with async_save this times only the blocking handoff (serialize +
-        # background-thread launch); wait() below captures the barrier
-        with get_registry().timer("checkpoint/save").time():
+        registry = get_registry()
+        policy = RetryPolicy(
+            max_retries=self.config.save_retries,
+            backoff_base_s=self.config.retry_backoff_s,
+            backoff_max_s=self.config.retry_backoff_max_s,
+        )
+
+        def _save(attempt: int) -> None:
+            chaos_point("checkpoint_save", step=step)
+            # force-overwrite path (emergency save over a stale/partial
+            # entry, or a retry after a mid-write failure): orbax refuses to
+            # save over a finalized step, so drop it first. There is a
+            # window between the delete and the replacement's commit where
+            # this step has no durable copy — a SIGKILL inside it loses the
+            # step; retention (max_to_keep) plus the restore fallback bound
+            # the damage to "resume from the previous retained step", which
+            # beats the alternative (StepAlreadyExistsError = no emergency
+            # save at all)
+            if step in self.manager.all_steps():
+                self.manager.delete(step)
+            # force here only bypasses the save-interval policy; a failed
+            # attempt's partial (unfinalized) dir is cleared by orbax itself
             self.manager.save(
                 step,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardSave(_pack(state)),
                     meta=ocp.args.JsonSave(meta),
                 ),
-                force=force,
+                force=force or attempt > 0,
             )
-        logger.info("checkpoint saved at step %d -> %s", step, self.directory)
+
+        # with async_save this times only the blocking handoff (serialize +
+        # background-thread launch); wait() below captures the barrier
+        with registry.timer("checkpoint/save").time():
+            retry_call(
+                _save, policy,
+                label=f"checkpoint save (step {step})",
+                counter=registry.counter("checkpoint/retries"),
+            )
+        if self.config.async_save:
+            self._inflight_step = step
+            logger.info(
+                "checkpoint save started at step %d -> %s (async; durable "
+                "after the wait() barrier)", step, self.directory,
+            )
+        else:
+            logger.info(
+                "checkpoint committed at step %d -> %s", step, self.directory
+            )
 
     def maybe_restore(
         self,
         abstract_state: Any,
         shardings: Any,
         step: int | None = None,
+        repair: bool = True,
     ) -> tuple[TrainState, dict] | None:
         """Restore the latest (or given) step straight into sharded buffers.
-        Returns None when no checkpoint exists."""
-        step = step if step is not None else self.manager.latest_step()
-        if step is None:
+        Returns None when no checkpoint exists. When no explicit step is
+        requested and the newest retained step is corrupt/partial (a
+        preemption mid-commit), fall back to the next older retained step —
+        losing a few steps of progress beats crash-looping the relaunch.
+        An EXPLICIT step request never falls back (the caller asked for
+        that state, not "something close to it"); and if every retained
+        step fails, the first error is re-raised so a systematic problem
+        (e.g. an optimizer-layout mismatch) keeps its diagnosis.
+
+        `repair=True` (the fit path) deletes the unrestorable newer steps
+        after a successful fallback so the resumed run re-saves them;
+        read-only callers (the `validate` CLI) pass False — an observation
+        must not mutate the checkpoint directory."""
+        explicit = step is not None
+        candidates = (
+            [step] if explicit else sorted(self.manager.all_steps(), reverse=True)
+        )
+        if not candidates:
             return None
         abstract = jax.tree.map(
             lambda leaf, sharding: jax.ShapeDtypeStruct(
@@ -116,15 +196,78 @@ class Checkpointer:
             shardings,
         )
         abstract = _pack_abstract(abstract)
-        restored = self.manager.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                meta=ocp.args.JsonRestore(),
-            ),
+        from llm_training_tpu.resilience import RetryPolicy, is_transient, retry_call
+        from llm_training_tpu.telemetry import get_registry
+
+        # transient I/O during restore is retried like it is during save —
+        # without this, a one-off storage blip would be misclassified as
+        # corruption and the (perfectly good) newest step deleted below.
+        # FileNotFoundError is excluded: a missing payload file is the
+        # corruption signature, and no amount of retrying conjures it back
+        policy = RetryPolicy(
+            max_retries=self.config.save_retries,
+            backoff_base_s=self.config.retry_backoff_s,
+            backoff_max_s=self.config.retry_backoff_max_s,
         )
-        logger.info("restored checkpoint step %d from %s", step, self.directory)
-        return _unpack(restored["state"]), restored["meta"]
+
+        def _restore_transient(e: BaseException) -> bool:
+            return is_transient(e) and not isinstance(e, FileNotFoundError)
+
+        first_error: Exception | None = None
+        corrupt: list[int] = []
+        for candidate in candidates:
+            try:
+                restored = retry_call(
+                    lambda attempt: self.manager.restore(
+                        candidate,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(abstract),
+                            meta=ocp.args.JsonRestore(),
+                        ),
+                    ),
+                    policy,
+                    label=f"checkpoint restore (step {candidate})",
+                    counter=get_registry().counter("checkpoint/retries"),
+                    transient=_restore_transient,
+                )
+            except Exception as e:
+                if explicit:
+                    raise
+                if first_error is None:
+                    first_error = e
+                corrupt.append(candidate)
+                get_registry().counter("resilience/restore_fallbacks").inc()
+                logger.warning(
+                    "checkpoint step %d in %s is corrupt or partial (%s); "
+                    "falling back to the previous retained step",
+                    candidate, self.directory, e,
+                )
+                continue
+            logger.info(
+                "restored checkpoint step %d from %s", candidate, self.directory
+            )
+            # drop the unrestorable newer steps: left in place they would
+            # (a) stay the "newest" checkpoint every later restore has to
+            # fall back past, and (b) make the resumed run's interval save
+            # at the same step skip via the already-exists early return —
+            # the corruption would never be repaired
+            for bad in corrupt if repair else ():
+                try:
+                    self.manager.delete(bad)
+                    logger.warning(
+                        "deleted unrestorable checkpoint step %d", bad
+                    )
+                except Exception as e:
+                    logger.warning(
+                        "could not delete unrestorable checkpoint step %d "
+                        "(%s); later restores will keep falling back past it",
+                        bad, e,
+                    )
+            return _unpack(restored["state"]), restored["meta"]
+        raise RuntimeError(
+            f"all retained checkpoint steps {candidates} in {self.directory} "
+            "failed to restore"
+        ) from first_error
 
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
@@ -134,9 +277,20 @@ class Checkpointer:
 
         with get_registry().timer("checkpoint/wait").time():
             self.manager.wait_until_finished()
+        if self._inflight_step is not None:
+            logger.info(
+                "checkpoint committed at step %d -> %s",
+                self._inflight_step, self.directory,
+            )
+            self._inflight_step = None
 
     def close(self) -> None:
-        self.manager.close()
+        # a fast exit (preemption grace window, early return) must not drop
+        # an in-flight async save — barrier first, then release resources
+        try:
+            self.wait()
+        finally:
+            self.manager.close()
 
 
 def _strip(abstract_state: Any) -> Any:

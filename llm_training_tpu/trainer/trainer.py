@@ -35,6 +35,16 @@ from llm_training_tpu.parallel.sharding import (
     DEFAULT_LOGICAL_AXIS_RULES,
     logical_to_spec,
 )
+from llm_training_tpu.resilience import (
+    GracefulShutdown,
+    HangWatchdog,
+    PreemptionInterrupt,
+    ResilienceConfig,
+    config_from_env,
+    get_chaos,
+    install_chaos,
+    uninstall_chaos,
+)
 from llm_training_tpu.telemetry import (
     GoodputLedger,
     HealthConfig,
@@ -108,6 +118,11 @@ class TrainerConfig(BaseModel):
     # every `health.every_n_steps` optimizer steps. Default (unset) builds
     # no variant — the compiled train step is byte-identical to health-off
     health: HealthConfig = HealthConfig()
+    # fault tolerance (resilience/): preemption signal handling (on by
+    # default — zero cost until a signal arrives), hang watchdog (off by
+    # default), data-source retry policy, and the fault-injection harness
+    # (docs/resilience.md)
+    resilience: ResilienceConfig = ResilienceConfig()
     mesh: MeshConfig = MeshConfig()
 
 
@@ -173,6 +188,15 @@ class Trainer:
         # callbacks set this when the state must NOT be persisted (e.g. the
         # NaN guard stopping on divergence — saving would poison resume)
         self.abort_final_save = False
+        # resilience runtime (built per fit): signal-driven shutdown manager,
+        # hang watchdog, and whether this fit is ending due to a preemption
+        # (fit then raises PreemptionInterrupt after the emergency save)
+        self._shutdown: GracefulShutdown | None = None
+        self._watchdog: HangWatchdog | None = None
+        self._preempted = False
+        # optimizer step of the newest in-loop interval save this fit (the
+        # final-save epilogue skips re-saving an identical step)
+        self._last_interval_save: int | None = None
         self.abstract_state = None
         self.last_step: int | None = None
         self.last_seq_len: int | None = None
@@ -467,10 +491,38 @@ class Trainer:
         self.telemetry = TelemetryRegistry()
         self.ledger.start()
         previous_registry = set_registry(self.telemetry)
+        resil = cfg.resilience
+        self._preempted = False
+        self._last_interval_save = None
+        # fault injection first (env overlays the config), so every other
+        # resilience layer — and the checkpointer/prefetcher call sites —
+        # sees the harness
+        install_chaos(config_from_env(resil.chaos), registry=self.telemetry)
+        self._shutdown = (
+            GracefulShutdown().install() if resil.handle_signals else None
+        )
+        self._watchdog = None
+        if resil.watchdog_timeout_s:
+            from llm_training_tpu.telemetry.anomaly import resolve_run_dir
+
+            self._watchdog = HangWatchdog(
+                resil.watchdog_timeout_s,
+                run_dir=resolve_run_dir(self),
+                ledger=self.ledger,
+                registry=self.telemetry,
+                action=resil.watchdog_action,
+            ).start()
         try:
             with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
                 return self._fit_inner(objective, datamodule, resume_step, state)
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+            if self._shutdown is not None:
+                self._shutdown.uninstall()
+                self._shutdown = None
+            uninstall_chaos()
             set_registry(previous_registry)
             # callbacks that alter process state (output tees, profiler
             # traces) must restore it even when fit raises mid-run
@@ -700,15 +752,23 @@ class Trainer:
             if cfg.prefetch_batches > 0:
                 from llm_training_tpu.data.prefetch import DevicePrefetcher
 
+                watchdog = self._watchdog
                 prefetcher = DevicePrefetcher(
                     batches,
                     batch_shardings,
                     depth=cfg.prefetch_batches,
                     host_aux_fn=self._batch_counts,
                     registry=self.telemetry,
+                    retries=cfg.resilience.data_retries,
+                    retry_backoff_s=cfg.resilience.data_retry_backoff_s,
+                    heartbeat=(
+                        (lambda: watchdog.beat("prefetcher")) if watchdog else None
+                    ),
                 )
                 batches = iter(prefetcher)
             for micro in range(start_micro, micro_steps):
+                if self._watchdog is not None:
+                    self._watchdog.beat("train_loop", step=micro)
                 with jax.profiler.StepTraceAnnotation("train", step_num=micro):
                     with self.ledger.measure("data_wait"), \
                             jax.profiler.TraceAnnotation("data_load"):
@@ -870,6 +930,26 @@ class Trainer:
                     with self.ledger.measure("checkpoint_save"), \
                             jax.profiler.TraceAnnotation("checkpoint_save"):
                         self.checkpointer.save(step, state, counters=dict(self.counters))
+                    self._last_interval_save = step
+
+                # simulated preemption (fault injection): a REAL SIGTERM to
+                # this process, so the whole handler -> boundary-check ->
+                # emergency-save path below is the one being exercised
+                chaos = get_chaos()
+                if chaos is not None:
+                    chaos.maybe_sigterm(step)
+
+                if self._shutdown is not None and self._shutdown.should_stop(
+                    step, cfg.resilience.preemption_sync_every_n_steps
+                ):
+                    logger.warning(
+                        "preemption (%s) at step %d: committing an emergency "
+                        "checkpoint, then exiting resumable",
+                        self._shutdown.reason, step,
+                    )
+                    self.telemetry.counter("resilience/preemptions").inc()
+                    self._preempted = True
+                    self.should_stop = True
 
                 if self.should_stop:
                     logger.info("stopping at step %d (callback request)", step)
@@ -877,7 +957,15 @@ class Trainer:
         finally:
             if prefetcher is not None:
                 prefetcher.close()
+            # the watchdog patrols the LOOP; the epilogue below legitimately
+            # blocks on the final save + async barrier for however long the
+            # checkpoint takes — a dump (or worse, an abort) mid-commit
+            # would manufacture the very partial checkpoint it guards
+            # against. fit's finally makes this stop idempotent.
+            if self._watchdog is not None:
+                self._watchdog.stop()
 
+        final_save_committed = False
         if (
             self.checkpointer is not None
             and self.last_step is not None
@@ -888,9 +976,25 @@ class Trainer:
             # (should_stop) must not masquerade as a completed run
             with self.ledger.measure("checkpoint_save"), \
                     jax.profiler.TraceAnnotation("checkpoint_save"):
-                self.checkpointer.save(
-                    self.last_step, state, counters=dict(self.counters), force=True
-                )
+                # force=True: this step may collide with a stale/partial
+                # entry from a PREVIOUS run of the same dir (the emergency-
+                # save case) — but when THIS fit's interval save already
+                # wrote the identical state, re-saving would be pure waste
+                if self.last_step != self._last_interval_save:
+                    if self._preempted:
+                        self.telemetry.counter("resilience/emergency_saves").inc()
+                    self.checkpointer.save(
+                        self.last_step, state, counters=dict(self.counters), force=True
+                    )
+                # the barrier: after this, the newest save (emergency or
+                # interval) is durable — safe to exit
+                self.checkpointer.wait()
+                final_save_committed = True
+        elif self.checkpointer is not None and self._preempted:
+            # the emergency save was vetoed (diverged/non-finite state) —
+            # still barrier any in-flight async interval save so what the
+            # relaunch restores is durable before the resumable exit
+            with self.ledger.measure("checkpoint_save"):
                 self.checkpointer.wait()
         # one final telemetry record: the post-loop checkpoint save/wait
         # landed after the last log step, so without this flush every
@@ -908,6 +1012,24 @@ class Trainer:
         for cb in self.callbacks:
             if hasattr(cb, "on_fit_end"):
                 cb.on_fit_end(self, state)
+        if self._preempted:
+            # after the emergency checkpoint is durable and every logger is
+            # flushed/closed: hand the supervisor contract up (the CLI maps
+            # this to RESUMABLE_EXIT_CODE; relaunching `fit` resumes via
+            # maybe_restore)
+            saved = final_save_committed
+            raise PreemptionInterrupt(
+                self.last_step,
+                f"preempted ({self._shutdown.reason if self._shutdown else 'signal'}) "
+                f"at step {self.last_step}; "
+                + (
+                    "emergency checkpoint committed — relaunch fit with the "
+                    "same config to resume"
+                    if saved
+                    else "NO resumable checkpoint written by this fit — a "
+                    "relaunch resumes from the newest previous one, if any"
+                ),
+            )
         return state
 
     def _run_validation(self, eval_step, state, datamodule, step) -> None:
@@ -915,6 +1037,10 @@ class Trainer:
         for i, batch in enumerate(datamodule.val_batches()):
             if self.config.limit_val_batches and i >= self.config.limit_val_batches:
                 break
+            if self._watchdog is not None:
+                # a validation epoch can legitimately outlast the no-progress
+                # timeout; each batch is progress
+                self._watchdog.beat("train_loop", step=step)
             out = jax.device_get(eval_step(state, batch))
             losses.append(out["loss"])
             weights.append(out["target_tokens"])
@@ -978,8 +1104,9 @@ class Trainer:
             abstract_boxed = self._abstract_state(objective, sample_batch, tx)
             self.state_shardings = self._state_shardings(abstract_boxed)
             abstract_state = nn.meta.unbox(abstract_boxed)
+            # read-only path: a validation must not delete/repair anything
             restored = self.checkpointer.maybe_restore(
-                abstract_state, self.state_shardings, resume_step
+                abstract_state, self.state_shardings, resume_step, repair=False
             )
             if restored is None:
                 raise ValueError(f"no checkpoint found in {self.checkpointer.directory}")

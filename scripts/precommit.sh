@@ -30,6 +30,13 @@ grep -q "== Health ==" "${SMOKE_ROOT}/report_smoke.log"
 echo "== precommit: forced-NaN anomaly dump smoke =="
 JAX_PLATFORMS=cpu python scripts/force_nan_smoke.py "${SMOKE_ROOT}/nan-smoke"
 
+# resilience gate (docs/resilience.md): chaos SIGTERM mid-fit -> committed
+# emergency checkpoint + resumable exit code + loss-exact resume; injected
+# checkpoint I/O error retried; corrupt latest checkpoint falls back on
+# restore; a forced stall produces the watchdog's thread-stack dump
+echo "== precommit: kill-and-resume smoke =="
+JAX_PLATFORMS=cpu python scripts/crash_resume_smoke.py "${SMOKE_ROOT}/resilience"
+
 # note: under axon the sitecustomize registers the TPU backend at interpreter
 # start, so JAX_PLATFORMS=cpu does NOT demote this to a CPU smoke — when a
 # chip is attached this runs the REAL default bench (and must print rc=0 with

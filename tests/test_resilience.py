@@ -1,0 +1,742 @@
+"""Resilience subsystem: retry policy, chaos harness, graceful shutdown,
+hang watchdog, durable checkpointing, and the kill-and-resume contract
+(docs/resilience.md)."""
+
+import os
+import signal
+import threading
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import yaml
+
+from llm_training_tpu.resilience import (
+    RESUMABLE_EXIT_CODE,
+    ChaosConfig,
+    ChaosError,
+    GracefulShutdown,
+    HangWatchdog,
+    PreemptionInterrupt,
+    ResilienceConfig,
+    RetryPolicy,
+    config_from_env,
+    install_chaos,
+    is_transient,
+    retry_call,
+    uninstall_chaos,
+)
+from llm_training_tpu.telemetry import GoodputLedger, TelemetryRegistry
+from llm_training_tpu.trainer.state import TrainState
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    uninstall_chaos()
+
+
+# ---------------------------------------------------------------- retry
+
+
+def test_retry_call_backoff_counter_and_success():
+    registry = TelemetryRegistry()
+    sleeps = []
+    calls = []
+
+    def flaky(attempt):
+        calls.append(attempt)
+        if attempt < 2:
+            raise OSError("transient")
+        return "ok"
+
+    result = retry_call(
+        flaky,
+        RetryPolicy(max_retries=3, backoff_base_s=0.5, backoff_factor=2.0),
+        counter=registry.counter("data/retries"),
+        sleep=sleeps.append,
+    )
+    assert result == "ok"
+    assert calls == [0, 1, 2]
+    assert sleeps == [0.5, 1.0]  # exponential
+    assert registry.counter("data/retries").value == 2
+
+
+def test_retry_call_exhausts_and_reraises():
+    with pytest.raises(OSError):
+        retry_call(
+            lambda attempt: (_ for _ in ()).throw(OSError("always")),
+            RetryPolicy(max_retries=2, backoff_base_s=0),
+            sleep=lambda s: None,
+        )
+
+
+def test_retry_call_non_transient_fails_fast():
+    calls = []
+
+    def broken(attempt):
+        calls.append(attempt)
+        raise ValueError("bug, not weather")
+
+    with pytest.raises(ValueError):
+        retry_call(broken, RetryPolicy(max_retries=5, backoff_base_s=0))
+    assert calls == [0]  # no retries for programming errors
+
+
+def test_backoff_is_capped():
+    policy = RetryPolicy(max_retries=10, backoff_base_s=1.0, backoff_max_s=4.0)
+    assert policy.delay_s(0) == 1.0
+    assert policy.delay_s(5) == 4.0
+
+
+def test_transient_classification():
+    assert is_transient(OSError())
+    assert is_transient(ConnectionError())
+    assert is_transient(TimeoutError())
+    assert is_transient(ChaosError("injected"))
+    assert not is_transient(ValueError())
+
+
+# ---------------------------------------------------------------- chaos
+
+
+def test_chaos_deterministic_trigger_fires_exactly_once():
+    registry = TelemetryRegistry()
+    chaos = install_chaos(ChaosConfig(data_error_steps=(2,)), registry=registry)
+    chaos.maybe_raise("data", step=1)  # no trigger
+    with pytest.raises(ChaosError):
+        chaos.maybe_raise("data", step=2)
+    chaos.maybe_raise("data", step=2)  # the retry path succeeds
+    assert registry.counter("resilience/chaos_injections").value == 1
+
+
+def test_chaos_checkpoint_site_and_unknown_site():
+    chaos = install_chaos(ChaosConfig(checkpoint_error_steps=(5,)))
+    with pytest.raises(ChaosError):
+        chaos.maybe_raise("checkpoint_save", step=5)
+    chaos.maybe_raise("data", step=5)  # other site untouched
+    with pytest.raises(ValueError):
+        chaos.maybe_raise("nope", step=1)
+
+
+def test_chaos_install_requires_active_trigger():
+    assert install_chaos(ChaosConfig()) is None  # all-default = off
+    assert install_chaos(ChaosConfig(sigterm_step=3)) is not None
+    uninstall_chaos()
+    from llm_training_tpu.resilience import get_chaos
+
+    assert get_chaos() is None
+
+
+def test_chaos_config_from_env(monkeypatch):
+    monkeypatch.setenv("LLMT_CHAOS_DATA_ERROR_STEPS", "3,5")
+    monkeypatch.setenv("LLMT_CHAOS_SIGTERM_STEP", "7")
+    monkeypatch.setenv("LLMT_CHAOS_CHECKPOINT_ERROR_PROB", "0.25")
+    config = config_from_env(ChaosConfig(seed=9))
+    assert config.data_error_steps == (3, 5)
+    assert config.sigterm_step == 7
+    assert config.checkpoint_error_prob == 0.25
+    assert config.seed == 9  # untouched fields keep the base values
+
+
+# ---------------------------------------------------------------- shutdown
+
+
+def test_graceful_shutdown_real_sigterm_sets_flag():
+    shutdown = GracefulShutdown().install()
+    try:
+        assert shutdown.installed
+        assert not shutdown.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython runs the Python-level handler at the next bytecode boundary
+        deadline = time.monotonic() + 5.0
+        while not shutdown.requested and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert shutdown.requested
+        assert shutdown.reason == "SIGTERM"
+        assert shutdown.should_stop(step=1)
+    finally:
+        shutdown.uninstall()
+
+
+def test_graceful_shutdown_restores_previous_handlers():
+    before = signal.getsignal(signal.SIGTERM)
+    shutdown = GracefulShutdown().install()
+    assert signal.getsignal(signal.SIGTERM) is not before
+    shutdown.uninstall()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_graceful_shutdown_programmatic_request():
+    shutdown = GracefulShutdown()  # no handlers installed
+    assert not shutdown.should_stop(step=0)
+    shutdown.request()
+    assert shutdown.should_stop(step=0)
+
+
+# ---------------------------------------------------------------- watchdog
+
+
+def test_watchdog_dumps_stacks_on_stall(tmp_path):
+    registry = TelemetryRegistry()
+    ledger = GoodputLedger()
+    ledger.start()
+    parked = threading.Event()
+
+    def park():
+        parked.wait(timeout=30)
+
+    thread = threading.Thread(target=park, name="parked-worker", daemon=True)
+    thread.start()
+    watchdog = HangWatchdog(
+        timeout_s=0.2, run_dir=tmp_path, ledger=ledger, registry=registry
+    ).start()
+    try:
+        with ledger.measure("data_wait"):  # the phase the "hang" is inside
+            deadline = time.monotonic() + 10.0
+            while not watchdog.dump_paths and time.monotonic() < deadline:
+                time.sleep(0.05)
+    finally:
+        watchdog.stop()
+        parked.set()
+        thread.join()
+    assert watchdog.dump_paths, "no hang dump produced under a forced stall"
+    content = watchdog.dump_paths[0].read_text()
+    assert "no train-loop heartbeat" in content
+    assert "goodput phase open at stall: data_wait" in content
+    assert "parked-worker" in content  # every thread's stack is in the dump
+    assert "MainThread" in content
+    assert registry.counter("resilience/watchdog_dumps").value == 1
+
+
+def test_watchdog_beat_rearms_and_prevents_dump(tmp_path):
+    watchdog = HangWatchdog(timeout_s=0.5, run_dir=tmp_path).start()
+    try:
+        for _ in range(6):  # keep beating well past the timeout window
+            watchdog.beat("train_loop", step=1)
+            time.sleep(0.1)
+        assert not watchdog.dump_paths
+    finally:
+        watchdog.stop()
+
+
+def test_watchdog_dumps_once_per_stall(tmp_path):
+    watchdog = HangWatchdog(timeout_s=0.1, run_dir=tmp_path).start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while not watchdog.dump_paths and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.4)  # several timeout windows later: still one dump
+        assert len(watchdog.dump_paths) == 1
+        watchdog.beat("train_loop")  # progress re-arms
+        deadline = time.monotonic() + 10.0
+        while len(watchdog.dump_paths) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert len(watchdog.dump_paths) == 2
+    finally:
+        watchdog.stop()
+
+
+def test_watchdog_validates_config():
+    with pytest.raises(ValueError):
+        HangWatchdog(timeout_s=0)
+    with pytest.raises(ValueError):
+        HangWatchdog(timeout_s=1, action="explode")
+
+
+def test_ledger_current_phase_tracks_nesting():
+    ledger = GoodputLedger()
+    ledger.start()
+    assert ledger.current_phase is None
+    with ledger.measure("data_wait"):
+        assert ledger.current_phase == "data_wait"
+        with ledger.measure("step_compute"):
+            assert ledger.current_phase == "step_compute"
+        assert ledger.current_phase == "data_wait"
+    assert ledger.current_phase is None
+
+
+# ---------------------------------------------------------------- checkpointer
+
+
+def _tiny_state(value: float) -> TrainState:
+    return TrainState.create(
+        params={"w": jnp.full((4,), value, jnp.float32)},
+        opt_state={"m": jnp.zeros((4,), jnp.float32)},
+        rng=jax.random.key(0),
+    )
+
+
+def _restore_args(state: TrainState):
+    abstract = jax.eval_shape(lambda: state)
+    shardings = jax.tree.map(lambda leaf: None, abstract)
+    return abstract, shardings
+
+
+def _checkpointer(tmp_path, **overrides):
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    kwargs = dict(dirpath=str(tmp_path), async_save=False, retry_backoff_s=0.0)
+    kwargs.update(overrides)
+    return Checkpointer(CheckpointConfig(**kwargs))
+
+
+def test_checkpointer_save_honors_force(tmp_path):
+    ckpt = _checkpointer(tmp_path)
+    ckpt.save(1, _tiny_state(1.0))
+    ckpt.save(1, _tiny_state(2.0))  # duplicate step without force: skipped
+    state, shardings = _restore_args(_tiny_state(0.0))
+    restored, _ = ckpt.maybe_restore(state, shardings)
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), 1.0)
+    # force=True overwrites the stale entry (the emergency-save contract)
+    ckpt.save(1, _tiny_state(3.0), force=True)
+    restored, meta = ckpt.maybe_restore(state, shardings)
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), 3.0)
+    assert meta["step"] == 1
+    ckpt.close()
+
+
+def test_checkpointer_retries_transient_save_error(tmp_path):
+    registry = TelemetryRegistry()
+    from llm_training_tpu.telemetry import set_registry
+
+    previous = set_registry(registry)
+    try:
+        install_chaos(ChaosConfig(checkpoint_error_steps=(1,)), registry=registry)
+        ckpt = _checkpointer(tmp_path, save_retries=2)
+        ckpt.save(1, _tiny_state(1.0))  # first attempt injected, retry lands
+        assert registry.counter("checkpoint/retries").value == 1
+        assert registry.counter("resilience/chaos_injections").value == 1
+        assert ckpt.latest_step() == 1
+        ckpt.close()
+    finally:
+        set_registry(previous)
+
+
+def test_checkpointer_save_fails_after_retries_exhausted(tmp_path):
+    install_chaos(ChaosConfig(checkpoint_error_prob=1.0))  # every attempt fails
+    ckpt = _checkpointer(tmp_path, save_retries=2)
+    with pytest.raises(ChaosError):
+        ckpt.save(1, _tiny_state(1.0))
+
+
+def test_restore_falls_back_to_previous_step_on_corrupt_latest(tmp_path):
+    registry = TelemetryRegistry()
+    from llm_training_tpu.telemetry import set_registry
+
+    previous = set_registry(registry)
+    try:
+        ckpt = _checkpointer(tmp_path)
+        ckpt.save(1, _tiny_state(1.0))
+        ckpt.save(2, _tiny_state(2.0))
+        # simulate a preemption mid-commit: the newest step dir loses its
+        # state payload
+        import shutil
+
+        state_dir = next((tmp_path / "2").glob("state*"))
+        shutil.rmtree(state_dir)
+        state, shardings = _restore_args(_tiny_state(0.0))
+        # an EXPLICIT step request must not silently fall back (checked
+        # first: the implicit restore below deletes the corrupt step)
+        with pytest.raises(Exception):
+            ckpt.maybe_restore(state, shardings, step=2)
+        restored, meta = ckpt.maybe_restore(state, shardings)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), 1.0)
+        assert meta["step"] == 1
+        assert registry.counter("resilience/restore_fallbacks").value >= 1
+        # the unrestorable step is dropped, so the resumed run's next save
+        # at step 2 is not skipped by the already-exists early return —
+        # the corruption gets repaired instead of poisoning the dir forever
+        assert 2 not in ckpt.manager.all_steps()
+        ckpt.save(2, _tiny_state(5.0))
+        restored, meta = ckpt.maybe_restore(state, shardings)
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), 5.0)
+        assert meta["step"] == 2
+        ckpt.close()
+    finally:
+        set_registry(previous)
+
+
+def test_restore_repair_false_keeps_corrupt_step(tmp_path):
+    """Read-only callers (the validate CLI) must not mutate the checkpoint
+    dir: fallback still works, but the corrupt step stays in place."""
+    ckpt = _checkpointer(tmp_path)
+    ckpt.save(1, _tiny_state(1.0))
+    ckpt.save(2, _tiny_state(2.0))
+    import shutil
+
+    shutil.rmtree(next((tmp_path / "2").glob("state*")))
+    state, shardings = _restore_args(_tiny_state(0.0))
+    restored, meta = ckpt.maybe_restore(state, shardings, repair=False)
+    assert meta["step"] == 1
+    assert 2 in ckpt.manager.all_steps()  # NOT deleted
+    ckpt.close()
+
+
+def test_restore_retries_transient_error_without_fallback(tmp_path, monkeypatch):
+    """A one-off I/O blip during restore must be retried, NOT misclassified
+    as corruption (which would fall back AND delete the good newest step)."""
+    registry = TelemetryRegistry()
+    from llm_training_tpu.telemetry import set_registry
+
+    previous = set_registry(registry)
+    try:
+        ckpt = _checkpointer(tmp_path, save_retries=2)
+        ckpt.save(1, _tiny_state(1.0))
+        ckpt.save(2, _tiny_state(2.0))
+        real_restore = ckpt.manager.restore
+        calls = {"n": 0}
+
+        def flaky(step, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionError("transient storage blip")
+            return real_restore(step, *args, **kwargs)
+
+        monkeypatch.setattr(ckpt.manager, "restore", flaky)
+        state, shardings = _restore_args(_tiny_state(0.0))
+        restored, meta = ckpt.maybe_restore(state, shardings)
+        assert meta["step"] == 2  # the newest step, not a fallback
+        np.testing.assert_array_equal(np.asarray(restored.params["w"]), 2.0)
+        assert 2 in ckpt.manager.all_steps()  # and it was NOT deleted
+        assert registry.counter("checkpoint/retries").value == 1
+        assert registry.counter("resilience/restore_fallbacks").value == 0
+        ckpt.close()
+    finally:
+        set_registry(previous)
+
+
+def test_restore_raises_when_every_step_is_corrupt(tmp_path):
+    ckpt = _checkpointer(tmp_path, max_to_keep=1)
+    ckpt.save(1, _tiny_state(1.0))
+    import shutil
+
+    shutil.rmtree(next((tmp_path / "1").glob("state*")))
+    state, shardings = _restore_args(_tiny_state(0.0))
+    with pytest.raises(RuntimeError, match="failed to restore"):
+        ckpt.maybe_restore(state, shardings)
+
+
+def test_async_error_surfaces_at_next_save_point(tmp_path, monkeypatch):
+    ckpt = _checkpointer(tmp_path, async_save=True)
+    ckpt.save(1, _tiny_state(1.0))
+    ckpt.wait()
+
+    def boom():
+        raise RuntimeError("background save failed")
+
+    monkeypatch.setattr(ckpt.manager, "check_for_errors", boom)
+    with pytest.raises(RuntimeError, match="background save failed"):
+        ckpt.save(2, _tiny_state(2.0))
+
+
+def test_close_waits_for_inflight_async_save(tmp_path):
+    ckpt = _checkpointer(tmp_path, async_save=True)
+    ckpt.save(3, _tiny_state(3.0))
+    ckpt.close()  # must barrier first — the save below must be durable
+    ckpt2 = _checkpointer(tmp_path)
+    assert ckpt2.latest_step() == 3
+    ckpt2.close()
+
+
+# ---------------------------------------------------------------- prefetcher
+
+
+def _batch_stream(n):
+    for i in range(n):
+        yield {"x": np.full((2,), i, np.float32)}
+
+
+def test_prefetcher_retries_transient_data_errors():
+    from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+    registry = TelemetryRegistry()
+    install_chaos(ChaosConfig(data_error_steps=(1,)), registry=registry)
+    beats = []
+    pf = DevicePrefetcher(
+        _batch_stream(3), None, depth=2, registry=registry,
+        retries=2, retry_backoff_s=0.0, heartbeat=lambda: beats.append(1),
+    )
+    got = [np.asarray(batch["x"])[0] for batch, _ in pf]
+    assert got == [0.0, 1.0, 2.0]  # nothing lost across the injected fault
+    assert registry.counter("data/retries").value == 1
+    assert len(beats) == 3
+
+
+def test_prefetcher_surfaces_real_generator_error_despite_retries():
+    """A transient error raised INSIDE a generator closes it; the retries'
+    re-pulls then see StopIteration. That must surface the ORIGINAL error,
+    not truncate the stream into a silent clean-looking end."""
+    from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+    def stream():
+        yield {"x": np.zeros((2,), np.float32)}
+        raise OSError("mid-epoch storage failure")
+
+    pf = DevicePrefetcher(
+        stream(), None, depth=2, registry=TelemetryRegistry(),
+        retries=2, retry_backoff_s=0.0,
+    )
+    got = 0
+    with pytest.raises(OSError, match="mid-epoch storage failure"):
+        for _ in pf:
+            got += 1
+    assert got == 1  # the good batch still arrived
+
+
+def test_prefetcher_default_zero_retries_surfaces_error():
+    from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+    install_chaos(ChaosConfig(data_error_steps=(1,)))
+    pf = DevicePrefetcher(_batch_stream(3), None, depth=2, registry=TelemetryRegistry())
+    with pytest.raises(ChaosError):
+        for _ in pf:
+            pass
+    pf.close()
+
+
+# ---------------------------------------------------------------- config/report
+
+
+def test_trainer_config_carries_resilience():
+    from llm_training_tpu.trainer import TrainerConfig
+
+    config = TrainerConfig(
+        resilience={"watchdog_timeout_s": 120, "data_retries": 2,
+                    "chaos": {"sigterm_step": 4}}
+    )
+    assert config.resilience.watchdog_timeout_s == 120
+    assert config.resilience.chaos.sigterm_step == 4
+    with pytest.raises(Exception):
+        TrainerConfig(resilience={"watchdong_timeout_s": 1})  # typo rejected
+    with pytest.raises(Exception):
+        ResilienceConfig(watchdog_action="panic")
+
+
+def test_report_renders_resilience_section(tmp_path):
+    import json
+
+    from llm_training_tpu.telemetry.report import render_report
+
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "loss": 2.0, "steps_per_sec": 1.0}) + "\n"
+    )
+    (tmp_path / "telemetry.jsonl").write_text(
+        json.dumps({
+            "step": 1, "goodput/total_s": 10.0, "goodput/step_compute_s": 8.0,
+            "resilience/preemptions": 1.0, "resilience/emergency_saves": 1.0,
+            "data/retries": 3.0, "checkpoint/retries": 2.0,
+        }) + "\n"
+    )
+    report = render_report(tmp_path)
+    assert "== Resilience ==" in report
+    assert "preemptions (graceful shutdowns): 1" in report
+    assert "data-source retries: 3" in report
+    assert "checkpoint I/O retries: 2" in report
+
+
+def test_report_omits_resilience_section_for_clean_runs(tmp_path):
+    import json
+
+    from llm_training_tpu.telemetry.report import render_report
+
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "loss": 2.0}) + "\n"
+    )
+    (tmp_path / "telemetry.jsonl").write_text(
+        json.dumps({"step": 1, "goodput/total_s": 10.0,
+                    "resilience/preemptions": 0.0, "data/retries": 0.0}) + "\n"
+    )
+    assert "== Resilience ==" not in render_report(tmp_path)
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def test_cli_maps_preemption_to_resumable_exit_code(tmp_path, monkeypatch):
+    from llm_training_tpu.cli.main import main
+    from llm_training_tpu.trainer import Trainer
+
+    config = {
+        "trainer": {"max_steps": 2},
+        "model": {
+            "class_path": "llm_training_tpu.lms.CLM",
+            "init_args": {
+                "model": {
+                    "model_class": "llm_training_tpu.models.Llama",
+                    "model_kwargs": {
+                        "vocab_size": 64, "hidden_size": 16,
+                        "intermediate_size": 32, "num_hidden_layers": 1,
+                        "num_attention_heads": 2, "num_key_value_heads": 2,
+                        "max_position_embeddings": 32,
+                    },
+                },
+                "optim": {"learning_rate": 1e-3},
+            },
+        },
+        "data": {
+            "class_path": "llm_training_tpu.data.DummyDataModule",
+            "init_args": {"batch_size": 8, "max_length": 16, "num_samples": 16,
+                          "vocab_size": 64},
+        },
+    }
+    path = tmp_path / "config.yaml"
+    path.write_text(yaml.safe_dump(config))
+
+    def fake_fit(self, objective, datamodule, resume_step=None, state=None):
+        raise PreemptionInterrupt(3, "preempted at step 3")
+
+    monkeypatch.setattr(Trainer, "fit", fake_fit)
+    assert main(["fit", "--config", str(path)]) == RESUMABLE_EXIT_CODE
+    assert RESUMABLE_EXIT_CODE == 75  # BSD EX_TEMPFAIL, the supervisor contract
+
+
+# ---------------------------------------------------------------- kill & resume
+
+
+TINY_MODEL = dict(
+    model_class="llm_training_tpu.models.Llama",
+    model_kwargs=dict(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, compute_dtype="float32",
+    ),
+)
+
+
+def _objective():
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.optim import OptimConfig
+
+    return CLM(
+        CLMConfig(
+            model=ModelProvider(**TINY_MODEL),
+            optim=OptimConfig(learning_rate=1e-3, warmup_steps=2,
+                              lr_scheduler="constant"),
+        )
+    )
+
+
+def _data():
+    from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+
+    return DummyDataModule(
+        DummyDataModuleConfig(batch_size=8, max_length=64, num_samples=64,
+                              vocab_size=256, validation_split=8)
+    )
+
+
+class _Rec:
+    def __init__(self):
+        self.losses = {}
+
+    def on_step_end(self, trainer, step, metrics):
+        self.losses[step] = float(metrics["loss"])
+
+
+@pytest.mark.slow
+def test_chaos_sigterm_kill_and_resume_is_exact(devices, tmp_path):
+    """The acceptance path: a chaos-injected SIGTERM mid-fit produces a
+    committed emergency checkpoint and PreemptionInterrupt; a fresh fit
+    resumes at the right micro-step with matching consumed counters and
+    losses identical to an uninterrupted run."""
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    rec_full = _Rec()
+    trainer = Trainer(
+        TrainerConfig(max_steps=6, log_every_n_steps=1),
+        callbacks=[rec_full],
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=str(tmp_path / "full"), async_save=False)
+        ),
+    )
+    trainer.fit(_objective(), _data())
+    full_counters = dict(trainer.counters)
+
+    # preempted at step 3 — async save proves the emergency path waits the
+    # barrier out before exiting
+    rec_a = _Rec()
+    ckpt_dir = str(tmp_path / "resume")
+    t1 = Trainer(
+        TrainerConfig(
+            max_steps=6, log_every_n_steps=1,
+            resilience=ResilienceConfig(chaos=ChaosConfig(sigterm_step=3)),
+        ),
+        callbacks=[rec_a],
+        checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir)),
+    )
+    with pytest.raises(PreemptionInterrupt) as excinfo:
+        t1.fit(_objective(), _data())
+    assert excinfo.value.step == 3
+    assert max(rec_a.losses) == 3  # stopped AT the boundary, not later
+    assert t1.telemetry.snapshot()["resilience/preemptions"] == 1
+    # the emergency checkpoint is committed and restorable
+    ckpt = Checkpointer(CheckpointConfig(dirpath=ckpt_dir))
+    assert ckpt.latest_step() == 3
+    ckpt.close()
+
+    # relaunch: resumes micro-step 3 and matches the uninterrupted run
+    rec_b = _Rec()
+    t2 = Trainer(
+        TrainerConfig(max_steps=6, log_every_n_steps=1),
+        callbacks=[rec_b],
+        checkpointer=Checkpointer(CheckpointConfig(dirpath=ckpt_dir, async_save=False)),
+    )
+    t2.fit(_objective(), _data())
+    assert sorted(rec_b.losses) == [4, 5, 6]
+    for step in range(4, 7):
+        np.testing.assert_allclose(
+            rec_b.losses[step], rec_full.losses[step], rtol=1e-6,
+            err_msg=f"step {step}",
+        )
+    assert t2.counters == full_counters
+
+
+@pytest.mark.slow
+def test_fit_retries_chaos_checkpoint_error_and_completes(devices, tmp_path):
+    """A transient checkpoint I/O fault mid-fit is retried and the run
+    completes normally, with the retry visible in telemetry."""
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=4, log_every_n_steps=1, checkpoint_every_n_steps=2,
+            resilience=ResilienceConfig(chaos=ChaosConfig(checkpoint_error_steps=(2,))),
+        ),
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=str(tmp_path), async_save=False,
+                             retry_backoff_s=0.0)
+        ),
+    )
+    state = trainer.fit(_objective(), _data())
+    assert int(jax.device_get(state.step)) == 4
+    snapshot = trainer.telemetry.snapshot()
+    assert snapshot["checkpoint/retries"] == 1
+    assert snapshot["resilience/chaos_injections"] == 1
+    ckpt = Checkpointer(CheckpointConfig(dirpath=str(tmp_path)))
+    assert ckpt.latest_step() == 4
+    ckpt.close()
+
+
+@pytest.mark.slow
+def test_fit_with_data_retries_survives_chaos_data_fault(devices, tmp_path):
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=3, log_every_n_steps=1,
+            resilience=ResilienceConfig(
+                data_retries=2, data_retry_backoff_s=0.0,
+                chaos=ChaosConfig(data_error_steps=(2,)),
+            ),
+        ),
+    )
+    state = trainer.fit(_objective(), _data())
+    assert int(jax.device_get(state.step)) == 3
+    snapshot = trainer.telemetry.snapshot()
+    assert snapshot["data/retries"] == 1
