@@ -291,6 +291,37 @@ def main() -> None:
     if os.environ.get("BENCH_HEALTH", "1") != "0":
         _, sec_health = timed_fit(health_every=1)
         health_overhead_pct = 100.0 * (sec_health - sec_per_step) / sec_per_step
+
+    # decode-path gauge (docs/inference.md): a TINY-model generate run —
+    # the headline bench model's fp32 state is torn down by the fits above,
+    # and the gauge exists to track the decode program's dispatch/step
+    # overhead trend, not model-scale decode throughput. BENCH_DECODE=0
+    # skips it.
+    prefill_time_s = decode_tokens_per_sec = None
+    if os.environ.get("BENCH_DECODE", "1") != "0":
+        from llm_training_tpu.infer import GenerateConfig, InferenceEngine
+        from llm_training_tpu.models import Llama, LlamaConfig
+
+        tiny = Llama(LlamaConfig(
+            vocab_size=2048, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512,
+            compute_dtype="float32" if not on_tpu else "bfloat16",
+        ))
+        variables = tiny.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+        engine = InferenceEngine(tiny, variables)
+        prompts = [[int(t) for t in np.arange(1, 17) + 7 * row]
+                   for row in range(4)]
+        # warm-up generate absorbs the prefill/decode compiles so the
+        # recorded prefill_time_s is a run number, not a compile number;
+        # max_length pinned so both runs share one cache shape (and so one
+        # compiled program)
+        engine.generate(prompts, GenerateConfig(max_new_tokens=4, max_length=48))
+        decode_stats = engine.generate(
+            prompts, GenerateConfig(max_new_tokens=32, max_length=48)
+        )["stats"]
+        prefill_time_s = round(decode_stats["decode/prefill_time_s"], 4)
+        decode_tokens_per_sec = round(decode_stats["decode/tokens_per_sec"], 1)
     tokens_per_step = batch * max(1, n_dev) * seq
     tokens_per_sec = tokens_per_step / sec_per_step
     tokens_per_sec_chip = tokens_per_sec / max(1, n_dev)
@@ -352,6 +383,10 @@ def main() -> None:
         "health_overhead_pct": (
             round(health_overhead_pct, 2) if health_overhead_pct is not None else None
         ),
+        # tiny-model generate gauges (None when BENCH_DECODE=0 skipped it):
+        # decode-program overhead trend, not model-scale throughput
+        "prefill_time_s": prefill_time_s,
+        "decode_tokens_per_sec": decode_tokens_per_sec,
         # global per OPTIMIZER step (the gauge is per-device per train_step
         # invocation), same units as the estimator's perf/xla_flops_per_step
         "xla_flops_per_step": (

@@ -1,4 +1,4 @@
-"""Console entry: fit / validate / report.
+"""Console entry: fit / validate / generate / evaluate / report.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -6,6 +6,11 @@ DataModule -> run, with seed_everything, logging-level control, and the
 resolved config handed to the checkpointer for embedding. `report` is a
 TPU-native addition: render a finished run's goodput/MFU/HBM summary from
 its run directory (docs/observability.md) — no config or backend needed.
+`generate` / `evaluate` (docs/inference.md) restore the run's checkpoint
+read-only and drive the inference subsystem (`llm_training_tpu.infer`):
+batched KV-cache decoding with sampling, and packed-perplexity held-out
+scoring; both merge their `decode/*` / `eval/*` telemetry into the run
+directory's telemetry.jsonl so `report` renders it.
 """
 
 from __future__ import annotations
@@ -74,6 +79,168 @@ def _build(config: dict):
     return trainer, objective, datamodule
 
 
+def _jsonl_run_dir(config: dict):
+    """Run directory of the config's JsonlLogger, or None when the run has
+    no deterministic on-disk location (no JsonlLogger node, or a
+    timestamped name). Derived through JsonlLoggerConfig itself so the
+    save_dir/project defaults can never drift from what the fit used."""
+    from pathlib import Path
+
+    from llm_training_tpu.callbacks.loggers import JsonlLoggerConfig
+
+    for node in config.get("trainer", {}).get("loggers", []) or []:
+        if str(node.get("class_path", "")).endswith("JsonlLogger"):
+            logger_config = JsonlLoggerConfig(**node.get("init_args", {}))
+            if logger_config.name:
+                return (
+                    Path(logger_config.save_dir)
+                    / logger_config.project
+                    / logger_config.name
+                )
+    return None
+
+
+def _publish_run_telemetry(config: dict, gauges: dict) -> None:
+    """Merge `decode/*` / `eval/*` gauges into the run dir's newest
+    telemetry.jsonl record (same step, keys overlaid), so `report` renders
+    them next to the fit's goodput/health numbers instead of a bare record
+    shadowing them. No-op when the config has no addressable run dir.
+    Process 0 only — run-dir artifacts follow the JsonlLogger policy
+    (N hosts appending would duplicate and interleave records)."""
+    import json
+
+    from llm_training_tpu.callbacks.loggers import _primary_host
+
+    run_dir = _jsonl_run_dir(config)
+    if run_dir is None or not gauges or not _primary_host():
+        return
+    path = run_dir / "telemetry.jsonl"
+    last: dict = {}
+    if path.exists():
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                last = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail from a killed run
+    run_dir.mkdir(parents=True, exist_ok=True)
+    record = {**last, **{k: float(v) for k, v in gauges.items()}}
+    record.setdefault("step", 0)
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    logging.getLogger(__name__).info("telemetry merged into %s", path)
+
+
+def _parse_prompts(args, config: dict) -> list[list[int]]:
+    """--prompt-tokens '3,17,42' (repeatable) and/or --prompt 'text...'
+    (repeatable; needs a resolvable tokenizer in the data config node)."""
+    prompts: list[list[int]] = []
+    for raw in args.prompt_tokens or []:
+        prompts.append([int(t) for t in raw.replace(" ", "").split(",") if t])
+    if args.prompt:
+        tokenizer_node = config.get("data", {}).get("init_args", {}).get("tokenizer")
+        if tokenizer_node is None:
+            raise SystemExit(
+                "--prompt needs a tokenizer in the config's data node; "
+                "use --prompt-tokens with raw token ids instead"
+            )
+        from llm_training_tpu.data.tokenizer import resolve_tokenizer
+
+        tokenizer = resolve_tokenizer(tokenizer_node)
+        for text in args.prompt:
+            prompts.append(list(tokenizer(text)["input_ids"]))
+    if not prompts:
+        raise SystemExit("generate needs --prompt-tokens and/or --prompt")
+    return prompts
+
+
+def _require_single_model_objective(objective, command: str) -> None:
+    """generate/evaluate drive ONE causal LM over CLM-keyed batches;
+    preference objectives (DPO's policy+ref trees, ORPO's chosen_/rejected_
+    batch keys) would fail with a KeyError deep in shape evaluation — fail
+    up front with a clear message instead."""
+    from llm_training_tpu.lms import CLM
+
+    if not isinstance(objective, CLM):
+        raise SystemExit(
+            f"{command} supports the CLM objective only; the config's model "
+            f"node builds {type(objective).__name__} — point {command} at a "
+            "config whose model node is llm_training_tpu.lms.CLM wrapping "
+            "the (policy) model"
+        )
+
+
+def _run_generate(args, config: dict) -> int:
+    import json
+
+    from llm_training_tpu.infer import GenerateConfig, InferenceEngine, SamplingConfig
+    from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+    trainer, objective, _ = _build(config)
+    _require_single_model_objective(objective, "generate")
+    prompts = _parse_prompts(args, config)
+    state = trainer.restore_for_inference(
+        objective, int(args.ckpt_path) if args.ckpt_path else None
+    )
+    engine = InferenceEngine(
+        objective.model, state.params, mesh=trainer.mesh, rules=LOGICAL_AXIS_RULES
+    )
+    generate_config = GenerateConfig(
+        max_new_tokens=args.max_new_tokens,
+        max_length=args.max_length,
+        cache_dtype=args.cache_dtype,
+        seed=args.seed,
+        eos_token_id=(
+            args.eos_token_id if args.eos_token_id is not None
+            else _scalar_eos(objective.model.config)
+        ),
+        sampling=SamplingConfig(
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+        ),
+    )
+    result = engine.generate(prompts, generate_config)
+    for row, tokens in enumerate(result["tokens"]):
+        print(json.dumps({
+            "prompt": prompts[row],
+            "tokens": tokens,
+            "sequence": result["sequences"][row],
+        }))
+    print(json.dumps({"stats": result["stats"]}))
+    _publish_run_telemetry(config, result["stats"])
+    return 0
+
+
+def _scalar_eos(model_config) -> int | None:
+    """The config's eos id when it is a single int (list-valued eos —
+    Llama-3.x instruct — would need multi-token stop support; decode then
+    runs to max_new_tokens)."""
+    eos = getattr(model_config, "eos_token_id", None)
+    return eos if isinstance(eos, int) else None
+
+
+def _run_evaluate(args, config: dict) -> int:
+    import json
+
+    from llm_training_tpu.infer import run_evaluation
+
+    trainer, objective, datamodule = _build(config)
+    _require_single_model_objective(objective, "evaluate")
+    state = trainer.restore_for_inference(
+        objective, int(args.ckpt_path) if args.ckpt_path else None
+    )
+    result = run_evaluation(
+        objective, state, datamodule, trainer.mesh,
+        state_shardings=trainer.state_shardings,
+        limit_batches=args.limit_batches,
+        split=args.split,
+    )
+    print(json.dumps(result))
+    _publish_run_telemetry(config, result)
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="llm-training-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -84,6 +251,45 @@ def main(argv: list[str] | None = None) -> int:
         p.add_argument(
             "overrides", nargs="*", help="dotted config overrides: trainer.max_steps=100"
         )
+    generate = sub.add_parser(
+        "generate", help="KV-cache decoding from a run's checkpoint"
+    )
+    generate.add_argument("--config", required=True)
+    generate.add_argument("--ckpt-path", default=None, help="checkpoint step to restore")
+    generate.add_argument(
+        "--prompt-tokens", action="append", default=None,
+        metavar="IDS", help="comma-separated token ids (repeatable)",
+    )
+    generate.add_argument(
+        "--prompt", action="append", default=None,
+        help="text prompt (repeatable; needs a tokenizer in the data config)",
+    )
+    generate.add_argument("--max-new-tokens", type=int, default=32)
+    generate.add_argument(
+        "--max-length", type=int, default=None,
+        help="KV-cache capacity (default: prompt width + max_new_tokens)",
+    )
+    generate.add_argument(
+        "--cache-dtype", default=None, choices=("param", "float32", "bfloat16"),
+        help="KV-cache storage dtype (default: the model's param dtype)",
+    )
+    generate.add_argument("--temperature", type=float, default=0.0)
+    generate.add_argument("--top-k", type=int, default=None)
+    generate.add_argument("--top-p", type=float, default=None)
+    generate.add_argument("--seed", type=int, default=0)
+    generate.add_argument(
+        "--eos-token-id", type=int, default=None,
+        help="stop token (default: the model config's scalar eos, if any)",
+    )
+    generate.add_argument("overrides", nargs="*")
+    evaluate = sub.add_parser(
+        "evaluate", help="packed perplexity / per-token NLL from a checkpoint"
+    )
+    evaluate.add_argument("--config", required=True)
+    evaluate.add_argument("--ckpt-path", default=None, help="checkpoint step to restore")
+    evaluate.add_argument("--limit-batches", type=int, default=None)
+    evaluate.add_argument("--split", default="val", choices=("val", "train"))
+    evaluate.add_argument("overrides", nargs="*")
     report = sub.add_parser("report", help="render a run summary from a run directory")
     report.add_argument("run_dir", help="dir holding metrics.jsonl / telemetry.jsonl")
     args = parser.parse_args(argv)
@@ -106,6 +312,11 @@ def main(argv: list[str] | None = None) -> int:
 
     initialize_distributed()
     _apply_extra_config(config)
+
+    if args.command == "generate":
+        return _run_generate(args, config)
+    if args.command == "evaluate":
+        return _run_evaluate(args, config)
 
     trainer, objective, datamodule = _build(config)
 

@@ -1,0 +1,47 @@
+"""Inference & evaluation subsystem (docs/inference.md).
+
+TPU-native batched decoding over the training mesh and checkpoints: a
+static-shape mesh-sharded KV cache (`cache.py` + `models/base.DecodeState`)
+threaded through the shared decoder stack, jitted prefill / decode-step
+programs with sampling (`engine.py`, `sampling.py`), and a packed-
+perplexity eval harness (`evaluate.py`) — behind the `generate` and
+`evaluate` CLI subcommands.
+
+Leaf modules (cache, sampling) import eagerly; the engine/evaluate modules
+are lazy so model files can import `llm_training_tpu.infer.cache` without
+pulling the trainer stack in (engine -> telemetry -> ... would cycle).
+"""
+
+from llm_training_tpu.infer.cache import (
+    cache_bytes,
+    decode_state_shardings,
+    init_decode_state,
+)
+from llm_training_tpu.infer.sampling import SamplingConfig, sample_tokens
+
+__all__ = [
+    "GenerateConfig",
+    "InferenceEngine",
+    "SamplingConfig",
+    "cache_bytes",
+    "decode_state_shardings",
+    "init_decode_state",
+    "run_evaluation",
+    "sample_tokens",
+    "supports_decoding",
+]
+
+_LAZY = {
+    "GenerateConfig": "llm_training_tpu.infer.engine",
+    "InferenceEngine": "llm_training_tpu.infer.engine",
+    "supports_decoding": "llm_training_tpu.infer.engine",
+    "run_evaluation": "llm_training_tpu.infer.evaluate",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

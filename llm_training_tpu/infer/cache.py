@@ -1,0 +1,130 @@
+"""KV-cache construction + sharding for the inference subsystem.
+
+The `DecodeState` pytree itself lives in `models/base.py` (next to
+`CausalLMOutput`, so model files never import `infer/`); this module owns
+everything about *building* one: sizing from a model config, the cache
+dtype policy, the mesh placement (k/v heads shard over 'tensor', batch over
+'data'/'fsdp' — the same rule table the attention activations use), and the
+HBM-footprint gauge the decode telemetry publishes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from llm_training_tpu.models.base import DecodeState, resolve_dtype
+from llm_training_tpu.parallel.sharding import LogicalAxisRules, logical_to_spec
+
+# cache buffer layout: [num_layers, batch, max_length, num_kv_heads, head_dim]
+KV_LOGICAL_AXES = ("layers", "batch", None, "kv_heads", None)
+SEG_LOGICAL_AXES = ("batch", None)
+
+
+def cache_dims(config) -> tuple[int, int, int]:
+    """(num_layers, num_kv_heads, head_dim) for any shared-stack config.
+
+    Gemma carries a mandatory explicit `head_dim`; llama-family configs
+    derive it via `resolved_head_dim`."""
+    head_dim = getattr(config, "resolved_head_dim", None) or config.head_dim
+    return config.num_hidden_layers, config.num_key_value_heads, head_dim
+
+
+def resolve_cache_dtype(config, cache_dtype: str | None) -> jnp.dtype:
+    """None / 'param' -> the model's param dtype; otherwise an explicit
+    dtype name ('float32' for an exactness oracle, 'bfloat16' to halve the
+    cache HBM)."""
+    if cache_dtype in (None, "param"):
+        return config.param_jnp_dtype
+    return resolve_dtype(cache_dtype)
+
+
+def _divisible_spec(
+    shape: tuple[int, ...],
+    logical_axes: tuple[str | None, ...],
+    mesh: Mesh,
+    rules: LogicalAxisRules,
+) -> PartitionSpec:
+    """logical axes -> PartitionSpec, dropping any mesh axis whose ways do
+    not divide the dimension (a 1-prompt batch on an 8-way data mesh must
+    replicate, not error)."""
+    spec = logical_to_spec(logical_axes, rules)
+    out = []
+    for dim, entry in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        ways = 1
+        for axis in axes:
+            ways *= mesh.shape[axis]
+        out.append(entry if ways and dim % ways == 0 else None)
+    return PartitionSpec(*out)
+
+
+def decode_state_shardings(
+    config,
+    batch_size: int,
+    max_length: int,
+    mesh: Mesh,
+    rules: LogicalAxisRules,
+    rope_length: int | None = None,
+) -> DecodeState:
+    """A DecodeState-shaped tree of NamedShardings for jit in/out.
+    `rope_length` must match the state the shardings are used with — it is
+    static pytree metadata, so a mismatch is a structure mismatch."""
+    num_layers, kv_heads, head_dim = cache_dims(config)
+    kv_shape = (num_layers, batch_size, max_length, kv_heads, head_dim)
+    kv = NamedSharding(mesh, _divisible_spec(kv_shape, KV_LOGICAL_AXES, mesh, rules))
+    seg = NamedSharding(
+        mesh,
+        _divisible_spec((batch_size, max_length), SEG_LOGICAL_AXES, mesh, rules),
+    )
+    return DecodeState(
+        k=kv, v=kv, index=NamedSharding(mesh, PartitionSpec()), segment_ids=seg,
+        rope_length=rope_length,
+    )
+
+
+def init_decode_state(
+    config,
+    batch_size: int,
+    max_length: int,
+    mesh: Mesh | None = None,
+    rules: LogicalAxisRules | None = None,
+    cache_dtype: str | None = None,
+    rope_length: int | None = None,
+) -> DecodeState:
+    """Fresh all-zeros cache (index 0, no slot filled). With a mesh the
+    buffers are created ALREADY sharded (jit with out_shardings), so the
+    first prefill never materializes a replicated cache. `rope_length` is
+    the planned total sequence length when it is shorter than the cache
+    capacity (length-dependent RoPE variants select tables from it)."""
+    num_layers, kv_heads, head_dim = cache_dims(config)
+    dtype = resolve_cache_dtype(config, cache_dtype)
+
+    def build() -> DecodeState:
+        kv_shape = (num_layers, batch_size, max_length, kv_heads, head_dim)
+        return DecodeState(
+            k=jnp.zeros(kv_shape, dtype),
+            v=jnp.zeros(kv_shape, dtype),
+            index=jnp.int32(0),
+            segment_ids=jnp.zeros((batch_size, max_length), jnp.int32),
+            rope_length=rope_length,
+        )
+
+    if mesh is None:
+        return build()
+    shardings = decode_state_shardings(
+        config, batch_size, max_length, mesh, rules or (), rope_length=rope_length
+    )
+    return jax.jit(build, out_shardings=shardings)()
+
+
+def cache_bytes(state: DecodeState) -> int:
+    """Global HBM footprint of the cache buffers (the `decode/cache_bytes`
+    gauge)."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in (state.k, state.v)
+    )

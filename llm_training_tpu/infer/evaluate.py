@@ -1,0 +1,128 @@
+"""Held-out evaluation: stream a datamodule through the objective.
+
+Runs inside the SAME sharded program shape as training validation (the
+pjit/TPUv4 eval-inside-the-mesh pattern, arxiv 2204.06514): one jitted
+loss step over packed batches, with the objective's segment-id masking —
+packed-document boundaries and padding never count — so the numbers are
+directly comparable to training `val_loss`.
+
+Reported per-token NLL is the token-weighted corpus mean (sum of per-token
+losses / number of target tokens), and perplexity its exp; batch means are
+re-weighted by their `target_tokens` so ragged final batches don't skew
+the aggregate. Results are published as `eval/*` registry gauges so the
+`evaluate` CLI lands them in telemetry.jsonl for `report`.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any
+
+import flax.linen as nn
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def run_evaluation(
+    objective: Any,
+    state: Any,
+    datamodule: Any,
+    mesh: Any,
+    state_shardings: Any | None = None,
+    limit_batches: int | None = None,
+    split: str = "val",
+) -> dict[str, float]:
+    """-> {eval/nll_per_token, eval/perplexity, eval/tokens, eval/batches,
+    eval/time_s, eval/tokens_per_sec}, also set as registry gauges."""
+    from llm_training_tpu.telemetry import get_registry
+    from llm_training_tpu.trainer.trainer import (
+        LOGICAL_AXIS_RULES,
+        _batch_shardings,
+    )
+
+    if split not in ("val", "train"):
+        raise ValueError(f"split must be 'val' or 'train', got {split!r}")
+    if split == "train" and not limit_batches:
+        raise ValueError(
+            "split='train' streams an infinite batch sequence; set "
+            "limit_batches"
+        )
+    datamodule.setup()
+    batches = (
+        datamodule.val_batches() if split == "val"
+        else datamodule.train_batches()
+    )
+
+    def eval_step(state, batch):
+        _, metrics = objective.loss_and_metrics(
+            state.params, batch, rng=state.rng, train=False
+        )
+        loss = metrics["loss"]
+        if "aux_loss" in metrics:
+            # MoE configs fold coef*aux_loss into metrics['loss'] (clm.py);
+            # a PERPLEXITY must be exp of the token-level cross entropy
+            # only — same convention as clm's own `perplexity` metric —
+            # so back the balancing penalty out (exact reversal up to one
+            # fp32 rounding; the trainer's val_loss keeps the penalty, so
+            # eval/nll_per_token may differ from it by coef*aux on MoE)
+            coef = getattr(
+                objective.model.config, "router_aux_loss_coef", 0.0
+            )
+            loss = loss - coef * metrics["aux_loss"]
+        return {
+            "loss": loss,
+            "target_tokens": metrics["target_tokens"],
+        }
+
+    total_nll = 0.0
+    total_tokens = 0.0
+    n_batches = 0
+    t0 = time.perf_counter()
+    with mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+        step_fn = None
+        for i, batch in enumerate(batches):
+            if limit_batches and i >= limit_batches:
+                break
+            if step_fn is None:
+                in_shardings = (
+                    (state_shardings, _batch_shardings(batch, mesh))
+                    if state_shardings is not None
+                    else None
+                )
+                step_fn = jax.jit(eval_step, in_shardings=in_shardings)
+            out = jax.device_get(step_fn(state, batch))
+            tokens = float(out["target_tokens"])
+            total_nll += float(out["loss"]) * tokens
+            total_tokens += tokens
+            n_batches += 1
+    elapsed = time.perf_counter() - t0
+    if n_batches == 0:
+        raise ValueError(
+            f"datamodule produced no {split} batches "
+            "(set validation_split or provide a val dataset)"
+        )
+
+    nll = total_nll / max(total_tokens, 1.0)
+    result = {
+        "eval/nll_per_token": nll,
+        "eval/perplexity": float(np.exp(np.minimum(nll, 700.0))) if math.isfinite(nll) else float("inf"),
+        "eval/tokens": total_tokens,
+        "eval/batches": float(n_batches),
+        "eval/time_s": elapsed,
+        "eval/tokens_per_sec": total_tokens / elapsed if elapsed > 0 else 0.0,
+    }
+    registry = get_registry()
+    for key, value in result.items():
+        if math.isfinite(value):
+            registry.gauge(key).set(value)
+    logger.info(
+        "evaluate[%s]: nll/token %.4f | ppl %.2f | %d tokens in %d batches "
+        "(%.1f tok/s)",
+        split, nll, result["eval/perplexity"], int(total_tokens), n_batches,
+        result["eval/tokens_per_sec"],
+    )
+    return result

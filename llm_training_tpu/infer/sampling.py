@@ -1,0 +1,86 @@
+"""Token sampling for the decode loop: greedy, temperature, top-k, top-p.
+
+All transforms operate on the fp32 next-token logits [batch, vocab] INSIDE
+the jitted decode program, under an explicit PRNG key (no global state —
+`jax.random.fold_in(key, step)` gives each step its stream, so a generation
+is reproducible from (params, prompt, seed) alone). temperature == 0 is
+greedy argmax and compiles with no random bits at all.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from pydantic import BaseModel, ConfigDict, model_validator
+
+_FILTERED = -1e10  # large-negative fill for filtered logits (fp32-safe)
+
+
+class SamplingConfig(BaseModel):
+    """The sampling knobs of the `generate` CLI (docs/inference.md).
+
+    Filters compose HF-style (the default LogitsProcessor order):
+    temperature scaling FIRST — the top-p nucleus must be computed on the
+    temperature-warped distribution, or a high temperature would keep the
+    narrow temperature-1 nucleus — then top_k, then top_p over the
+    survivors, then the categorical draw. temperature=0.0 (the default) is
+    deterministic greedy decoding and ignores the filters."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+
+    @model_validator(mode="after")
+    def _validate(self) -> "SamplingConfig":
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        return self
+
+
+def top_k_filter(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Keep each row's k largest logits; fill the rest with -inf-like."""
+    if k >= logits.shape[-1]:
+        return logits
+    threshold = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits >= threshold, logits, _FILTERED)
+
+
+def top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest prefix of the probability-sorted
+    vocab whose mass reaches p (the token that crosses the boundary is kept,
+    HF semantics), fill the rest with -inf-like."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # exclusive cumsum: a token survives if the mass BEFORE it is < p, so
+    # the first token always survives and the boundary-crossing token stays
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = mass_before < p
+    # threshold = smallest kept logit per row
+    threshold = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits >= threshold, logits, _FILTERED)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    rng: jax.Array | None,
+    config: SamplingConfig,
+) -> jnp.ndarray:
+    """logits [batch, vocab] (fp32) -> token ids [batch] int32."""
+    if config.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("temperature > 0 sampling requires a PRNG key")
+    logits = logits / jnp.float32(config.temperature)
+    if config.top_k is not None:
+        logits = top_k_filter(logits, config.top_k)
+    if config.top_p is not None:
+        logits = top_p_filter(logits, config.top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -23,7 +23,12 @@ from llm_training_tpu.optim.builder import OptimConfig
 @runtime_checkable
 class CausalLM(Protocol):
     """Structural protocol for anything an objective can drive
-    (reference `lms/protos/clm_proto.py:9-26`)."""
+    (reference `lms/protos/clm_proto.py:9-26`).
+
+    `decode_state` (a `models.base.DecodeState` KV cache) is OPTIONAL for
+    implementations: families that accept it opt into the inference
+    engine's prefill/decode programs; `infer.engine.supports_decoding`
+    checks for it and raises NotImplementedError otherwise."""
 
     def __call__(
         self,

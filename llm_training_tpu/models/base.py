@@ -99,6 +99,51 @@ class RouterStats:
 
 
 @flax.struct.dataclass
+class DecodeState:
+    """Static-shape, mesh-sharded KV cache threaded through the decoder
+    stack for autoregressive decoding (`infer/` subsystem, docs/inference.md).
+
+    `k`/`v` are `[num_layers, batch, max_length, num_kv_heads, head_dim]`
+    buffers in the cache dtype (param dtype by default, fp32/bf16
+    configurable); the leading layer axis is the scan axis under
+    `scan_layers` and an indexed axis on the looped path, sharded like the
+    scanned param stacks (replicated), while heads shard over 'tensor' and
+    batch over 'data'/'fsdp' exactly like attention activations.
+
+    `index` is a traced int32 scalar: the number of tokens already written,
+    i.e. the absolute kv position the incoming chunk appends at. It is
+    SHARED across the batch — prompts are LEFT-padded to a common width so
+    every row appends at the same slot (per-row write offsets would need a
+    scatter instead of one `dynamic_update_slice`). `segment_ids [batch,
+    max_length]` marks which cache slots hold real tokens (1) vs left-pad /
+    not-yet-written garbage (0); the attention mask's `seg > 0` term makes
+    unwritten slots unreachable, and the causal term (`q_offset = index`)
+    keeps the chunk from seeing slots written after it."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    index: jnp.ndarray
+    segment_ids: jnp.ndarray
+    # STATIC (not a pytree leaf): the sequence length the generation will
+    # actually reach (padded prompt width + max_new_tokens). Length-
+    # dependent RoPE variants (longrope short/long factor selection,
+    # dynamic NTK) must key off THIS, not the cache capacity — a cache
+    # over-allocated for reuse (max_length >> planned length) must not
+    # flip a Phi-3 checkpoint onto its long-context tables. None = fall
+    # back to the cache capacity.
+    rope_length: int | None = flax.struct.field(pytree_node=False, default=None)
+
+    @property
+    def max_length(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def table_length(self) -> int:
+        """The length RoPE table selection should see (static)."""
+        return self.rope_length or self.max_length
+
+
+@flax.struct.dataclass
 class CausalLMOutput:
     """Forward output (reference `modeling_outputs.py:11-13`).
 
@@ -110,10 +155,13 @@ class CausalLMOutput:
     dense models; exactly 0 when ep=1 or routing fits the buffer) — the
     observability VERDICT r4 asked for on the static-capacity EP path.
     `router_stats` carries the pre-pooled per-layer router statistics
-    (None for dense models) for the health-metric layer."""
+    (None for dense models) for the health-metric layer. `decode_state` is
+    the updated KV cache when the forward was called with one (None on the
+    training path)."""
 
     logits: jnp.ndarray | None = None
     last_hidden_states: jnp.ndarray | None = None
     aux_loss: jnp.ndarray | None = None
     ep_dropped_rows: jnp.ndarray | None = None
     router_stats: RouterStats | None = None
+    decode_state: DecodeState | None = None

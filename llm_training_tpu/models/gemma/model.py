@@ -32,7 +32,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput
+from llm_training_tpu.models.base import CausalLMOutput, DecodeState
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.gemma.config import GemmaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention
@@ -75,11 +75,16 @@ def _dense(config: GemmaConfig, features: int, logical_axes: tuple[str, str], na
 
 
 class GemmaAttention(nn.Module):
+    """KV-cache args (`layer_kv`/`kv_index`/`kv_segment_ids`) follow the
+    shared-stack convention — see `llama/model.py:LlamaAttention`; with a
+    cache the call returns `(out, new_layer_kv)`."""
+
     config: GemmaConfig
     sliding_window: int | None
 
     @nn.compact
-    def __call__(self, hidden, segment_ids, cos, sin):
+    def __call__(self, hidden, segment_ids, cos, sin,
+                 layer_kv=None, kv_index=None, kv_segment_ids=None):
         cfg = self.config
         batch, seq, _ = hidden.shape
         q = _dense(cfg, cfg.num_attention_heads * cfg.head_dim, ("embed", "heads"), "q_proj")(hidden)
@@ -93,6 +98,30 @@ class GemmaAttention(nn.Module):
             q = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
             k = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
         q, k = apply_rope(q, k, cos, sin)
+        if layer_kv is not None:
+            ck, cv = layer_kv
+            ck = jax.lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, kv_index, 0, 0)
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, kv_index, 0, 0)
+            )
+            out = dot_product_attention(
+                q, ck.astype(k.dtype), cv.astype(v.dtype),
+                segment_ids=kv_segment_ids,
+                q_segment_ids=segment_ids,
+                causal=True,
+                sliding_window=self.sliding_window,
+                logits_soft_cap=cfg.attn_logit_softcapping,
+                scale=cfg.attention_scale,
+                q_offset=kv_index,
+                impl="xla",
+            )
+            out = out.astype(hidden.dtype).reshape(
+                batch, seq, cfg.num_attention_heads * cfg.head_dim
+            )
+            out = _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj")(out)
+            return out, (ck, cv)
         out = None
         if getattr(cfg, "ring_attention", False):
             from llm_training_tpu.parallel.ring_attention import (
@@ -138,34 +167,44 @@ class GemmaDecoderLayer(nn.Module):
     sliding_window: int | None
 
     @nn.compact
-    def __call__(self, hidden, segment_ids, cos, sin):
+    def __call__(self, hidden, segment_ids, cos, sin,
+                 layer_kv=None, kv_index=None, kv_segment_ids=None):
         cfg = self.config
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
         norm = lambda name: GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
 
         attn_in = norm("input_layernorm")(hidden)
         attn_out = GemmaAttention(cfg, self.sliding_window, name="self_attn")(
-            attn_in, segment_ids, cos, sin
+            attn_in, segment_ids, cos, sin, layer_kv, kv_index, kv_segment_ids
         )
+        new_kv = None
+        if layer_kv is not None:
+            attn_out, new_kv = attn_out
         if cfg.version in (2, 3):
             attn_out = norm("post_attention_layernorm")(attn_out)
             hidden = hidden + attn_out
             mlp_in = norm("pre_feedforward_layernorm")(hidden)
             mlp_out = norm("post_feedforward_layernorm")(GemmaMLP(cfg, name="mlp")(mlp_in))
-            return hidden + mlp_out
-        hidden = hidden + attn_out
-        mlp_in = norm("post_attention_layernorm")(hidden)
-        return hidden + GemmaMLP(cfg, name="mlp")(mlp_in)
+            hidden = hidden + mlp_out
+        else:
+            hidden = hidden + attn_out
+            mlp_in = norm("post_attention_layernorm")(hidden)
+            hidden = hidden + GemmaMLP(cfg, name="mlp")(mlp_in)
+        if layer_kv is not None:
+            return hidden, new_kv
+        return hidden
 
 
 class _ScannedBody(nn.Module):
     """Scan body: one layer (gemma 1 / windowless gemma 2) or a
-    (sliding, full) pair (gemma 2 with sliding_window)."""
+    (sliding, full) pair (gemma 2 with sliding_window). ys is the updated
+    KV slice when decoding, else None."""
 
     config: GemmaConfig
 
     @nn.compact
-    def __call__(self, hidden, segment_ids, cos, sin):
+    def __call__(self, hidden, segment_ids, cos, sin,
+                 layer_kv=None, kv_index=None, kv_segment_ids=None):
         cfg = self.config
         if cfg.version == 2 and cfg.sliding_window:
             hidden = GemmaDecoderLayer(cfg, cfg.sliding_window, name="sliding")(
@@ -174,11 +213,13 @@ class _ScannedBody(nn.Module):
             hidden = GemmaDecoderLayer(cfg, None, name="full")(
                 hidden, segment_ids, cos, sin
             )
-        else:
-            hidden = GemmaDecoderLayer(cfg, None, name="layer")(
-                hidden, segment_ids, cos, sin
-            )
-        return hidden, None
+            return hidden, None
+        out = GemmaDecoderLayer(cfg, None, name="layer")(
+            hidden, segment_ids, cos, sin, layer_kv, kv_index, kv_segment_ids
+        )
+        if layer_kv is not None:
+            return out  # (hidden, new_kv)
+        return out, None
 
 
 
@@ -188,25 +229,49 @@ class Gemma(nn.Module):
 
     config: GemmaConfig
 
-    def _layers(self, hidden, segment_ids, cos, sin, cos_local, sin_local):
+    def _layers(self, hidden, segment_ids, cos, sin, cos_local, sin_local,
+                decode_kv=None, kv_index=None, kv_segment_ids=None):
         cfg = self.config
         policy = _remat_policy(cfg)
         paired = cfg.version == 2 and cfg.sliding_window
+        new_kv = None
         if cfg.scan_layers:
+            if decode_kv is not None and paired:
+                raise NotImplementedError(
+                    "KV-cache decoding of gemma-2's paired (sliding, full) "
+                    "scan body is not supported; its cache layer axis would "
+                    "have to fold into [L/2, 2] pairs"
+                )
             body = _ScannedBody
             if policy is not None:
                 body = nn.remat(_ScannedBody, policy=policy, prevent_cse=False)
             length = cfg.num_hidden_layers // 2 if paired else cfg.num_hidden_layers
-            scanned = nn.scan(
-                body,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-                length=length,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="layers")
-            hidden, _ = scanned(hidden, segment_ids, cos, sin)
-            return hidden
+            if decode_kv is None:
+                scanned = nn.scan(
+                    body,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True},
+                    in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                    length=length,
+                    metadata_params={nn.PARTITION_NAME: "layers"},
+                )(cfg, name="layers")
+                hidden, _ = scanned(hidden, segment_ids, cos, sin)
+            else:
+                scanned = nn.scan(
+                    body,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True},
+                    in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, 0,
+                             nn.broadcast, nn.broadcast),
+                    length=length,
+                    metadata_params={nn.PARTITION_NAME: "layers"},
+                )(cfg, name="layers")
+                hidden, new_kv = scanned(
+                    hidden, segment_ids, cos, sin, decode_kv, kv_index,
+                    kv_segment_ids,
+                )
+            return hidden, new_kv
+        kv_slices = []
         for i in range(cfg.num_hidden_layers):
             layer_cls = GemmaDecoderLayer
             if policy is not None:
@@ -216,10 +281,19 @@ class Gemma(nn.Module):
             lcos, lsin = (
                 (cos_local, sin_local) if cfg.version == 3 and window else (cos, sin)
             )
+            layer_kv = (
+                None if decode_kv is None
+                else jax.tree.map(lambda a: a[i], decode_kv)
+            )
             hidden = layer_cls(
                 cfg, window, name=f"layers_{i}"
-            )(hidden, segment_ids, lcos, lsin)
-        return hidden
+            )(hidden, segment_ids, lcos, lsin, layer_kv, kv_index, kv_segment_ids)
+            if decode_kv is not None:
+                hidden, layer_new_kv = hidden
+                kv_slices.append(layer_new_kv)
+        if kv_slices:
+            new_kv = jax.tree.map(lambda *xs: jnp.stack(xs), *kv_slices)
+        return hidden, new_kv
 
     @nn.compact
     def __call__(
@@ -230,6 +304,7 @@ class Gemma(nn.Module):
         inputs_embeds: jnp.ndarray | None = None,
         compute_logits: bool = True,
         return_last_hidden_states: bool = False,
+        decode_state: DecodeState | None = None,
     ) -> CausalLMOutput:
         cfg = self.config
         embed_tokens = nn.Embed(
@@ -251,22 +326,49 @@ class Gemma(nn.Module):
         hidden = inputs_embeds * normalizer
         seq = hidden.shape[1]
 
+        kv_segment_ids = None
+        if decode_state is not None:
+            # shared-stack KV-cache convention (llama/model.py): merge the
+            # chunk's segment ids into the cache's filled-slot map up front
+            if segment_ids is None:
+                segment_ids = jnp.ones((hidden.shape[0], seq), jnp.int32)
+            kv_segment_ids = jax.lax.dynamic_update_slice(
+                decode_state.segment_ids, segment_ids.astype(jnp.int32),
+                (0, decode_state.index),
+            )
+
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
+        rope_len = seq if decode_state is None else decode_state.table_length
         inv_freq, attention_scaling = compute_rope_frequencies(
-            cfg.rope_config, seq_len=seq
+            cfg.rope_config, seq_len=rope_len
         )
         cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
         cos_local = sin_local = None
         if cfg.version == 3:
             inv_freq_l, scaling_l = compute_rope_frequencies(
-                cfg.local_rope_config, seq_len=seq
+                cfg.local_rope_config, seq_len=rope_len
             )
             cos_local, sin_local = compute_rope_cos_sin(
                 inv_freq_l, position_ids, scaling_l
             )
 
-        hidden = self._layers(hidden, segment_ids, cos, sin, cos_local, sin_local)
+        hidden, new_kv = self._layers(
+            hidden, segment_ids, cos, sin, cos_local, sin_local,
+            decode_kv=(
+                None if decode_state is None
+                else (decode_state.k, decode_state.v)
+            ),
+            kv_index=None if decode_state is None else decode_state.index,
+            kv_segment_ids=kv_segment_ids,
+        )
+        new_decode_state = None
+        if decode_state is not None:
+            new_decode_state = decode_state.replace(
+                k=new_kv[0], v=new_kv[1],
+                index=decode_state.index + seq,
+                segment_ids=kv_segment_ids,
+            )
         hidden = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
 
@@ -281,6 +383,7 @@ class Gemma(nn.Module):
         return CausalLMOutput(
             logits=logits,
             last_hidden_states=hidden if return_last_hidden_states else None,
+            decode_state=new_decode_state,
         )
 
     def get_input_embeddings_path(self) -> str:

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llm_training_tpu.models.base import CausalLMOutput, RouterStats
+from llm_training_tpu.models.base import CausalLMOutput, DecodeState, RouterStats
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.llama.config import LlamaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention, rms_norm
@@ -146,7 +146,15 @@ class LlamaAttention(nn.Module):
 
     Also serves Phi-3 (reference `phi3_model.py:436-480`): the config may
     carry `sliding_window` and `attention_compute_dtype` (Phi-3's SDPA
-    upcast workaround, `phi3_model.py:172-187`)."""
+    upcast workaround, `phi3_model.py:172-187`).
+
+    KV-cache decoding (docs/inference.md): `layer_kv` is this layer's
+    `(k, v)` cache buffers `[batch, max_length, kv_heads, head_dim]`;
+    `kv_index` the shared append position and `kv_segment_ids` the cache's
+    filled-slot ids (already including the incoming chunk). When given, the
+    post-RoPE k/v are appended at `kv_index` and attention runs against the
+    whole cache with `q_offset = kv_index`, and the call returns
+    `(out, new_layer_kv)` instead of `out`."""
 
     config: LlamaConfig
     sliding_window_override: int | None | str = "unset"
@@ -158,6 +166,9 @@ class LlamaAttention(nn.Module):
         segment_ids: jnp.ndarray | None,
         cos: jnp.ndarray,
         sin: jnp.ndarray,
+        layer_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        kv_index: jnp.ndarray | None = None,
+        kv_segment_ids: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         cfg = self.config
         head_dim = cfg.resolved_head_dim
@@ -236,10 +247,49 @@ class LlamaAttention(nn.Module):
             dtype = resolve_dtype(attention_dtype)
             q, k, v = q.astype(dtype), k.astype(dtype), v.astype(dtype)
 
-        out = self._attention(q, k, v, segment_ids)
+        new_layer_kv = None
+        if layer_kv is not None:
+            out, new_layer_kv = self._cached_attention(
+                q, k, v, segment_ids, layer_kv, kv_index, kv_segment_ids
+            )
+        else:
+            out = self._attention(q, k, v, segment_ids)
         out = out.astype(hidden.dtype)
         out = out.reshape(batch, seq, cfg.num_attention_heads * head_dim)
-        return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj", cfg.attention_out_bias)(out)
+        out = _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj", cfg.attention_out_bias)(out)
+        if layer_kv is not None:
+            return out, new_layer_kv
+        return out
+
+    def _cached_attention(self, q, k, v, segment_ids, layer_kv, kv_index, kv_segment_ids):
+        """Append this chunk's k/v at `kv_index` and attend q against the
+        full static-shape cache. The causal term of the mask (q_offset =
+        kv_index) hides slots written after this chunk, and `kv_segment_ids`
+        (0 on unwritten/pad slots) hides garbage — so ONE program serves
+        both prefill (chunk at index 0) and single-token decode steps.
+        Always the XLA einsum path: the flash kernel's block tiling assumes
+        q_len ≥ a block and a static q_offset; a ragged-paged decode kernel
+        (PAPERS.md, arxiv 2604.15464) is the designated successor."""
+        cfg = self.config
+        window = (
+            getattr(cfg, "sliding_window", None)
+            if self.sliding_window_override == "unset"
+            else self.sliding_window_override
+        )
+        ck, cv = layer_kv
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, kv_index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, kv_index, 0, 0))
+        out = dot_product_attention(
+            q, ck.astype(k.dtype), cv.astype(v.dtype),
+            segment_ids=kv_segment_ids,
+            q_segment_ids=segment_ids,
+            causal=True,
+            sliding_window=window,
+            scale=getattr(cfg, "attention_multiplier", None),
+            q_offset=kv_index,
+            impl="xla",
+        )
+        return out, (ck, cv)
 
     def _attention(self, q, k, v, segment_ids):
         """Dispatch: ring attention over a sequence-sharded mesh when enabled,
@@ -333,7 +383,12 @@ class LlamaMLP(nn.Module):
 
 
 class LlamaDecoderLayer(nn.Module):
-    """Pre-norm block (reference `llama_model.py:747-789`)."""
+    """Pre-norm block (reference `llama_model.py:747-789`).
+
+    With a KV cache (`layer_kv` et al. — see `LlamaAttention`) the layer
+    returns `(hidden, (aux, new_layer_kv))`; without one the return stays
+    `(hidden, aux)` and the traced graph is identical to before the cache
+    existed."""
 
     config: LlamaConfig
     sliding_window_override: int | None | str = "unset"
@@ -345,14 +400,33 @@ class LlamaDecoderLayer(nn.Module):
         segment_ids: jnp.ndarray | None,
         cos: jnp.ndarray,
         sin: jnp.ndarray,
+        layer_kv: tuple[jnp.ndarray, jnp.ndarray] | None = None,
+        kv_index: jnp.ndarray | None = None,
+        kv_segment_ids: jnp.ndarray | None = None,
     ) -> jnp.ndarray:
         cfg = self.config
         hidden = nn.with_logical_constraint(hidden, ("batch", "act_seq", "act_embed"))
         norm = lambda name: _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name=name)
 
-        attention = lambda name: LlamaAttention(
-            cfg, self.sliding_window_override, name=name
-        )
+        new_kv = [None]  # box: written by whichever branch runs attention
+
+        def attention(name):
+            module = LlamaAttention(cfg, self.sliding_window_override, name=name)
+
+            def run(x, seg, c, s):
+                if layer_kv is None:
+                    return module(x, seg, c, s)
+                out, new_kv[0] = module(
+                    x, seg, c, s, layer_kv, kv_index, kv_segment_ids
+                )
+                return out
+
+            return run
+
+        def pack(hidden, aux):
+            if layer_kv is None:
+                return hidden, aux
+            return hidden, (aux, new_kv[0])
 
         def mlp(x):
             """(out, aux): MoE block returns per-layer router stats
@@ -378,7 +452,7 @@ class LlamaDecoderLayer(nn.Module):
             attn = attention("self_attn")(normed, segment_ids, cos, sin)
             mlp_out, aux = mlp(normed)
             hidden = hidden + join(attn) + join(mlp_out)
-            return hidden, aux
+            return pack(hidden, aux)
         if cfg.norm_scheme == "parallel2":
             # GPT-NeoX: TWO norms over the SAME block input feed attention
             # and mlp in parallel; one residual join
@@ -387,7 +461,7 @@ class LlamaDecoderLayer(nn.Module):
             )
             mlp_out, aux = mlp(norm("post_attention_layernorm")(hidden))
             hidden = hidden + join(attn) + join(mlp_out)
-            return hidden, aux
+            return pack(hidden, aux)
         if cfg.norm_scheme == "sandwich":
             # GLM-4: pre-norm AND output-norm around both blocks
             normed = norm("input_layernorm")(hidden)
@@ -396,7 +470,7 @@ class LlamaDecoderLayer(nn.Module):
             normed = norm("post_attention_layernorm")(hidden)
             mlp_out, aux = mlp(normed)
             hidden = hidden + join(norm("post_mlp_layernorm")(mlp_out))
-            return hidden, aux
+            return pack(hidden, aux)
         if cfg.norm_scheme == "post":
             # OLMo-2 reordering: no input norms; normalize each block's
             # OUTPUT before it joins the residual stream
@@ -404,26 +478,30 @@ class LlamaDecoderLayer(nn.Module):
             hidden = hidden + join(norm("post_attention_layernorm")(attn))
             mlp_out, aux = mlp(hidden)
             hidden = hidden + join(norm("post_feedforward_layernorm")(mlp_out))
-            return hidden, aux
+            return pack(hidden, aux)
         normed = norm("input_layernorm")(hidden)
         hidden = hidden + join(attention("self_attn")(normed, segment_ids, cos, sin))
         normed = norm("post_attention_layernorm")(hidden)
         mlp_out, aux = mlp(normed)
         hidden = hidden + join(mlp_out)
-        return hidden, aux
+        return pack(hidden, aux)
 
 
 class _ScannedLayer(nn.Module):
     """Adapter giving LlamaDecoderLayer the (carry, xs) -> (carry, ys)
-    signature nn.scan expects; ys carries the per-layer MoE aux loss."""
+    signature nn.scan expects; ys carries the per-layer MoE aux loss (and,
+    when decoding, this layer's updated KV-cache slice)."""
 
     config: LlamaConfig
     layer_cls: type
 
     @nn.compact
-    def __call__(self, hidden, segment_ids, cos, sin):
-        hidden, aux = self.layer_cls(self.config, name="layer")(hidden, segment_ids, cos, sin)
-        return hidden, aux
+    def __call__(self, hidden, segment_ids, cos, sin,
+                 layer_kv=None, kv_index=None, kv_segment_ids=None):
+        hidden, ys = self.layer_cls(self.config, name="layer")(
+            hidden, segment_ids, cos, sin, layer_kv, kv_index, kv_segment_ids
+        )
+        return hidden, ys
 
 
 
@@ -438,20 +516,33 @@ class Llama(nn.Module):
 
     config: LlamaConfig
 
-    def _layers(self, hidden, segment_ids, cos, sin, local_cos=None, local_sin=None):
-        """Returns (hidden, aux_loss, ep_dropped_rows, layer_stats). For MoE
-        configs the per-layer router stats (sel_frac, mean_prob, dropped) are
-        pooled across depth BEFORE the E * sum(f * P) product — matching HF
-        `load_balancing_loss_func`, which concatenates all layers' gate
-        logits first, so the loss stays ~top_k when balanced regardless of
-        num_hidden_layers. `layer_stats` is the PRE-pooled
+    def _layers(self, hidden, segment_ids, cos, sin, local_cos=None, local_sin=None,
+                decode_kv=None, kv_index=None, kv_segment_ids=None):
+        """Returns (hidden, aux_loss, ep_dropped_rows, layer_stats, new_kv).
+        For MoE configs the per-layer router stats (sel_frac, mean_prob,
+        dropped) are pooled across depth BEFORE the E * sum(f * P) product —
+        matching HF `load_balancing_loss_func`, which concatenates all
+        layers' gate logits first, so the loss stays ~top_k when balanced
+        regardless of num_hidden_layers. `layer_stats` is the PRE-pooled
         (sel_frac [L, E], mean_prob [L, E]) pair for the health layer
-        (None for dense configs)."""
+        (None for dense configs).
+
+        `decode_kv` is the whole-stack KV cache `(k, v)` with leading layer
+        axis; each layer consumes/produces its slice (the scan axis under
+        scan_layers, an indexed axis on the looped path). `new_kv` is the
+        updated stack (None on the training path)."""
         cfg = self.config
         policy = _remat_policy(cfg)
+        new_kv = None
         if getattr(cfg, "pipeline_stages", 1) > 1:
             from llm_training_tpu.models.pipeline import PipelinedLayers
 
+            if decode_kv is not None:
+                raise NotImplementedError(
+                    "KV-cache decoding does not compose with "
+                    "pipeline_stages > 1; restore the checkpoint with "
+                    "pipeline_stages=1 for inference"
+                )
             layer_cls = _ScannedLayer
             if policy is not None:
                 layer_cls = nn.remat(
@@ -468,15 +559,35 @@ class Llama(nn.Module):
                 layer_cls = nn.remat(
                     _ScannedLayer, policy=policy, prevent_cse=False,
                 )
-            scanned = nn.scan(
-                layer_cls,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-                length=cfg.num_hidden_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, LlamaDecoderLayer, name="layers")
-            hidden, aux = scanned(hidden, segment_ids, cos, sin)
+            if decode_kv is None:
+                scanned = nn.scan(
+                    layer_cls,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True},
+                    in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                    length=cfg.num_hidden_layers,
+                    metadata_params={nn.PARTITION_NAME: "layers"},
+                )(cfg, LlamaDecoderLayer, name="layers")
+                hidden, aux = scanned(hidden, segment_ids, cos, sin)
+            else:
+                # the cache's layer axis IS the scan axis: each step consumes
+                # its [B, S, H, D] slice and emits the updated slice as ys
+                # (same param scope as the training-path scan above — only
+                # one of the two traces per call)
+                scanned = nn.scan(
+                    layer_cls,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True},
+                    in_axes=(nn.broadcast, nn.broadcast, nn.broadcast, 0,
+                             nn.broadcast, nn.broadcast),
+                    length=cfg.num_hidden_layers,
+                    metadata_params={nn.PARTITION_NAME: "layers"},
+                )(cfg, LlamaDecoderLayer, name="layers")
+                hidden, ys = scanned(
+                    hidden, segment_ids, cos, sin, decode_kv, kv_index,
+                    kv_segment_ids,
+                )
+                aux, new_kv = ys
         else:
             no_rope = getattr(cfg, "no_rope_layers", None)
             if no_rope is not None and cos is not None:
@@ -486,6 +597,7 @@ class Llama(nn.Module):
                 id_sin = jnp.zeros_like(sin)
             layer_types = getattr(cfg, "layer_types", None)
             stats = []
+            kv_slices = []
             for i in range(cfg.num_hidden_layers):
                 layer_cls = LlamaDecoderLayer
                 if policy is not None:
@@ -501,18 +613,28 @@ class Llama(nn.Module):
                 elif layer_types is not None and window and local_cos is not None:
                     # OLMo-3: sliding layers rotate with the UNSCALED tables
                     lcos, lsin = local_cos, local_sin
-                hidden, layer_aux = layer_cls(cfg, window, name=f"layers_{i}")(
-                    hidden, segment_ids, lcos, lsin,
+                layer_kv = (
+                    None if decode_kv is None
+                    else jax.tree.map(lambda a: a[i], decode_kv)
                 )
-                stats.append(layer_aux)
+                hidden, layer_ys = layer_cls(cfg, window, name=f"layers_{i}")(
+                    hidden, segment_ids, lcos, lsin, layer_kv, kv_index,
+                    kv_segment_ids,
+                )
+                if decode_kv is not None:
+                    layer_ys, layer_new_kv = layer_ys
+                    kv_slices.append(layer_new_kv)
+                stats.append(layer_ys)
             aux = jax.tree.map(lambda *xs: jnp.stack(xs), *stats)
+            if kv_slices:
+                new_kv = jax.tree.map(lambda *xs: jnp.stack(xs), *kv_slices)
         if not cfg.num_experts:
-            return hidden, jnp.float32(0.0), jnp.float32(0.0), None
+            return hidden, jnp.float32(0.0), jnp.float32(0.0), None, new_kv
         sel_frac, mean_prob, dropped = aux  # [L, E], [L, E], [L]
         aux_loss = cfg.num_experts * jnp.sum(
             sel_frac.mean(axis=0) * mean_prob.mean(axis=0)
         )
-        return hidden, aux_loss, dropped.sum(), (sel_frac, mean_prob)
+        return hidden, aux_loss, dropped.sum(), (sel_frac, mean_prob), new_kv
 
     @nn.compact
     def __call__(
@@ -523,6 +645,7 @@ class Llama(nn.Module):
         inputs_embeds: jnp.ndarray | None = None,
         compute_logits: bool = True,
         return_last_hidden_states: bool = False,
+        decode_state: DecodeState | None = None,
     ) -> CausalLMOutput:
         cfg = self.config
         embed_tokens = nn.Embed(
@@ -544,6 +667,19 @@ class Llama(nn.Module):
         if em != 1.0:  # Granite scales the embeddings into the residual stream
             hidden = hidden * jnp.asarray(em, hidden.dtype)
         seq = hidden.shape[1]
+
+        kv_segment_ids = None
+        if decode_state is not None:
+            # the chunk's q-side segment ids (pads 0, real tokens 1) double
+            # as the cache-slot ids for the slots it writes; merge them into
+            # the cache's filled-slot map BEFORE the layers so every layer
+            # masks against the same updated view
+            if segment_ids is None:
+                segment_ids = jnp.ones((hidden.shape[0], seq), jnp.int32)
+            kv_segment_ids = jax.lax.dynamic_update_slice(
+                decode_state.segment_ids, segment_ids.astype(jnp.int32),
+                (0, decode_state.index),
+            )
 
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
@@ -571,12 +707,15 @@ class Llama(nn.Module):
         # trace time, so seq-dependent variants (dynamic NTK, longrope
         # short/long factor selection — HF Phi3RotaryEmbedding semantics)
         # resolve per compiled shape. Learned-position models carry no
-        # rotation at all.
+        # rotation at all. Under a KV cache the chunk is 1 token wide but
+        # positions span the generation, so the table-selection length is
+        # the cache's (static) planned length, not the chunk width.
+        rope_len = seq if decode_state is None else decode_state.table_length
         if learned:
             cos = sin = None
         else:
             inv_freq, attention_scaling = compute_rope_frequencies(
-                cfg.rope_config, seq_len=seq
+                cfg.rope_config, seq_len=rope_len
             )
             cos, sin = compute_rope_cos_sin(inv_freq, position_ids, attention_scaling)
         if cos is not None and getattr(cfg, "rope_interleaved", False):
@@ -594,7 +733,7 @@ class Llama(nn.Module):
             # sliding layers use the UNSCALED default tables (OLMo-3;
             # Ministral's layer_types pattern keeps ONE table everywhere)
             inv_freq_l, scaling_l = compute_rope_frequencies(
-                cfg.local_rope_config, seq_len=seq
+                cfg.local_rope_config, seq_len=rope_len
             )
             local_cos, local_sin = compute_rope_cos_sin(
                 inv_freq_l, position_ids, scaling_l
@@ -603,9 +742,22 @@ class Llama(nn.Module):
                 half = local_cos.shape[-1] // 2
                 local_cos = jnp.repeat(local_cos[..., :half], 2, axis=-1)
                 local_sin = jnp.repeat(local_sin[..., :half], 2, axis=-1)
-        hidden, aux_loss, ep_dropped, layer_stats = self._layers(
-            hidden, segment_ids, cos, sin, local_cos, local_sin
+        hidden, aux_loss, ep_dropped, layer_stats, new_kv = self._layers(
+            hidden, segment_ids, cos, sin, local_cos, local_sin,
+            decode_kv=(
+                None if decode_state is None
+                else (decode_state.k, decode_state.v)
+            ),
+            kv_index=None if decode_state is None else decode_state.index,
+            kv_segment_ids=kv_segment_ids,
         )
+        new_decode_state = None
+        if decode_state is not None:
+            new_decode_state = decode_state.replace(
+                k=new_kv[0], v=new_kv[1],
+                index=decode_state.index + seq,
+                segment_ids=kv_segment_ids,
+            )
         hidden = _norm_cls(cfg)(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="norm")(hidden)
         mult = getattr(cfg, "logit_scale", None)
         if mult is not None:
@@ -648,6 +800,7 @@ class Llama(nn.Module):
             aux_loss=aux_loss if cfg.num_experts else None,
             ep_dropped_rows=ep_dropped if cfg.num_experts else None,
             router_stats=router_stats,
+            decode_state=new_decode_state,
         )
 
     def get_input_embeddings_path(self) -> str:

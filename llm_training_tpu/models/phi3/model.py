@@ -14,7 +14,11 @@ architecture is Llama-shaped; the reference's differences are:
 - longrope with `original_max_position_embeddings` (`phi3_model.py:303-317`)
 
 All of these are handled by the shared decoder stack (see
-`llama/model.py:LlamaAttention`), so Phi3 is Llama with a Phi3Config.
+`llama/model.py:LlamaAttention`), so Phi3 is Llama with a Phi3Config —
+including KV-cache decoding (`decode_state`, docs/inference.md), which the
+family inherits from the shared stack unchanged (the sliding-window mask
+and the attention_compute_dtype upcast both apply inside the cached
+attention path too).
 """
 
 from __future__ import annotations

@@ -39,13 +39,20 @@ def make_attention_mask(
     kv_len: int,
     causal: bool = True,
     sliding_window: int | None = None,
-    q_offset: int = 0,
+    q_offset: int | jnp.ndarray = 0,
 ) -> jnp.ndarray:
     """Boolean mask [batch, 1, q_len, kv_len] (True = attend).
 
-    `q_offset` is the absolute position of query row 0 in the kv sequence
-    (used by ring attention where q is a rotating kv chunk's neighbour).
-    """
+    `q_offset` is the absolute position of query row 0 in the kv sequence:
+    a static int for ring attention (q is a rotating kv chunk's neighbour),
+    or a TRACED scalar for KV-cache decoding (`infer/`), where kv is the
+    whole static-shape cache and the offset is the dynamic append index —
+    row `q_offset + i` of this mask must equal row `q_offset + i` of the
+    full dense q_len==kv_len mask (the invariant the decode path relies
+    on; tests/test_ops.py::test_make_attention_mask_q_offset_decode_rows).
+    Positions the cache has not reached yet fall away via the causal term
+    (kv_pos > q_pos) and the `seg_kv > 0` term (unwritten slots carry
+    segment id 0)."""
     q_pos = jnp.arange(q_len)[:, None] + q_offset
     kv_pos = jnp.arange(kv_len)[None, :]
     mask = jnp.ones((q_len, kv_len), dtype=bool)

@@ -136,6 +136,51 @@ def _health_section(telemetry: dict) -> list[str]:
     return lines
 
 
+def _decode_section(telemetry: dict) -> list[str]:
+    """Inference telemetry (`decode/*` from `generate`, `eval/*` from
+    `evaluate` — docs/inference.md): rendered only when the run dir saw an
+    inference invocation merge its gauges into telemetry.jsonl."""
+    def num(key):
+        try:
+            return float(telemetry[key])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    lines = []
+    prefill = num("decode/prefill_time_s")
+    tps = num("decode/tokens_per_sec")
+    if prefill is not None or tps is not None:
+        line = "generate:"
+        if prefill is not None:
+            line += f" prefill_time_s {prefill:.3f}"
+        if tps is not None:
+            line += f"  decode_tokens_per_sec {tps:,.1f}"
+        new_tokens = num("decode/new_tokens")
+        if new_tokens is not None:
+            line += f"  new_tokens {int(new_tokens)}"
+        lines.append(line)
+        cache = num("decode/cache_bytes")
+        if cache is not None:
+            line = f"kv cache: {cache / _GIB:.3f} GiB"
+            max_len = num("decode/max_length")
+            if max_len is not None:
+                line += f" ({int(max_len)} slots)"
+            lines.append(line)
+    nll = num("eval/nll_per_token")
+    if nll is not None:
+        line = f"evaluate: nll/token {nll:.4f}"
+        ppl = num("eval/perplexity")
+        if ppl is not None:
+            line += f"  perplexity {ppl:.2f}"
+        tokens = num("eval/tokens")
+        if tokens is not None:
+            line += f"  over {int(tokens):,} tokens"
+        lines.append(line)
+    if not lines:
+        return []
+    return ["", "== Inference =="] + lines
+
+
 def _resilience_section(telemetry: dict) -> list[str]:
     """Fault-tolerance event counters (`resilience/*` plus the retry
     counters — docs/resilience.md): rendered only when the run recorded at
@@ -253,6 +298,7 @@ def render_report(run_dir: str | Path) -> str:
         lines.append(peak_line)
 
     lines.extend(_health_section(telemetry))
+    lines.extend(_decode_section(telemetry))
     lines.extend(_resilience_section(telemetry))
     return "\n".join(lines)
 

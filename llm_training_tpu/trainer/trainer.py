@@ -1088,36 +1088,70 @@ class Trainer:
 
     # ------------------------------------------------------------ validate
 
+    def restore_for_inference(
+        self,
+        objective,
+        resume_step: int | None = None,
+        sample_batch: dict | None = None,
+    ) -> TrainState:
+        """READ-ONLY restore for the inference/eval CLIs (`generate`,
+        `evaluate` — docs/inference.md): build the mesh and the abstract
+        train state exactly as `fit` would (the optimizer-state pytree
+        layout depends on the trainer settings, so the SAME TrainerConfig
+        the checkpoint was written under must be used), then restore the
+        newest (or given) step straight into sharded buffers with
+        repair=False — an inference run must never delete or repair
+        anything in the checkpoint directory. Leaves `self.mesh` /
+        `self.state_shardings` populated for the caller's own jits.
+
+        `sample_batch` feeds the objective's init_params shape evaluation;
+        objectives whose init reads non-CLM keys (DPO/ORPO use
+        `chosen_input_ids`) must pass a real batch — the CLM-shaped
+        synthetic default only suits single-model causal-LM objectives."""
+        if self.checkpointer is None:
+            raise ValueError("restore_for_inference requires a checkpointer")
+        self.mesh = build_mesh(self.config.mesh, self.devices)
+        with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+            if sample_batch is None:
+                # parameter shapes are sequence-length independent, so a
+                # synthetic batch is enough to shape-evaluate the state
+                sample_batch = {"input_ids": np.zeros((1, 8), np.int32)}
+            tx, _ = self._build_tx(objective)
+            abstract_boxed = self._abstract_state(objective, sample_batch, tx)
+            self.state_shardings = self._state_shardings(abstract_boxed)
+            abstract_state = nn.meta.unbox(abstract_boxed)
+            self.abstract_state = abstract_state
+            restored = self.checkpointer.maybe_restore(
+                abstract_state, self.state_shardings, resume_step, repair=False
+            )
+            if restored is None:
+                raise ValueError(
+                    f"no checkpoint found in {self.checkpointer.directory}"
+                )
+            state, _ = restored
+            return state
+
     def validate_from_checkpoint(
         self, objective, datamodule, resume_step: int | None = None
     ) -> dict[str, float]:
         """Restore the latest (or given) checkpoint and run validation
         (the CLI `validate` subcommand, reference `llm-training validate`)."""
-        if self.checkpointer is None:
-            raise ValueError("validate_from_checkpoint requires a checkpointer")
-        cfg = self.config
-        self.mesh = build_mesh(cfg.mesh, self.devices)
         datamodule.setup()
+        # a REAL batch, not the synthetic default: DPO/ORPO objectives
+        # shape-evaluate from preference keys (chosen_/rejected_input_ids)
+        sample_batch = next(datamodule.train_batches())
+        state = self.restore_for_inference(
+            objective, resume_step, sample_batch=sample_batch
+        )
         with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
-            sample_batch = next(datamodule.train_batches())
-            tx, _ = self._build_tx(objective)
-            abstract_boxed = self._abstract_state(objective, sample_batch, tx)
-            self.state_shardings = self._state_shardings(abstract_boxed)
-            abstract_state = nn.meta.unbox(abstract_boxed)
-            # read-only path: a validation must not delete/repair anything
-            restored = self.checkpointer.maybe_restore(
-                abstract_state, self.state_shardings, resume_step, repair=False
-            )
-            if restored is None:
-                raise ValueError(f"no checkpoint found in {self.checkpointer.directory}")
-            state, _ = restored
             eval_step = jax.jit(
                 self._build_eval_step(objective),
                 in_shardings=(self.state_shardings, _batch_shardings(sample_batch, self.mesh)),
             )
             losses, weights = [], []
+            limit = self.config.limit_val_batches
             for i, batch in enumerate(datamodule.val_batches()):
-                if cfg.limit_val_batches and i >= cfg.limit_val_batches:
+                if limit and i >= limit:
                     break
                 out = jax.device_get(eval_step(state, batch))
                 losses.append(out["loss"])

@@ -25,6 +25,34 @@ grep -q "goodput" "${SMOKE_ROOT}/report_smoke.log"
 # stats flowed registry -> telemetry.jsonl -> report)
 grep -q "== Health ==" "${SMOKE_ROOT}/report_smoke.log"
 
+# inference gate (docs/inference.md): generate + evaluate must run
+# end-to-end from the smoke fit's checkpoint, emit nonzero output, and land
+# their decode/eval gauges in telemetry.jsonl so report renders them
+echo "== precommit: generate/evaluate smoke (checkpoint -> decode -> report) =="
+JAX_PLATFORMS=cpu python -m llm_training_tpu generate \
+    --config config/examples/smoke/cpu-smoke.yaml "run_root=${SMOKE_ROOT}" \
+    --prompt-tokens 3,17,42 --max-new-tokens 8 \
+    | tee "${SMOKE_ROOT}/generate_smoke.log"
+python - "${SMOKE_ROOT}/generate_smoke.log" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip().startswith("{")]
+tokens = [r["tokens"] for r in rows if "tokens" in r]
+# nonzero output, capped at the requested 8 (the model's scalar eos — the
+# LlamaConfig default id 2 — may legitimately stop a greedy row early)
+assert tokens and all(0 < len(t) <= 8 for t in tokens), f"bad token output: {tokens}"
+stats = [r["stats"] for r in rows if "stats" in r]
+assert stats and stats[0]["decode/tokens_per_sec"] > 0, f"no decode rate: {stats}"
+print("generate smoke: OK", tokens)
+EOF
+JAX_PLATFORMS=cpu python -m llm_training_tpu evaluate \
+    --config config/examples/smoke/cpu-smoke.yaml "run_root=${SMOKE_ROOT}" \
+    --limit-batches 2
+JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
+    | tee "${SMOKE_ROOT}/report_infer.log"
+grep -q "== Inference ==" "${SMOKE_ROOT}/report_infer.log"
+grep -q "decode_tokens_per_sec" "${SMOKE_ROOT}/report_infer.log"
+grep -q "perplexity" "${SMOKE_ROOT}/report_infer.log"
+
 # NaN-provenance gate: a forced non-finite micro-fit must name the offending
 # layer path in the NonFiniteLossError AND write an anomaly-<step>.json dump
 echo "== precommit: forced-NaN anomaly dump smoke =="

@@ -140,8 +140,15 @@ def test_cross_topology_resume(devices, tmp_path):
 
     assert int(jax.device_get(state.step)) == 10
     for step in range(6, 11):
+        # rtol bound: the two resumes run on DIFFERENT meshes ({fsdp:4,
+        # tensor:2} vs {fsdp:8}), so GSPMD legitimately reorders the
+        # gradient/loss reductions — fp32 sum-order noise of ~1e-7/step
+        # compounds through 5 optimizer steps to the low 1e-6s, which
+        # straddled the old rtol=1e-6 and flaked. 5e-5 is ~50x that noise
+        # floor yet far below any real restore bug (a resharding error
+        # shows up as O(1) divergence within a step or two).
         np.testing.assert_allclose(
-            rec_x.losses[step], rec_same.losses[step], rtol=1e-6,
+            rec_x.losses[step], rec_same.losses[step], rtol=5e-5,
             err_msg=f"step {step}",
         )
     # and the restored params really live on the new mesh

@@ -311,6 +311,46 @@ def test_sliding_window_attention():
     np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-5)
 
 
+def test_make_attention_mask_q_offset_decode_rows():
+    """The KV-cache decode invariant (infer/, docs/inference.md): a 1-row
+    mask built with q_offset=i (+ sliding_window + packed/left-pad segment
+    ids) must equal ROW i of the full dense q_len==kv_len mask — this is
+    the exact path the decode step's cache attention rides."""
+    S, window = 10, 3
+    # row 0: left-padded single document; row 1: packed docs + trailing pad
+    seg = jnp.asarray([
+        [0, 0, 1, 1, 1, 1, 1, 1, 1, 1],
+        [1, 1, 1, 2, 2, 2, 2, 3, 3, 0],
+    ])
+    for sliding in (None, window):
+        dense = np.asarray(make_attention_mask(
+            seg, seg, S, S, causal=True, sliding_window=sliding
+        ))
+        for i in range(S):
+            row = np.asarray(make_attention_mask(
+                seg[:, i:i + 1], seg, 1, S,
+                causal=True, sliding_window=sliding, q_offset=i,
+            ))
+            np.testing.assert_array_equal(
+                row[:, :, 0], dense[:, :, i],
+                err_msg=f"q_offset={i} sliding_window={sliding}",
+            )
+    # the decode step traces q_offset as a dynamic scalar — same rows must
+    # come out when the offset is a traced value inside jit
+    row_fn = jax.jit(
+        lambda off: make_attention_mask(
+            seg[:, 4:5], seg, 1, S, causal=True, sliding_window=window,
+            q_offset=off,
+        )
+    )
+    dense = np.asarray(make_attention_mask(
+        seg, seg, S, S, causal=True, sliding_window=window
+    ))
+    np.testing.assert_array_equal(
+        np.asarray(row_fn(jnp.int32(4)))[:, :, 0], dense[:, :, 4]
+    )
+
+
 def test_soft_cap_matches_naive_tanh():
     rng = np.random.default_rng(10)
     q = rng.standard_normal((1, 4, 1, 4)).astype(np.float32) * 10
