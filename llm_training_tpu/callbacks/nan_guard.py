@@ -172,6 +172,50 @@ class NanGuard:
             # checkpoint stays useful for post-mortem / rewind, so the save
             # is NOT aborted
 
+    # ------------------------------------------------------------ state
+
+    def state_dict(self) -> dict:
+        """JSON-serializable guard state, persisted in checkpoint metadata
+        (the trainer gathers every callback's `state_dict` on save). The
+        EMA trackers matter most: without them the spike detector restarts
+        its warmup window blind right after every resume — the moment
+        spikes are most likely."""
+        return {
+            "non_finite_steps": self.non_finite_steps,
+            "spike_steps": self.spike_steps,
+            "streak": self._streak,
+            "spike_streak": self._spike_streak,
+            "detectors": {
+                name: {"count": d.count, "mean": d.mean, "var": d.var}
+                for name, d in self._detectors.items()
+            },
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore from checkpoint metadata. Detector hyperparameters
+        (beta/warmup) come from THIS run's config — only the tracked
+        statistics are restored, and only for detectors this config builds
+        (a run that disabled spike detection ignores persisted trackers)."""
+        self.non_finite_steps = int(state.get("non_finite_steps", 0))
+        self.spike_steps = int(state.get("spike_steps", 0))
+        self._streak = int(state.get("streak", 0))
+        self._spike_streak = int(state.get("spike_streak", 0))
+        for name, data in (state.get("detectors") or {}).items():
+            detector = self._detectors.get(name)
+            if detector is None:
+                continue
+            detector.count = int(data.get("count", 0))
+            detector.mean = float(data.get("mean", 0.0))
+            detector.var = float(data.get("var", 0.0))
+
+    def on_rollback(self, trainer, step: int) -> None:
+        """In-process recovery rewound to `step`: clear the failure streaks
+        (the diverged window is being discarded) but keep the EMA trackers
+        and lifetime totals — they model the healthy process and the run's
+        history, not the excursion."""
+        self._streak = 0
+        self._spike_streak = 0
+
     # ------------------------------------------------------------ plumbing
 
     @staticmethod

@@ -1,4 +1,4 @@
-"""Console entry: fit / validate / generate / evaluate / report.
+"""Console entry: fit / validate / generate / evaluate / report / supervise.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -10,7 +10,15 @@ its run directory (docs/observability.md) — no config or backend needed.
 read-only and drive the inference subsystem (`llm_training_tpu.infer`):
 batched KV-cache decoding with sampling, and packed-perplexity held-out
 scoring; both merge their `decode/*` / `eval/*` telemetry into the run
-directory's telemetry.jsonl so `report` renders it.
+directory's telemetry.jsonl so `report` renders it. `supervise`
+(docs/resilience.md) runs `fit` as a child process and relaunches it on
+preemption (exit 75) and hard deaths (SIGKILL/segfault/SIGABRT), with a
+restart budget, backoff, and a supervisor.jsonl event log.
+
+Exit-code contract for `fit` (docs/resilience.md#exit-codes): 0 complete,
+75 preempted-but-resumable, 76 recovery budget exhausted, 77 loss spike
+(unrecovered), 78 non-finite divergence (unrecovered); anything else is an
+unclassified failure.
 """
 
 from __future__ import annotations
@@ -241,6 +249,36 @@ def _run_evaluate(args, config: dict) -> int:
     return 0
 
 
+def _run_supervise(args) -> int:
+    """`supervise`: relaunch `fit` on exit 75 and hard deaths
+    (docs/resilience.md#supervise). Pure subprocess driving — no jax."""
+    from llm_training_tpu.resilience.supervisor import (
+        Supervisor,
+        SupervisorConfig,
+        build_fit_argv,
+    )
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        stream=sys.stdout,
+    )
+    config = SupervisorConfig(
+        max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base_s,
+        backoff_max_s=args.backoff_max_s,
+        log_path=args.log or None,
+    )
+    supervisor = Supervisor(
+        build_fit_argv(args.config, args.overrides, ckpt_path=args.ckpt_path),
+        config=config,
+        # relaunches drop any explicit --ckpt-path: they must restore the
+        # NEWEST checkpoint, not rewind to the pinned step every restart
+        relaunch_argv=build_fit_argv(args.config, args.overrides),
+    )
+    return supervisor.run()
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="llm-training-tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -292,12 +330,35 @@ def main(argv: list[str] | None = None) -> int:
     evaluate.add_argument("overrides", nargs="*")
     report = sub.add_parser("report", help="render a run summary from a run directory")
     report.add_argument("run_dir", help="dir holding metrics.jsonl / telemetry.jsonl")
+    supervise = sub.add_parser(
+        "supervise",
+        help="run fit as a supervised child process; restart it on "
+        "preemption (exit 75) and hard deaths (SIGKILL/segfault/SIGABRT)",
+    )
+    supervise.add_argument("--config", required=True)
+    supervise.add_argument(
+        "--ckpt-path", default=None,
+        help="explicit resume step for the FIRST launch only (relaunches "
+        "always restore the newest checkpoint)",
+    )
+    supervise.add_argument("--max-restarts", type=int, default=10)
+    supervise.add_argument("--backoff-base-s", type=float, default=1.0)
+    supervise.add_argument("--backoff-max-s", type=float, default=300.0)
+    supervise.add_argument(
+        "--log", default="supervisor.jsonl",
+        help="supervisor event log path ('' disables)",
+    )
+    supervise.add_argument("overrides", nargs="*")
     args = parser.parse_args(argv)
 
     if args.command == "report":
         from llm_training_tpu.telemetry.report import report_main
 
         return report_main(args.run_dir)
+    if args.command == "supervise":
+        # the supervisor must never initialize jax — it would hold the TPU
+        # its child needs; hand off before any backend-touching import
+        return _run_supervise(args)
 
     config = load_config(args.config, args.overrides)
     logging.basicConfig(
@@ -322,19 +383,39 @@ def main(argv: list[str] | None = None) -> int:
 
     resume_step = int(args.ckpt_path) if args.ckpt_path else None
     if args.command == "fit":
-        from llm_training_tpu.resilience import RESUMABLE_EXIT_CODE, PreemptionInterrupt
+        from llm_training_tpu.callbacks.nan_guard import (
+            LossSpikeError,
+            NonFiniteLossError,
+        )
+        from llm_training_tpu.resilience import (
+            LOSS_SPIKE_EXIT_CODE,
+            NON_FINITE_EXIT_CODE,
+            RECOVERY_EXHAUSTED_EXIT_CODE,
+            RESUMABLE_EXIT_CODE,
+            PreemptionInterrupt,
+            RecoveryExhaustedError,
+        )
 
+        log = logging.getLogger(__name__)
         try:
             trainer.fit(objective, datamodule, resume_step=resume_step)
         except PreemptionInterrupt as e:
-            # supervisor contract (docs/resilience.md): exit 75 = the run
-            # was preempted AFTER committing a resumable checkpoint —
-            # relaunch this same command to continue; any other non-zero
-            # exit is a real failure
-            logging.getLogger(__name__).warning(
-                "%s — exiting with resumable code %d", e, RESUMABLE_EXIT_CODE
-            )
+            # supervisor contract (docs/resilience.md#exit-codes): exit 75
+            # = the run was preempted AFTER committing a resumable
+            # checkpoint — relaunch this same command to continue
+            log.warning("%s — exiting with resumable code %d", e, RESUMABLE_EXIT_CODE)
             return RESUMABLE_EXIT_CODE
+        except RecoveryExhaustedError as e:
+            # in-process recovery gave up: a blind relaunch would reproduce
+            # the failure — a human (or a config change) is needed
+            log.error("%s — exiting %d", e, RECOVERY_EXHAUSTED_EXIT_CODE)
+            return RECOVERY_EXHAUSTED_EXIT_CODE
+        except LossSpikeError as e:
+            log.error("%s — exiting %d", e, LOSS_SPIKE_EXIT_CODE)
+            return LOSS_SPIKE_EXIT_CODE
+        except NonFiniteLossError as e:
+            log.error("%s — exiting %d", e, NON_FINITE_EXIT_CODE)
+            return NON_FINITE_EXIT_CODE
     else:
         trainer.validate_from_checkpoint(objective, datamodule, resume_step=resume_step)
     return 0

@@ -51,14 +51,42 @@ class BaseDataModule:
     def steps_per_epoch(self) -> int:
         return len(self.train_dataset) // self.config.batch_size
 
-    def train_batches(self, start_step: int = 0) -> Iterator[dict[str, np.ndarray]]:
+    def train_batches(
+        self, start_step: int = 0, skip_list: Any | None = None
+    ) -> Iterator[dict[str, np.ndarray]]:
         """Infinite shuffled stream; deterministic in (seed, step) so resume
-        at `start_step` reproduces the exact post-crash data order."""
+        at `start_step` reproduces the exact post-crash data order.
+
+        `skip_list` (a `resilience.DataSkipList`, passed by the trainer when
+        rollback-and-skip recovery is enabled) makes the stream a pure
+        function of (seed, step, windows, reserve) instead: the LAST
+        `skip_list.reserve` batches of every epoch permutation are held out
+        as a replacement pool, and a step inside a poisoned window serves
+        the next reserved batch instead of its own. No batch is served
+        twice and none is lost (until the pool is exhausted, which wraps
+        with a warning), so a resumed run — or a clean run configured with
+        the same windows — replays the identical global batch sequence.
+        With `skip_list=None` the stream is byte-identical to before."""
         step = 0
         epoch = 0
+        reserve = int(getattr(skip_list, "reserve", 0)) if skip_list is not None else 0
         while True:
             batches = self._batch_indices(len(self.train_dataset), epoch, shuffle=True)
-            for row in batches:
+            if reserve:
+                if reserve >= len(batches):
+                    raise ValueError(
+                        f"recovery reserve ({reserve} batches/epoch) consumes "
+                        f"the whole epoch ({len(batches)} batches); shrink "
+                        "recovery.reserve_batches or the skip budget"
+                    )
+                served, pool = batches[:-reserve], batches[-reserve:]
+            else:
+                served, pool = batches, batches[:0]
+            epoch_start = step
+            for row in served:
+                if skip_list is not None and skip_list.is_skipped(step):
+                    replacement = skip_list.replacement_row(step, epoch_start, pool)
+                    row = replacement if replacement is not None else row
                 if step >= start_step:
                     yield self.collate([self.train_dataset[int(i)] for i in row])
                 step += 1

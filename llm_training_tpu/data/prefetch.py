@@ -12,14 +12,18 @@ storage hiccups — OSError and friends) can be retried with backoff before
 surfacing (`retries`, default 0 = historical fail-fast), counted in the
 `data/retries` registry counter; each successful production feeds an
 optional heartbeat so the hang watchdog can tell a stalled input pipeline
-from a stalled device."""
+from a stalled device. Passing an iterator *factory* instead of a plain
+iterator makes retries actually work against generator sources: a
+generator closed by an in-flight error cannot be re-pulled (its retry
+raises StopIteration), so the retry path rebuilds the stream from the
+factory at the current position instead."""
 
 from __future__ import annotations
 
 import queue
 import threading
 import time
-from typing import Any, Iterator
+from typing import Any, Callable, Iterator
 
 import jax
 
@@ -31,11 +35,18 @@ class DevicePrefetcher:
     the batch is already resident on device (placed with `shardings`) and
     `aux = host_aux_fn(host_batch)` (None when no fn is given). `close()`
     stops the worker — the trainer calls it when the fit ends so infinite
-    data streams don't leave threads parked behind a full queue."""
+    data streams don't leave threads parked behind a full queue.
+
+    `batches` is either a plain iterator (historical signature) or a
+    factory `Callable[[int], Iterator]` mapping a production offset to an
+    iterator positioned at that batch — the trainer passes
+    `lambda n: datamodule.train_batches(start_step=start_micro + n, ...)`.
+    With a factory, a failed pull rebuilds the stream at the batch being
+    retried, so retries survive closed generators."""
 
     def __init__(
         self,
-        batches: Iterator[dict],
+        batches: Iterator[dict] | Callable[[int], Iterator[dict]],
         shardings: Any,
         depth: int = 2,
         host_aux_fn: Any | None = None,
@@ -44,7 +55,13 @@ class DevicePrefetcher:
         retry_backoff_s: float = 0.5,
         heartbeat: Any | None = None,
     ):
-        self._batches = iter(batches)
+        if callable(batches) and not hasattr(batches, "__next__"):
+            self._factory: Callable[[int], Iterator[dict]] | None = batches
+            self._batches = iter(self._factory(0))
+        else:
+            self._factory = None
+            self._batches = iter(batches)
+        self._stream_dirty = False  # an error may have closed the generator
         self._shardings = shardings
         # hang-watchdog hook: called (no args) after each successful
         # production so a stalled data source is distinguishable from a
@@ -83,17 +100,25 @@ class DevicePrefetcher:
     def _produce_one(self, attempt: int) -> dict:
         """One data-source pull. The chaos hook sits BEFORE the underlying
         `next`, so an injected fault leaves the source untouched and the
-        retry really re-pulls the same batch — a generator that raised from
-        inside cannot be resumed (its retry raises StopIteration), so real
-        transient errors are only retryable when the source itself is
-        (remote readers are). The `_last_error` bookkeeping keeps a closed-
-        by-error generator from masquerading as a clean end of stream: the
-        ORIGINAL transient error surfaces once the retries exhaust."""
+        retry really re-pulls the same batch. With a plain iterator, a
+        generator that raised from inside cannot be resumed (its retry
+        raises StopIteration), so real transient errors are only retryable
+        when the source itself is (remote readers are) — the `_last_error`
+        bookkeeping keeps a closed-by-error generator from masquerading as
+        a clean end of stream: the ORIGINAL transient error surfaces once
+        the retries exhaust. With a FACTORY, a retry after any error
+        rebuilds the stream at the batch being retried instead, so even
+        generator sources retry for real."""
         from llm_training_tpu.resilience import chaos_point
 
+        if self._stream_dirty and self._factory is not None:
+            # the previous attempt's error may have closed a generator
+            # mid-pull; rebuild positioned at the batch being retried
+            self._batches = iter(self._factory(self._produced))
+            self._stream_dirty = False
         t0 = time.perf_counter()
-        chaos_point("data", step=self._produced)
         try:
+            chaos_point("data", step=self._produced)
             batch = next(self._batches)
         except StopIteration:
             if attempt > 0 and self._last_error is not None:
@@ -101,8 +126,10 @@ class DevicePrefetcher:
             raise
         except Exception as e:
             self._last_error = e
+            self._stream_dirty = True
             raise
         self._last_error = None
+        self._stream_dirty = False
         # the successful attempt's pull time only — failed attempts and
         # retry backoff must not skew the produce latency (they are visible
         # as data/retries instead)

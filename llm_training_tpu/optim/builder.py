@@ -91,12 +91,18 @@ def build_optimizer(
     config: OptimConfig,
     num_total_steps: int,
     frozen_modules: list[str] | None = None,
+    schedule_transform: Any | None = None,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
     """Full chain: clip -> optimizer(schedule) [-> freeze mask].
 
     The freeze mask is a *callable* so it adapts to whatever tree structure
-    (flax-boxed or plain) the transformation is applied to."""
+    (flax-boxed or plain) the transformation is applied to.
+    `schedule_transform` wraps the built LR schedule (the recovery LR
+    cooldown, `resilience/recovery.py`) — a pure function of the schedule
+    count, so the optimizer-state layout is untouched."""
     schedule = build_lr_schedule(config, num_total_steps)
+    if schedule_transform is not None:
+        schedule = schedule_transform(schedule)
     try:
         opt_fn = _OPTIMIZERS[config.optimizer]
     except KeyError:

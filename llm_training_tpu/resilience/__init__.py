@@ -1,6 +1,6 @@
 """Resilience subsystem: survive the failures TPU pods actually have.
 
-Four layers (docs/resilience.md):
+Six layers (docs/resilience.md):
 
 - **Preemption handling** (`shutdown.py`): SIGTERM/SIGINT → emergency
   checkpoint at the next step boundary → `PreemptionInterrupt` →
@@ -12,9 +12,18 @@ Four layers (docs/resilience.md):
 - **Hang watchdog** (`watchdog.py`): a heartbeat-fed daemon that dumps all
   thread stacks + the open goodput phase when the train loop stops making
   progress, optionally aborting so the supervisor can relaunch.
+- **In-process recovery** (`recovery.py`): NanGuard divergence → rollback
+  to the last committed checkpoint *without exiting*, skip the poisoned
+  data window (`DataSkipList` + the deterministic index stream's reserved
+  replacement pool), optional temporary LR cooldown, escalate when the
+  budget runs out (`RecoveryExhaustedError` → exit 76).
+- **Crash-restart supervision** (`supervisor.py` + the `supervise` CLI):
+  relaunch `fit` on exit 75 and on hard deaths (SIGKILL/OOM, segfault,
+  watchdog SIGABRT) with a restart budget, exponential backoff, and a
+  `supervisor.jsonl` event log — the failures in-process code cannot see.
 - **Fault injection** (`chaos.py`): config/env-driven failures at every
-  recovery site, so tests and `scripts/crash_resume_smoke.py` prove the
-  paths above end to end.
+  recovery site — including NaN/spike divergence and SIGKILL — so tests
+  and `scripts/crash_resume_smoke.py` prove the paths above end to end.
 """
 
 from pydantic import BaseModel, ConfigDict, Field
@@ -29,6 +38,16 @@ from llm_training_tpu.resilience.chaos import (
     install_chaos,
     uninstall_chaos,
 )
+from llm_training_tpu.resilience.recovery import (
+    LOSS_SPIKE_EXIT_CODE,
+    NON_FINITE_EXIT_CODE,
+    RECOVERY_EXHAUSTED_EXIT_CODE,
+    DataSkipList,
+    RecoveryConfig,
+    RecoveryExhaustedError,
+    RecoveryManager,
+    cooldown_schedule,
+)
 from llm_training_tpu.resilience.retry import (
     TRANSIENT_EXCEPTIONS,
     RetryPolicy,
@@ -39,6 +58,11 @@ from llm_training_tpu.resilience.shutdown import (
     RESUMABLE_EXIT_CODE,
     GracefulShutdown,
     PreemptionInterrupt,
+)
+from llm_training_tpu.resilience.supervisor import (
+    Supervisor,
+    SupervisorConfig,
+    build_fit_argv,
 )
 from llm_training_tpu.resilience.watchdog import HangWatchdog
 
@@ -68,24 +92,39 @@ class ResilienceConfig(BaseModel):
     # surfacing; 0 preserves the historical fail-fast behavior
     data_retries: int = Field(0, ge=0)
     data_retry_backoff_s: float = Field(0.5, ge=0)
+    # in-process rollback-and-skip recovery for NanGuard divergence
+    # (docs/resilience.md#recovery); None (default) = fail-fast as before,
+    # with the data stream byte-identical to a recovery-less build
+    recovery: RecoveryConfig | None = None
     # fault injection (off unless a trigger is set); LLMT_CHAOS_* env vars
     # overlay this at fit start
     chaos: ChaosConfig = ChaosConfig()
 
 
 __all__ = [
+    "LOSS_SPIKE_EXIT_CODE",
+    "NON_FINITE_EXIT_CODE",
+    "RECOVERY_EXHAUSTED_EXIT_CODE",
     "RESUMABLE_EXIT_CODE",
     "TRANSIENT_EXCEPTIONS",
     "Chaos",
     "ChaosConfig",
     "ChaosError",
+    "DataSkipList",
     "GracefulShutdown",
     "HangWatchdog",
     "PreemptionInterrupt",
+    "RecoveryConfig",
+    "RecoveryExhaustedError",
+    "RecoveryManager",
     "ResilienceConfig",
     "RetryPolicy",
+    "Supervisor",
+    "SupervisorConfig",
+    "build_fit_argv",
     "chaos_point",
     "config_from_env",
+    "cooldown_schedule",
     "get_chaos",
     "install_chaos",
     "is_transient",

@@ -53,6 +53,22 @@ class ChaosConfig(BaseModel):
     # deliver a real SIGTERM to this process at this optimizer step —
     # exercises the GracefulShutdown handler end to end
     sigterm_step: int | None = None
+    # deliver SIGKILL at this optimizer step — a hard death no in-process
+    # code can survive (the `supervise` restart path). Fires only in a run
+    # that STARTED from step 0, so the supervisor's relaunch (resuming past
+    # a checkpoint) survives instead of crash-looping on the same trigger
+    sigkill_step: int | None = None
+    # divergence injection (the rollback-and-skip recovery path,
+    # docs/resilience.md#recovery): at the first log step >= the trigger,
+    # poison the host-side loss/grad_norm metrics — nan_step makes them
+    # non-finite (NanGuard's NonFiniteLossError path), spike_step scales
+    # them by spike_scale (the LossSpikeError path). Host-side only: the
+    # device state stays healthy, which is exactly what the recovery loop
+    # needs to prove (rollback + skip + replay on CPU, no real divergence
+    # required)
+    nan_step: int | None = None
+    spike_step: int | None = None
+    spike_scale: float = Field(1e3, gt=0)
 
     def any_active(self) -> bool:
         return bool(
@@ -61,6 +77,9 @@ class ChaosConfig(BaseModel):
             or self.data_error_prob
             or self.checkpoint_error_prob
             or self.sigterm_step is not None
+            or self.sigkill_step is not None
+            or self.nan_step is not None
+            or self.spike_step is not None
         )
 
 
@@ -68,8 +87,9 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
     """Overlay `LLMT_CHAOS_*` environment variables on `base`:
     LLMT_CHAOS_DATA_ERROR_STEPS / LLMT_CHAOS_CHECKPOINT_ERROR_STEPS
     (comma-separated ints), LLMT_CHAOS_DATA_ERROR_PROB /
-    LLMT_CHAOS_CHECKPOINT_ERROR_PROB (floats), LLMT_CHAOS_SIGTERM_STEP,
-    LLMT_CHAOS_SEED (ints)."""
+    LLMT_CHAOS_CHECKPOINT_ERROR_PROB / LLMT_CHAOS_SPIKE_SCALE (floats),
+    LLMT_CHAOS_SIGTERM_STEP / LLMT_CHAOS_SIGKILL_STEP / LLMT_CHAOS_NAN_STEP
+    / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SEED (ints)."""
     update: dict = {}
     for field, cast in (
         ("data_error_steps", _int_tuple),
@@ -77,6 +97,10 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
         ("data_error_prob", float),
         ("checkpoint_error_prob", float),
         ("sigterm_step", int),
+        ("sigkill_step", int),
+        ("nan_step", int),
+        ("spike_step", int),
+        ("spike_scale", float),
         ("seed", int),
     ):
         raw = os.environ.get(ENV_PREFIX + field.upper())
@@ -143,6 +167,60 @@ class Chaos:
         logger.warning("chaos: delivering SIGTERM to self at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
         return True
+
+    def maybe_sigkill(self, step: int, fresh_start: bool) -> None:
+        """SIGKILL this process at the trigger step — but only in a run
+        that started from step 0 (`fresh_start`): SIGKILL leaves no chance
+        to record the shot, so a supervisor's relaunch (which resumes past
+        a checkpoint and is NOT a fresh start) must survive re-crossing the
+        trigger step or the restart budget burns on one injection."""
+        if self.config.sigkill_step is None or not fresh_start:
+            return
+        if step != self.config.sigkill_step:
+            return
+        self._count()
+        logger.warning("chaos: delivering SIGKILL to self at step %d", step)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_poison_metrics(
+        self, step: int, metrics: dict, fresh_start: bool = True
+    ) -> list[str]:
+        """Divergence injection: at the first log step >= each armed
+        trigger, poison the host metrics dict in place — `nan_step` sets
+        loss/grad_norm non-finite, `spike_step` multiplies them by
+        `spike_scale`. Each trigger fires once per process, and (like
+        `maybe_sigkill`) only in a run that started from step 0: a
+        supervised relaunch resuming past a checkpoint must not re-fire
+        the trigger its predecessor already consumed — that would burn a
+        rollback (or exit 77/78) on every restart. Returns the kinds
+        fired."""
+        if not fresh_start:
+            return []
+        fired: list[str] = []
+        for kind, trigger in (
+            ("nan", self.config.nan_step),
+            ("spike", self.config.spike_step),
+        ):
+            if trigger is None or step < trigger:
+                continue
+            with self._lock:
+                if (kind, trigger) in self._fired:
+                    continue
+                self._fired.add((kind, trigger))
+            self._count()
+            logger.warning(
+                "chaos: injecting %s into loss/grad_norm at step %d "
+                "(trigger %d)", kind, step, trigger,
+            )
+            for name in ("loss", "grad_norm"):
+                if name not in metrics:
+                    continue
+                if kind == "nan":
+                    metrics[name] = float("nan")
+                else:
+                    metrics[name] = float(metrics[name]) * self.config.spike_scale
+            fired.append(kind)
+        return fired
 
 
 # ---------------------------------------------------------------- current

@@ -1,0 +1,212 @@
+"""Crash-restart supervisor: the recovery layer for deaths no in-process
+code can survive.
+
+`recovery.py` heals detected divergence without leaving the process; this
+module covers everything else — SIGKILL (OOM killer), segfaults in native
+code, the watchdog's SIGABRT, and graceful preemptions (exit 75). The
+`supervise` CLI subcommand runs `fit` as a child process and relaunches it:
+
+- **exit 0** — run complete, supervisor exits 0;
+- **exit 75** (`RESUMABLE_EXIT_CODE`) — preempted after committing an
+  emergency checkpoint: relaunch the same command (the existing
+  `maybe_restore` path resumes exactly);
+- **negative returncode** (the child died on a signal: SIGKILL -9,
+  SIGSEGV -11, SIGABRT -6, ...) — a hard death: relaunch; the restore
+  fallback skips any checkpoint the death left partial;
+- **any other exit** (incl. 76/77/78, the recovery-escalation codes) — a
+  real failure a blind relaunch would only reproduce: give up and
+  propagate the child's code.
+
+Restarts are budgeted (`max_restarts`) with exponential backoff, the
+parent environment passes through to every child (plus optional
+overrides), and every lifecycle event appends to a `supervisor.jsonl` log
+(launch/exit/restart/giveup/complete with timestamps, runtimes, and
+decoded signal names) so a pod's churn is auditable after the fact.
+
+The supervisor itself never imports jax — it must not touch the TPU the
+child needs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Callable, Sequence
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from llm_training_tpu.resilience.shutdown import RESUMABLE_EXIT_CODE
+
+logger = logging.getLogger(__name__)
+
+
+class SupervisorConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+    # restarts (not launches) before giving up and propagating the child's
+    # last exit code
+    max_restarts: int = Field(10, ge=0)
+    backoff_base_s: float = Field(1.0, ge=0)
+    backoff_factor: float = Field(2.0, ge=1)
+    backoff_max_s: float = Field(300.0, ge=0)
+    # a child that ran at least this long before dying resets the backoff
+    # (it made real progress; the next death is a fresh incident, not a
+    # crash loop)
+    healthy_runtime_s: float = Field(600.0, ge=0)
+    # exit codes that mean "relaunch me" (75 = preempted-but-resumable)
+    restart_codes: tuple[int, ...] = (RESUMABLE_EXIT_CODE,)
+    # relaunch on signal deaths (SIGKILL/OOM, SIGSEGV, watchdog SIGABRT)
+    restart_on_signals: bool = True
+    # supervisor.jsonl event log (None = no log file; events still go to
+    # the logger)
+    log_path: str | None = None
+
+
+def _signal_name(returncode: int) -> str | None:
+    if returncode >= 0:
+        return None
+    try:
+        return signal.Signals(-returncode).name
+    except ValueError:
+        return f"signal {-returncode}"
+
+
+def _exit_code(rc: int) -> int:
+    """A subprocess returncode as a propagatable exit code: signal deaths
+    (negative) become the shell convention 128+signum — returning the raw
+    negative value would be truncated mod 256 by the OS into garbage
+    (e.g. -9 -> 247)."""
+    return 128 - rc if rc < 0 else rc
+
+
+class Supervisor:
+    """Runs `argv` as a child process under the restart policy above.
+
+    `env` overlays the inherited environment (passthrough by default);
+    `sleep`/`run_child` are injection points for tests."""
+
+    def __init__(
+        self,
+        argv: Sequence[str],
+        config: SupervisorConfig | None = None,
+        env: dict[str, str] | None = None,
+        sleep: Callable[[float], None] = time.sleep,
+        run_child: Callable[[list[str]], int] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        relaunch_argv: Sequence[str] | None = None,
+    ):
+        self.argv = list(argv)
+        # relaunches may need a different command than the first launch
+        # (e.g. dropping an explicit --ckpt-path, which must not rewind
+        # every restart to the same pinned step)
+        self.relaunch_argv = list(relaunch_argv) if relaunch_argv else self.argv
+        self.config = config or SupervisorConfig()
+        self.env = {**os.environ, **(env or {})}
+        self._sleep = sleep
+        self._clock = clock
+        self._run_child = run_child or self._spawn
+        self.restarts = 0
+        self.events: list[dict] = []  # in-memory mirror of supervisor.jsonl
+
+    # ------------------------------------------------------------ plumbing
+
+    def _spawn(self, argv: list[str]) -> int:
+        return subprocess.call(argv, env=self.env)
+
+    def _log(self, event: str, **fields: Any) -> None:
+        record = {"ts": time.time(), "event": event, **fields}
+        self.events.append(record)
+        logger.info("supervisor: %s %s", event, fields)
+        if self.config.log_path:
+            try:
+                path = Path(self.config.log_path)
+                path.parent.mkdir(parents=True, exist_ok=True)
+                with open(path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
+            except OSError:
+                logger.exception("supervisor: could not append %s", event)
+
+    def _should_restart(self, rc: int) -> tuple[bool, str]:
+        if rc in self.config.restart_codes:
+            return True, f"resumable exit {rc}"
+        name = _signal_name(rc)
+        if name is not None and self.config.restart_on_signals:
+            return True, f"hard death ({name})"
+        if name is not None:
+            return False, f"hard death ({name}), restart_on_signals off"
+        return False, f"non-resumable exit {rc}"
+
+    # ------------------------------------------------------------ main loop
+
+    def run(self) -> int:
+        cfg = self.config
+        consecutive = 0  # backoff exponent; healthy runtimes reset it
+        attempt = 0
+        while True:
+            attempt += 1
+            argv = self.argv if attempt == 1 else self.relaunch_argv
+            self._log("launch", attempt=attempt, argv=argv)
+            t0 = self._clock()
+            rc = self._run_child(argv)
+            runtime_s = self._clock() - t0
+            self._log(
+                "exit",
+                attempt=attempt,
+                rc=rc,
+                signal=_signal_name(rc),
+                runtime_s=round(runtime_s, 3),
+            )
+            if rc == 0:
+                self._log("complete", attempts=attempt, restarts=self.restarts)
+                return 0
+            restart, reason = self._should_restart(rc)
+            if not restart:
+                self._log("giveup", rc=rc, reason=reason)
+                return _exit_code(rc)
+            if self.restarts >= cfg.max_restarts:
+                self._log(
+                    "giveup",
+                    rc=rc,
+                    reason=f"restart budget exhausted ({cfg.max_restarts})",
+                )
+                return _exit_code(rc)
+            if runtime_s >= cfg.healthy_runtime_s:
+                consecutive = 0
+            delay = min(
+                cfg.backoff_base_s * (cfg.backoff_factor ** consecutive),
+                cfg.backoff_max_s,
+            )
+            consecutive += 1
+            self.restarts += 1
+            self._log(
+                "restart",
+                attempt=attempt + 1,
+                reason=reason,
+                backoff_s=round(delay, 3),
+                restarts=self.restarts,
+            )
+            if delay > 0:
+                self._sleep(delay)
+
+
+def build_fit_argv(
+    config_path: str,
+    overrides: Sequence[str] = (),
+    ckpt_path: str | None = None,
+) -> list[str]:
+    """The child `fit` command: this interpreter, this package, the same
+    config/overrides. `ckpt_path` (first launch only — pass it to the
+    Supervisor's `argv`, not `relaunch_argv`) pins an explicit resume step;
+    relaunches must restore the newest checkpoint or every restart would
+    rewind to the pinned step."""
+    argv = [sys.executable, "-m", "llm_training_tpu", "fit", "--config", config_path]
+    if ckpt_path:
+        argv += ["--ckpt-path", str(ckpt_path)]
+    argv += list(overrides)
+    return argv

@@ -181,19 +181,10 @@ def _decode_section(telemetry: dict) -> list[str]:
     return ["", "== Inference =="] + lines
 
 
-def _resilience_section(telemetry: dict) -> list[str]:
-    """Fault-tolerance event counters (`resilience/*` plus the retry
-    counters — docs/resilience.md): rendered only when the run recorded at
-    least one such event, so a clean run's report stays unchanged."""
-    rows = [
-        ("resilience/preemptions", "preemptions (graceful shutdowns)"),
-        ("resilience/emergency_saves", "emergency checkpoint saves"),
-        ("resilience/restore_fallbacks", "restore fallbacks (corrupt step skipped)"),
-        ("resilience/watchdog_dumps", "watchdog hang dumps"),
-        ("resilience/chaos_injections", "chaos-injected faults"),
-        ("data/retries", "data-source retries"),
-        ("checkpoint/retries", "checkpoint I/O retries"),
-    ]
+def _counter_section(title: str, rows: list[tuple[str, str]], telemetry: dict) -> list[str]:
+    """An event-counter section: one `label: count` line per nonzero
+    counter, the whole section omitted when nothing fired — a clean run's
+    report stays unchanged."""
     lines = []
     for key, label in rows:
         try:
@@ -204,7 +195,33 @@ def _resilience_section(telemetry: dict) -> list[str]:
             lines.append(f"{label}: {int(value)}")
     if not lines:
         return []
-    return ["", "== Resilience =="] + lines
+    return ["", f"== {title} =="] + lines
+
+
+def _recovery_section(telemetry: dict) -> list[str]:
+    """Self-healing events (`resilience/rollbacks` etc. —
+    docs/resilience.md#recovery)."""
+    return _counter_section("Recovery", [
+        ("resilience/rollbacks", "in-process rollbacks (rewind + resume)"),
+        ("resilience/skip_windows", "poisoned data windows skipped"),
+        ("resilience/skipped_steps", "micro-steps served from the reserve pool"),
+        ("resilience/lr_cooldowns", "temporary LR cooldowns applied"),
+        ("resilience/recovery_escalations", "recovery escalations (budget/same-step)"),
+    ], telemetry)
+
+
+def _resilience_section(telemetry: dict) -> list[str]:
+    """Fault-tolerance event counters (`resilience/*` plus the retry
+    counters — docs/resilience.md)."""
+    return _counter_section("Resilience", [
+        ("resilience/preemptions", "preemptions (graceful shutdowns)"),
+        ("resilience/emergency_saves", "emergency checkpoint saves"),
+        ("resilience/restore_fallbacks", "restore fallbacks (corrupt step skipped)"),
+        ("resilience/watchdog_dumps", "watchdog hang dumps"),
+        ("resilience/chaos_injections", "chaos-injected faults"),
+        ("data/retries", "data-source retries"),
+        ("checkpoint/retries", "checkpoint I/O retries"),
+    ], telemetry)
 
 
 def render_report(run_dir: str | Path) -> str:
@@ -299,6 +316,7 @@ def render_report(run_dir: str | Path) -> str:
 
     lines.extend(_health_section(telemetry))
     lines.extend(_decode_section(telemetry))
+    lines.extend(_recovery_section(telemetry))
     lines.extend(_resilience_section(telemetry))
     return "\n".join(lines)
 

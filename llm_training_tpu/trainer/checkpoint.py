@@ -96,6 +96,7 @@ class Checkpointer:
         state: TrainState,
         counters: dict[str, int] | None = None,
         force: bool = False,
+        extra: dict | None = None,
     ) -> None:
         # surface a parked async failure even when THIS call dedupes away —
         # "failures surface at the next save point" must include skipped ones
@@ -107,6 +108,10 @@ class Checkpointer:
             "counters": counters or {},
             "config": self.run_config,
             "run_metadata": self.run_metadata,
+            # JSON-serializable run-state riders: the recovery skip list /
+            # cooldown windows and callback state (NanGuard EMA) — what a
+            # resume needs beyond the array tree (docs/resilience.md)
+            **(extra or {}),
         }
         from llm_training_tpu.resilience import RetryPolicy, chaos_point, retry_call
         from llm_training_tpu.telemetry import get_registry

@@ -23,6 +23,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from pydantic import BaseModel, ConfigDict
 
+from llm_training_tpu.callbacks.nan_guard import LossSpikeError, NonFiniteLossError
 from llm_training_tpu.optim.builder import build_optimizer
 from llm_training_tpu.optim.quantized_state import (
     cast_state,
@@ -39,6 +40,7 @@ from llm_training_tpu.resilience import (
     GracefulShutdown,
     HangWatchdog,
     PreemptionInterrupt,
+    RecoveryManager,
     ResilienceConfig,
     config_from_env,
     get_chaos,
@@ -194,6 +196,13 @@ class Trainer:
         self._shutdown: GracefulShutdown | None = None
         self._watchdog: HangWatchdog | None = None
         self._preempted = False
+        # rollback-and-skip recovery (resilience/recovery.py): built per fit
+        # when cfg.resilience.recovery is set; the save path persists its
+        # skip-list/cooldown metadata into every checkpoint
+        self._recovery: RecoveryManager | None = None
+        # metadata of the checkpoint this fit restored from (callback state
+        # + recovery riders come out of it); None on fresh starts
+        self._restored_meta: dict | None = None
         # optimizer step of the newest in-loop interval save this fit (the
         # final-save epilogue skips re-saving an identical step)
         self._last_interval_save: int | None = None
@@ -221,14 +230,18 @@ class Trainer:
 
     # ------------------------------------------------------------ setup
 
-    def _build_tx(self, objective) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    def _build_tx(
+        self, objective, schedule_transform: Callable | None = None
+    ) -> tuple[optax.GradientTransformation, optax.Schedule]:
         """Decide the optimizer LAYOUT and build the transformation. The
         blocked (per-leaf) offload step needs a clip-free leaf-local
         transform; accumulation (MultiSteps wraps the whole tree) and
         path-named freeze masks fall back to the serialized round trip.
         fit and validate_from_checkpoint both go through here so the
         opt_state pytree layout — which checkpoints persist — always
-        matches."""
+        matches. `schedule_transform` (the recovery LR cooldown) wraps the
+        LR schedule only — it can never change the opt_state layout, so a
+        rebuilt tx accepts a previously-restored state unchanged."""
         cfg = self.config
         self._blocked_offload = (
             cfg.offload_optimizer_state
@@ -258,6 +271,7 @@ class Trainer:
             optim_config,
             num_total_steps=cfg.max_steps,
             frozen_modules=objective.config.frozen_modules or None,
+            schedule_transform=schedule_transform,
         )
         if cfg.accumulate_grad_batches > 1:
             tx = optax.MultiSteps(tx, cfg.accumulate_grad_batches)
@@ -598,6 +612,7 @@ class Trainer:
         batch_shardings = _batch_shardings(sample_batch, self.mesh)
 
         # restore or initialize, directly into sharded buffers
+        self._restored_meta = None
         if state is None and self.checkpointer is not None:
             try:
                 restored = self.checkpointer.maybe_restore(
@@ -617,6 +632,11 @@ class Trainer:
             if restored is not None:
                 state, meta = restored
                 self.counters.update(meta.get("counters", {}))
+                self._restored_meta = meta
+                # callback state riders (NanGuard EMA/z-score trackers):
+                # without this every resume restarts the spike detector's
+                # warmup blind — right when spikes are most likely
+                self._load_callback_state(meta)
         pre_trained = (
             objective.pretrained_source()
             if hasattr(objective, "pretrained_source")
@@ -630,21 +650,25 @@ class Trainer:
             init_shardings = jax.tree.map(
                 lambda s: s.with_memory_kind("device"), self.state_shardings
             )
-        if state is None and pre_trained and objective.config.load_weights:
-            # stream HF weights straight into sharded buffers (reference
-            # rank-0-load + broadcast, base_lm.py:175-193)
-            logger.info("loading pre-trained weights from %s", pre_trained)
-            dtypes = jax.tree.map(lambda leaf: leaf.dtype, abstract_state.params)
-            params = objective.pretrained_params(self.state_shardings.params, dtypes)
-            opt_state = jax.jit(
-                lambda p: self._opt_init(tx, p),
-                out_shardings=init_shardings.opt_state,
-            )(params)
-            state = jax.device_put(
-                TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1)),
-                self.state_shardings,
-            )
-        elif state is None:
+
+        def init_state() -> TrainState:
+            """Fresh sharded state (pretrained or seed-init) — the fit-start
+            path, and the recovery rollback target when no committed
+            checkpoint exists (both are deterministic in cfg.seed)."""
+            if pre_trained and objective.config.load_weights:
+                # stream HF weights straight into sharded buffers (reference
+                # rank-0-load + broadcast, base_lm.py:175-193)
+                logger.info("loading pre-trained weights from %s", pre_trained)
+                dtypes = jax.tree.map(lambda leaf: leaf.dtype, abstract_state.params)
+                params = objective.pretrained_params(self.state_shardings.params, dtypes)
+                opt_state = jax.jit(
+                    lambda p: self._opt_init(tx, p),
+                    out_shardings=init_shardings.opt_state,
+                )(params)
+                return jax.device_put(
+                    TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1)),
+                    self.state_shardings,
+                )
             logger.info("initializing parameters on the mesh")
 
             def make_state(rng):
@@ -654,11 +678,32 @@ class Trainer:
                     TrainState.create(params, opt_state, jax.random.key(cfg.seed + 1))
                 )
 
-            state = jax.jit(make_state, out_shardings=init_shardings)(
+            fresh = jax.jit(make_state, out_shardings=init_shardings)(
                 jax.random.key(cfg.seed)
             )
             if cfg.offload_optimizer_state:
-                state = jax.device_put(state, self.state_shardings)
+                fresh = jax.device_put(fresh, self.state_shardings)
+            return fresh
+
+        if state is None:
+            state = init_state()
+
+        # rollback-and-skip recovery (resilience/recovery.py): restore the
+        # persisted skip-list/cooldown riders so a resumed run replays the
+        # same data skips and LR; a restored cooldown window re-wraps the
+        # schedule before the steps compile (layout untouched)
+        recovery = None
+        self._recovery = None
+        if cfg.resilience.recovery is not None:
+            recovery = RecoveryManager(
+                cfg.resilience.recovery,
+                registry=self.telemetry,
+                metadata=(self._restored_meta or {}).get("recovery"),
+            )
+            self._recovery = recovery
+            transform = recovery.schedule_transform()
+            if transform is not None:
+                tx, schedule = self._build_tx(objective, schedule_transform=transform)
 
         train_step = jax.jit(
             self._build_step(objective, tx),
@@ -722,8 +767,10 @@ class Trainer:
         # the accumulation factor
         start_micro = int(jax.device_get(state.step))
         micro_steps = cfg.max_steps * cfg.accumulate_grad_batches
-        batches = datamodule.train_batches(start_step=start_micro)
-        prefetcher = None
+        # chaos SIGKILL only fires in runs that started from scratch, so a
+        # supervisor's relaunch (resuming past a checkpoint) survives the
+        # trigger step (chaos.maybe_sigkill, the supervise-gate contract)
+        fresh_start = start_micro == 0
 
         for cb in self.callbacks:
             if hasattr(cb, "on_fit_start"):
@@ -740,223 +787,337 @@ class Trainer:
         self.last_seq_len = (
             sample_batch["input_ids"].shape[1] if "input_ids" in sample_batch else None
         )
-        # throughput window: (start time, start step). Reset after the first
-        # optimizer step of this process so JIT compile/warmup never skews
-        # steps_per_sec (compile is its own telemetry gauge + goodput phase).
-        start_step0 = start_micro // cfg.accumulate_grad_batches
-        first_process_step = start_step0 + 1
-        window_time, window_step = time.perf_counter(), start_step0
-        try:
-            # constructed inside the try so an exception anywhere after the
-            # worker thread starts still reaches prefetcher.close()
-            if cfg.prefetch_batches > 0:
-                from llm_training_tpu.data.prefetch import DevicePrefetcher
 
-                watchdog = self._watchdog
-                prefetcher = DevicePrefetcher(
-                    batches,
-                    batch_shardings,
-                    depth=cfg.prefetch_batches,
-                    host_aux_fn=self._batch_counts,
-                    registry=self.telemetry,
-                    retries=cfg.resilience.data_retries,
-                    retry_backoff_s=cfg.resilience.data_retry_backoff_s,
-                    heartbeat=(
-                        (lambda: watchdog.beat("prefetcher")) if watchdog else None
-                    ),
+        skip_list = recovery.skip_list if recovery is not None else None
+
+        def data_stream(from_micro: int):
+            # the skip-list keyword only reaches datamodules when recovery
+            # is on — the default stream stays byte-identical to a
+            # recovery-less build (and to subclasses overriding
+            # train_batches with the historical signature)
+            if skip_list is not None:
+                return datamodule.train_batches(
+                    start_step=from_micro, skip_list=skip_list
                 )
-                batches = iter(prefetcher)
-            for micro in range(start_micro, micro_steps):
-                if self._watchdog is not None:
-                    self._watchdog.beat("train_loop", step=micro)
-                with jax.profiler.StepTraceAnnotation("train", step_num=micro):
-                    with self.ledger.measure("data_wait"), \
-                            jax.profiler.TraceAnnotation("data_load"):
-                        if prefetcher is not None:
-                            batch, counts = next(batches)
-                        else:
-                            batch = next(batches)
-                            counts = self._batch_counts(batch)
-                    # health cadence: the instrumented variant runs on the
-                    # optimizer steps `health.every_n_steps` selects (its jit
-                    # recompiles per shape natively; first compile bills to
-                    # the compile phase like the AOT step's)
-                    use_health = (
-                        health_step is not None
-                        and (micro + 1) % cfg.accumulate_grad_batches == 0
-                        and ((micro + 1) // cfg.accumulate_grad_batches)
-                        % health_every == 0
+            return datamodule.train_batches(start_step=from_micro)
+
+        def run_segment(state: TrainState, seg_start: int) -> TrainState:
+            """One recoverable stretch of the micro-step loop: from
+            `seg_start` to completion (or a guard raise / stop request).
+            The recovery path catches NanGuard errors around this, rolls
+            the state back, and re-enters with a later-start segment —
+            with recovery unset there is exactly one segment and the loop
+            below is the whole fit, byte-identical to before."""
+            nonlocal health_compiled, step_fn
+            prefetcher = None
+            batches = data_stream(seg_start)
+            # throughput window: (start time, start step). Reset after the
+            # first optimizer step of this segment so JIT compile/warmup
+            # never skews steps_per_sec (compile is its own telemetry gauge
+            # + goodput phase).
+            start_step0 = seg_start // cfg.accumulate_grad_batches
+            first_process_step = start_step0 + 1
+            window_time, window_step = time.perf_counter(), start_step0
+            try:
+                # constructed inside the try so an exception anywhere after
+                # the worker thread starts still reaches prefetcher.close()
+                if cfg.prefetch_batches > 0:
+                    from llm_training_tpu.data.prefetch import DevicePrefetcher
+
+                    watchdog = self._watchdog
+                    prefetcher = DevicePrefetcher(
+                        # an iterator FACTORY, not a bare iterator: data
+                        # retries can then rebuild a closed generator at the
+                        # batch being retried (docs/resilience.md)
+                        lambda produced: data_stream(seg_start + produced),
+                        batch_shardings,
+                        depth=cfg.prefetch_batches,
+                        host_aux_fn=self._batch_counts,
+                        registry=self.telemetry,
+                        retries=cfg.resilience.data_retries,
+                        retry_backoff_s=cfg.resilience.data_retry_backoff_s,
+                        heartbeat=(
+                            (lambda: watchdog.beat("prefetcher")) if watchdog else None
+                        ),
                     )
-                    # without the AOT pre-compile, the first invocation blocks
-                    # on trace+compile — bill it to the compile phase
-                    first_compiling = aot_step is None and micro == start_micro
-                    phase = "compile" if first_compiling else "step_compute"
-                    t_step = time.perf_counter()
-                    if use_health:
-                        health_phase = (
-                            "compile" if not health_compiled else "step_compute"
+                    batches = iter(prefetcher)
+                for micro in range(seg_start, micro_steps):
+                    if self._watchdog is not None:
+                        self._watchdog.beat("train_loop", step=micro)
+                    with jax.profiler.StepTraceAnnotation("train", step_num=micro):
+                        with self.ledger.measure("data_wait"), \
+                                jax.profiler.TraceAnnotation("data_load"):
+                            if prefetcher is not None:
+                                batch, counts = next(batches)
+                            else:
+                                batch = next(batches)
+                                counts = self._batch_counts(batch)
+                        # health cadence: the instrumented variant runs on the
+                        # optimizer steps `health.every_n_steps` selects (its jit
+                        # recompiles per shape natively; first compile bills to
+                        # the compile phase like the AOT step's)
+                        use_health = (
+                            health_step is not None
+                            and (micro + 1) % cfg.accumulate_grad_batches == 0
+                            and ((micro + 1) // cfg.accumulate_grad_batches)
+                            % health_every == 0
                         )
-                        with self.ledger.measure(health_phase), \
-                                jax.profiler.TraceAnnotation("train_step"):
-                            state, metrics = health_step(state, batch)
-                        if not health_compiled and aot_step is None:
-                            # no plain-step AOT ran: the health compile IS
-                            # the run's train-step compile
+                        # without the AOT pre-compile, the first invocation blocks
+                        # on trace+compile — bill it to the compile phase
+                        first_compiling = aot_step is None and micro == seg_start
+                        phase = "compile" if first_compiling else "step_compute"
+                        t_step = time.perf_counter()
+                        if use_health:
+                            health_phase = (
+                                "compile" if not health_compiled else "step_compute"
+                            )
+                            with self.ledger.measure(health_phase), \
+                                    jax.profiler.TraceAnnotation("train_step"):
+                                state, metrics = health_step(state, batch)
+                            if not health_compiled and aot_step is None:
+                                # no plain-step AOT ran: the health compile IS
+                                # the run's train-step compile
+                                self.telemetry.gauge("compile_time_s").set(
+                                    time.perf_counter() - t_step
+                                )
+                            health_compiled = True
+                            first_compiling = False
+                        else:
+                            try:
+                                with self.ledger.measure(phase), \
+                                        jax.profiler.TraceAnnotation("train_step"):
+                                    state, metrics = step_fn(state, batch)
+                            except TypeError:
+                                # the AOT executable is pinned to sample_batch's
+                                # shapes; pad-to-longest collators emit variable
+                                # sequence lengths. The mismatch raises BEFORE
+                                # execution (donated buffers intact), so fall back
+                                # permanently to the jitted callable, which
+                                # recompiles per shape like it always did. The
+                                # retry (jit trace + compile) bills to the compile
+                                # phase; LATER new-shape recompiles are invisible
+                                # inside the jit call and land in step_compute —
+                                # the warning below is the flag that this is
+                                # happening
+                                if step_fn is train_step:
+                                    raise
+                                logger.warning(
+                                    "AOT train step rejected batch shapes at "
+                                    "micro step %d (variable-length batches?); "
+                                    "falling back to jit recompilation", micro,
+                                )
+                                step_fn = train_step
+                                with self.ledger.measure("compile"), \
+                                        jax.profiler.TraceAnnotation("train_step"):
+                                    state, metrics = step_fn(state, batch)
+                        if first_compiling:
                             self.telemetry.gauge("compile_time_s").set(
                                 time.perf_counter() - t_step
                             )
-                        health_compiled = True
-                        first_compiling = False
-                    else:
-                        try:
-                            with self.ledger.measure(phase), \
-                                    jax.profiler.TraceAnnotation("train_step"):
-                                state, metrics = step_fn(state, batch)
-                        except TypeError:
-                            # the AOT executable is pinned to sample_batch's
-                            # shapes; pad-to-longest collators emit variable
-                            # sequence lengths. The mismatch raises BEFORE
-                            # execution (donated buffers intact), so fall back
-                            # permanently to the jitted callable, which
-                            # recompiles per shape like it always did. The
-                            # retry (jit trace + compile) bills to the compile
-                            # phase; LATER new-shape recompiles are invisible
-                            # inside the jit call and land in step_compute —
-                            # the warning below is the flag that this is
-                            # happening
-                            if step_fn is train_step:
-                                raise
-                            logger.warning(
-                                "AOT train step rejected batch shapes at "
-                                "micro step %d (variable-length batches?); "
-                                "falling back to jit recompilation", micro,
+
+                    self._apply_counts(counts)
+
+                    if (micro + 1) % cfg.accumulate_grad_batches != 0:
+                        continue
+                    step = (micro + 1) // cfg.accumulate_grad_batches
+                    self.last_step = step
+                    # fresh (non-donated) device arrays; callbacks that need wall-
+                    # clock accuracy can jax.block_until_ready(trainer.last_metrics)
+                    self.last_metrics = metrics
+                    if use_health:
+                        # pull the health metrics to host and publish them as
+                        # registry gauges: telemetry.jsonl, W&B, and `report` get
+                        # them through the registry snapshot on log steps with no
+                        # extra wiring, and NaN/spike provenance (nan_guard)
+                        # reads the stash. The blocking fetch drains the dispatch
+                        # queue, so it bills to step_compute like the log fetch —
+                        # this sync IS the overhead bench.py's
+                        # health_overhead_pct measures.
+                        health_keys = [k for k in metrics if k.startswith("health/")]
+                        with self.ledger.measure("step_compute"):
+                            host = jax.device_get({k: metrics[k] for k in health_keys})
+                        for key in health_keys:
+                            del metrics[key]
+                        self.last_health = {k: float(v) for k, v in host.items()}
+                        for key, value in self.last_health.items():
+                            self.telemetry.gauge(key).set(value)
+                    for cb in self.callbacks:
+                        # fires EVERY optimizer step (no metrics, no device sync);
+                        # on_step_end below fires only on log steps with host metrics
+                        if hasattr(cb, "on_train_step"):
+                            cb.on_train_step(self, step)
+
+                    if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
+                        # ONE batched transfer: per-value device_get pays one
+                        # host<->device round trip per metric, which on a
+                        # remote-attached TPU leaves the chip idle between steps.
+                        # The blocking fetch drains the async dispatch queue, so
+                        # its wall time is accumulated device step time —
+                        # goodput bills it to step_compute
+                        with self.ledger.measure("step_compute"):
+                            metrics = {
+                                k: np.asarray(v) for k, v in jax.device_get(metrics).items()
+                            }
+                        # divergence injection (chaos nan_step/spike_step):
+                        # poison the HOST metrics the guards read — the
+                        # device state stays healthy, which is exactly what
+                        # the rollback-and-skip loop needs to prove on CPU
+                        chaos = get_chaos()
+                        if chaos is not None:
+                            chaos.maybe_poison_metrics(
+                                step, metrics, fresh_start=fresh_start
                             )
-                            step_fn = train_step
-                            with self.ledger.measure("compile"), \
-                                    jax.profiler.TraceAnnotation("train_step"):
-                                state, metrics = step_fn(state, batch)
-                    if first_compiling:
-                        self.telemetry.gauge("compile_time_s").set(
-                            time.perf_counter() - t_step
+                        now = time.perf_counter()
+                        metrics["lr"] = np.asarray(schedule(step))
+                        metrics["steps_per_sec"] = (step - window_step) / max(
+                            now - window_time, 1e-9
                         )
+                        metrics.update(self.counters)
+                        window_time, window_step = now, step
+                        # telemetry rides the metrics dict: JSONL/W&B loggers
+                        # persist the goodput breakdown, device gauges, and
+                        # registry snapshot (compile_time_s, data/*, checkpoint/*)
+                        metrics.update(self.ledger.summary())
+                        metrics.update(hbm_gauges())
+                        metrics.update(self.telemetry.snapshot())
+                        logger.info(
+                            "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s "
+                            "| goodput %.1f%%",
+                            step, metrics["loss"], metrics["grad_norm"],
+                            metrics["steps_per_sec"], metrics["goodput/goodput_pct"],
+                        )
+                        for cb in self.callbacks:
+                            if hasattr(cb, "on_step_end"):
+                                cb.on_step_end(self, step, metrics)
 
-                self._apply_counts(counts)
+                    if step == first_process_step:
+                        # drop the compile/warmup-laden first step from the next
+                        # throughput window (after its possible log above)
+                        window_time, window_step = time.perf_counter(), step
 
-                if (micro + 1) % cfg.accumulate_grad_batches != 0:
-                    continue
-                step = (micro + 1) // cfg.accumulate_grad_batches
-                self.last_step = step
-                # fresh (non-donated) device arrays; callbacks that need wall-
-                # clock accuracy can jax.block_until_ready(trainer.last_metrics)
-                self.last_metrics = metrics
-                if use_health:
-                    # pull the health metrics to host and publish them as
-                    # registry gauges: telemetry.jsonl, W&B, and `report` get
-                    # them through the registry snapshot on log steps with no
-                    # extra wiring, and NaN/spike provenance (nan_guard)
-                    # reads the stash. The blocking fetch drains the dispatch
-                    # queue, so it bills to step_compute like the log fetch —
-                    # this sync IS the overhead bench.py's
-                    # health_overhead_pct measures.
-                    health_keys = [k for k in metrics if k.startswith("health/")]
-                    with self.ledger.measure("step_compute"):
-                        host = jax.device_get({k: metrics[k] for k in health_keys})
-                    for key in health_keys:
-                        del metrics[key]
-                    self.last_health = {k: float(v) for k, v in host.items()}
-                    for key, value in self.last_health.items():
-                        self.telemetry.gauge(key).set(value)
-                for cb in self.callbacks:
-                    # fires EVERY optimizer step (no metrics, no device sync);
-                    # on_step_end below fires only on log steps with host metrics
-                    if hasattr(cb, "on_train_step"):
-                        cb.on_train_step(self, step)
+                    if cfg.val_check_interval and step % cfg.val_check_interval == 0:
+                        with self.ledger.measure("validation"), \
+                                jax.profiler.TraceAnnotation("validation"):
+                            self._run_validation(eval_step, state, datamodule, step)
 
-                if step % cfg.log_every_n_steps == 0 or step == cfg.max_steps:
-                    # ONE batched transfer: per-value device_get pays one
-                    # host<->device round trip per metric, which on a
-                    # remote-attached TPU leaves the chip idle between steps.
-                    # The blocking fetch drains the async dispatch queue, so
-                    # its wall time is accumulated device step time —
-                    # goodput bills it to step_compute
-                    with self.ledger.measure("step_compute"):
-                        metrics = {
-                            k: np.asarray(v) for k, v in jax.device_get(metrics).items()
-                        }
-                    now = time.perf_counter()
-                    metrics["lr"] = np.asarray(schedule(step))
-                    metrics["steps_per_sec"] = (step - window_step) / max(
-                        now - window_time, 1e-9
+                    if (
+                        self.checkpointer is not None
+                        and cfg.checkpoint_every_n_steps
+                        and step % cfg.checkpoint_every_n_steps == 0
+                        # a guard may have flagged THIS step's state as diverged
+                        # (on_step_end runs first) — never persist it
+                        and not self.abort_final_save
+                        # guards only see metrics on log steps; the save gate must
+                        # not trust log cadence — check this step's loss directly
+                        and self._loss_finite(metrics, step)
+                    ):
+                        with self.ledger.measure("checkpoint_save"), \
+                                jax.profiler.TraceAnnotation("checkpoint_save"):
+                            self.checkpointer.save(
+                                step, state, counters=dict(self.counters),
+                                extra=self._save_extra(),
+                            )
+                        self._last_interval_save = step
+
+                    # simulated failures (fault injection): a REAL SIGTERM to
+                    # this process, so the whole handler -> boundary-check ->
+                    # emergency-save path below is the one being exercised;
+                    # or a SIGKILL — the hard death only `supervise` survives
+                    chaos = get_chaos()
+                    if chaos is not None:
+                        chaos.maybe_sigterm(step)
+                        chaos.maybe_sigkill(step, fresh_start)
+
+                    if self._shutdown is not None and self._shutdown.should_stop(
+                        step, cfg.resilience.preemption_sync_every_n_steps
+                    ):
+                        logger.warning(
+                            "preemption (%s) at step %d: committing an emergency "
+                            "checkpoint, then exiting resumable",
+                            self._shutdown.reason, step,
+                        )
+                        self.telemetry.counter("resilience/preemptions").inc()
+                        self._preempted = True
+                        self.should_stop = True
+
+                    if self.should_stop:
+                        logger.info("stopping at step %d (callback request)", step)
+                        break
+                return state
+            finally:
+                if prefetcher is not None:
+                    prefetcher.close()
+
+        try:
+            # the recovery driver: one segment with recovery unset; with it,
+            # a NanGuard raise rolls the state back to the last committed
+            # checkpoint, registers the poisoned data window, optionally
+            # cools the LR, and re-enters — all without leaving the process
+            # (docs/resilience.md#recovery). Budget exhaustion re-raises as
+            # RecoveryExhaustedError (CLI exit 76).
+            while True:
+                try:
+                    state = run_segment(state, start_micro)
+                    break
+                except (NonFiniteLossError, LossSpikeError) as failure:
+                    if recovery is None:
+                        raise
+                    # raises RecoveryExhaustedError when the budget is spent
+                    plan = recovery.on_failure(failure, self.last_step or 0)
+                    # the traceback frames pin the (discarded) diverged
+                    # state's buffers; clear them before the restore
+                    # allocates a second copy
+                    import traceback as _tb
+
+                    _tb.clear_frames(failure.__traceback__)
+                    state, start_micro = self._rollback_state(init_state)
+                    failed_micro_end = plan.failed_step * cfg.accumulate_grad_batches
+                    win_start, win_len = recovery.register_skip(
+                        failed_micro_end, start_micro
                     )
-                    metrics.update(self.counters)
-                    window_time, window_step = now, step
-                    # telemetry rides the metrics dict: JSONL/W&B loggers
-                    # persist the goodput breakdown, device gauges, and
-                    # registry snapshot (compile_time_s, data/*, checkpoint/*)
-                    metrics.update(self.ledger.summary())
-                    metrics.update(hbm_gauges())
-                    metrics.update(self.telemetry.snapshot())
-                    logger.info(
-                        "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s "
-                        "| goodput %.1f%%",
-                        step, metrics["loss"], metrics["grad_norm"],
-                        metrics["steps_per_sec"], metrics["goodput/goodput_pct"],
+                    logger.warning(
+                        "recovery rollback %d/%d after %s at step %d: restored "
+                        "micro-step %d, skipping data window [%d, %d), resuming "
+                        "in-process",
+                        plan.rollback_index, recovery.config.max_rollbacks,
+                        type(failure).__name__, plan.failed_step, start_micro,
+                        win_start, win_start + win_len,
                     )
                     for cb in self.callbacks:
-                        if hasattr(cb, "on_step_end"):
-                            cb.on_step_end(self, step, metrics)
-
-                if step == first_process_step:
-                    # drop the compile/warmup-laden first step from the next
-                    # throughput window (after its possible log above)
-                    window_time, window_step = time.perf_counter(), step
-
-                if cfg.val_check_interval and step % cfg.val_check_interval == 0:
-                    with self.ledger.measure("validation"), \
-                            jax.profiler.TraceAnnotation("validation"):
-                        self._run_validation(eval_step, state, datamodule, step)
-
-                if (
-                    self.checkpointer is not None
-                    and cfg.checkpoint_every_n_steps
-                    and step % cfg.checkpoint_every_n_steps == 0
-                    # a guard may have flagged THIS step's state as diverged
-                    # (on_step_end runs first) — never persist it
-                    and not self.abort_final_save
-                    # guards only see metrics on log steps; the save gate must
-                    # not trust log cadence — check this step's loss directly
-                    and self._loss_finite(metrics, step)
-                ):
-                    with self.ledger.measure("checkpoint_save"), \
-                            jax.profiler.TraceAnnotation("checkpoint_save"):
-                        self.checkpointer.save(step, state, counters=dict(self.counters))
-                    self._last_interval_save = step
-
-                # simulated preemption (fault injection): a REAL SIGTERM to
-                # this process, so the whole handler -> boundary-check ->
-                # emergency-save path below is the one being exercised
-                chaos = get_chaos()
-                if chaos is not None:
-                    chaos.maybe_sigterm(step)
-
-                if self._shutdown is not None and self._shutdown.should_stop(
-                    step, cfg.resilience.preemption_sync_every_n_steps
-                ):
-                    logger.warning(
-                        "preemption (%s) at step %d: committing an emergency "
-                        "checkpoint, then exiting resumable",
-                        self._shutdown.reason, step,
-                    )
-                    self.telemetry.counter("resilience/preemptions").inc()
-                    self._preempted = True
-                    self.should_stop = True
-
-                if self.should_stop:
-                    logger.info("stopping at step %d (callback request)", step)
-                    break
+                        if hasattr(cb, "on_rollback"):
+                            cb.on_rollback(
+                                self, start_micro // cfg.accumulate_grad_batches
+                            )
+                    if recovery.register_cooldown(
+                        start_micro // cfg.accumulate_grad_batches
+                    ):
+                        # re-wrap the LR schedule and rebuild the jitted
+                        # steps against it. The opt-state LAYOUT is
+                        # untouched (only the schedule closure changed), so
+                        # the restored state drops straight in; the rebuilt
+                        # step's first call recompiles (billed to the
+                        # compile phase — aot_step is dropped).
+                        tx, schedule = self._build_tx(
+                            objective,
+                            schedule_transform=recovery.schedule_transform(),
+                        )
+                        train_step = jax.jit(
+                            self._build_step(objective, tx),
+                            in_shardings=(self.state_shardings, batch_shardings),
+                            out_shardings=(self.state_shardings, None),
+                            donate_argnums=0,
+                        )
+                        if health_every:
+                            health_step = jax.jit(
+                                self._build_health_step(objective, tx),
+                                in_shardings=(self.state_shardings, batch_shardings),
+                                out_shardings=(self.state_shardings, None),
+                                donate_argnums=0,
+                            )
+                            health_compiled = False
+                        aot_step = None
+                        step_fn = train_step
         finally:
-            if prefetcher is not None:
-                prefetcher.close()
             # the watchdog patrols the LOOP; the epilogue below legitimately
             # blocks on the final save + async barrier for however long the
             # checkpoint takes — a dump (or worse, an abort) mid-commit
@@ -984,7 +1145,8 @@ class Trainer:
                     if self._preempted:
                         self.telemetry.counter("resilience/emergency_saves").inc()
                     self.checkpointer.save(
-                        self.last_step, state, counters=dict(self.counters), force=True
+                        self.last_step, state, counters=dict(self.counters),
+                        force=True, extra=self._save_extra(),
                     )
                 # the barrier: after this, the newest save (emergency or
                 # interval) is durable — safe to exit
@@ -1085,6 +1247,73 @@ class Trainer:
     def _apply_counts(self, counts: tuple[int, int]) -> None:
         self.counters["consumed_samples"] += counts[0]
         self.counters["consumed_tokens"] += counts[1]
+
+    # ------------------------------------------------------------ recovery
+
+    def _rollback_state(self, init_state_fn: Callable) -> tuple[TrainState, int]:
+        """Rewind to the last committed checkpoint (consumed counters and
+        callback state included — replayed steps must not double-count),
+        or to a deterministic fresh init when nothing was ever committed.
+        Returns (state, micro-step to resume from)."""
+        if self.checkpointer is not None:
+            # barrier any in-flight async save first: the newest commit is
+            # the rollback target, not a half-written step
+            with self.ledger.measure("checkpoint_save"):
+                self.checkpointer.wait()
+            restored = self.checkpointer.maybe_restore(
+                self.abstract_state, self.state_shardings
+            )
+            if restored is not None:
+                state, meta = restored
+                self.counters = {"consumed_samples": 0, "consumed_tokens": 0}
+                self.counters.update(meta.get("counters", {}))
+                self._load_callback_state(meta)
+                return state, int(jax.device_get(state.step))
+        logger.warning(
+            "recovery: no committed checkpoint to roll back to — "
+            "re-initializing from step 0"
+        )
+        self.counters = {"consumed_samples": 0, "consumed_tokens": 0}
+        return init_state_fn(), 0
+
+    def _save_extra(self) -> dict:
+        """JSON-serializable checkpoint-metadata riders: the recovery
+        skip-list/cooldown windows (a resumed run must replay the same
+        skips) and every callback's `state_dict` (NanGuard's EMA/z-score
+        trackers and counters)."""
+        extra: dict = {}
+        if self._recovery is not None:
+            extra["recovery"] = self._recovery.metadata()
+        cb_state: dict = {}
+        for cb in self.callbacks:
+            fn = getattr(cb, "state_dict", None)
+            if callable(fn):
+                try:
+                    cb_state[type(cb).__name__] = fn()
+                except Exception:
+                    logger.exception(
+                        "callback %s state_dict failed (not persisted)",
+                        type(cb).__name__,
+                    )
+        if cb_state:
+            extra["callbacks"] = cb_state
+        return extra
+
+    def _load_callback_state(self, meta: dict | None) -> None:
+        """Restore callback state riders from checkpoint metadata (keyed by
+        callback class name; absent entries and failures leave the callback
+        at its fresh-construction state)."""
+        states = (meta or {}).get("callbacks") or {}
+        for cb in self.callbacks:
+            data = states.get(type(cb).__name__)
+            if data is not None and hasattr(cb, "load_state_dict"):
+                try:
+                    cb.load_state_dict(data)
+                except Exception:
+                    logger.exception(
+                        "callback %s load_state_dict failed (starting fresh)",
+                        type(cb).__name__,
+                    )
 
     # ------------------------------------------------------------ validate
 

@@ -1,7 +1,8 @@
-"""Kill-and-resume smoke: prove every recovery path end to end (ISSUE 3).
+"""Kill-and-resume smoke: prove every recovery path end to end (ISSUE 3 +
+the ISSUE 5 exit-code/supervise contracts).
 
-Five legs, all in-process against the real CLI (`cli.main`), on a tiny CPU
-config:
+Legs 1-5 run in-process against the real CLI (`cli.main`) on a tiny CPU
+config; every leg asserts on EXACT exit codes (docs/resilience.md#exit-codes):
 
 1. **Baseline** — an uninterrupted 6-step fit; its per-step losses are the
    ground truth for resume exactness.
@@ -15,6 +16,12 @@ config:
    retry, complete with exit 0, and record `checkpoint/retries` telemetry.
 5. **Corrupt restore** — with the newest checkpoint made partial, restore
    must fall back to the previous retained step instead of crashing.
+6. **Divergence codes** — a chaos-injected loss spike with no recovery
+   configured must exit with exactly `LOSS_SPIKE_EXIT_CODE` (77).
+7. **Supervise** — a child SIGKILLed mid-fit (chaos `sigkill_step`, a hard
+   death) must be relaunched by the `supervise` subcommand, resume past
+   its checkpoint, and complete with exit 0 and a restart event in
+   `supervisor.jsonl`.
 
 Plus a watchdog leg: a forced stall must produce a `hang-dump-*.txt` with
 every thread's stack.
@@ -34,19 +41,27 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import yaml
 
 from llm_training_tpu.cli.main import main as cli_main
-from llm_training_tpu.resilience import RESUMABLE_EXIT_CODE, HangWatchdog
+from llm_training_tpu.resilience import (
+    LOSS_SPIKE_EXIT_CODE,
+    RESUMABLE_EXIT_CODE,
+    HangWatchdog,
+)
 
 MAX_STEPS = 6
 SIGTERM_STEP = 3
 
 
-def _config(scratch: Path, name: str, **trainer_extra) -> Path:
+def _config(
+    scratch: Path, name: str, async_save: bool = True, callbacks: list | None = None,
+    **trainer_extra,
+) -> Path:
     trainer = {
         "max_steps": MAX_STEPS,
         "log_every_n_steps": 1,
+        "callbacks": callbacks or [],
         "checkpoint": {
             "dirpath": str(scratch / name / "checkpoints"),
-            "async_save": True,
+            "async_save": async_save,
             "retry_backoff_s": 0.0,
         },
         "loggers": [{
@@ -189,6 +204,65 @@ def main(scratch_arg: str) -> int:
                      f"(no fallback to step {previous})")
     print(f"OK leg 5: corrupt step-{latest} checkpoint fell back to step "
           f"{previous} on restore")
+
+    # -------- leg 6: divergence maps to its EXACT exit code ------------
+    # chaos spike at step 5 with an armed spike guard and NO recovery
+    # configured: the CLI must exit with exactly LOSS_SPIKE_EXIT_CODE (77)
+    # — a supervisor needs the distinction (77 = don't blind-relaunch)
+    rc = cli_main(["fit", "--config", str(_config(
+        scratch, "spike-exit",
+        callbacks=[{
+            "class_path": "llm_training_tpu.callbacks.NanGuard",
+            "init_args": {"spike_zscore": 4.0, "spike_warmup_steps": 2},
+        }],
+        resilience={"chaos": {"spike_step": 5, "spike_scale": 1000.0}},
+    ))])
+    if rc != LOSS_SPIKE_EXIT_CODE:
+        return _fail(f"spike fit exited {rc}, want exactly {LOSS_SPIKE_EXIT_CODE}")
+    print(f"OK leg 6: injected loss spike -> exit {LOSS_SPIKE_EXIT_CODE} "
+          "(documented, distinct from 75)")
+
+    # -------- leg 7: supervise restarts a SIGKILLed child --------------
+    # a real child process (python -m llm_training_tpu fit) is SIGKILLed at
+    # step 3 (after its step-2 checkpoint committed — sync saves); the
+    # supervisor must observe the hard death, relaunch, and the resumed
+    # child (no longer a fresh start, so the trigger is inert) completes
+    import os
+
+    # the supervised children are real `python -m llm_training_tpu`
+    # processes: make the repo importable regardless of the caller's cwd
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    os.environ["PYTHONPATH"] = (
+        repo_root + os.pathsep + os.environ.get("PYTHONPATH", "")
+    )
+    supervisor_log = scratch / "supervise" / "supervisor.jsonl"
+    rc = cli_main([
+        "supervise",
+        "--config", str(_config(
+            scratch, "supervise", async_save=False, checkpoint_every_n_steps=2,
+            resilience={"chaos": {"sigkill_step": 3}},
+        )),
+        "--max-restarts", "2", "--backoff-base-s", "0",
+        "--log", str(supervisor_log),
+    ])
+    if rc != 0:
+        return _fail(f"supervise exited {rc} (child not recovered)")
+    events = [json.loads(line) for line in supervisor_log.read_text().splitlines()]
+    restarts = [e for e in events if e["event"] == "restart"]
+    kills = [e for e in events if e["event"] == "exit" and e.get("signal") == "SIGKILL"]
+    if len(restarts) != 1 or len(kills) != 1:
+        return _fail(f"supervisor.jsonl lacks the SIGKILL->restart record: {events}")
+    resumed = _losses(scratch, "supervise")
+    if sorted(resumed) != list(range(1, MAX_STEPS + 1)):
+        return _fail(f"supervised run logged steps {sorted(resumed)}")
+    for step in range(SIGTERM_STEP, MAX_STEPS + 1):
+        if abs(resumed[step] - baseline[step]) > 1e-6 * abs(baseline[step]):
+            return _fail(
+                f"supervised resume diverged at step {step}: {resumed[step]} "
+                f"vs baseline {baseline[step]}"
+            )
+    print("OK leg 7: child SIGKILLed at step 3, supervisor restarted it, "
+          "resumed run completed with baseline-identical losses")
 
     # -------- watchdog: forced stall produces a stack dump -------------
     import queue

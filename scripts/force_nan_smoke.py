@@ -1,15 +1,22 @@
-"""Forced-NaN micro-fit: the NaN-provenance commit gate.
+"""Forced-NaN micro-fit: the NaN-provenance + auto-recovery commit gates.
 
-Runs a tiny MoE fit whose loss is poisoned through the EMBEDDING TABLE
-(`0 * (inf * embed.sum())` — forward NaN, and the chain rule puts NaN into
-exactly the embedding gradients while every other layer's stay finite), with
-the health layer on every step and a `NanGuard(action="raise")`. Asserts the
-whole provenance path end to end (ISSUE 2 acceptance):
+Leg 1 (provenance, ISSUE 2): a tiny MoE fit whose loss is poisoned through
+the EMBEDDING TABLE (`0 * (inf * embed.sum())` — forward NaN, and the chain
+rule puts NaN into exactly the embedding gradients while every other
+layer's stay finite), with the health layer on every step and a
+`NanGuard(action="raise")`. Asserts the whole provenance path end to end:
 
 1. the fit dies with `NonFiniteLossError`,
 2. the error message names the offending layer path (`embed_tokens`), and
 3. an `anomaly-<step>.json` dump lands in the run dir with that layer in
    `offending_layers`.
+
+Leg 2 (auto-recovery, ISSUE 5): a healthy fit with a chaos-injected NaN at
+a deterministic step and `trainer.resilience.recovery` enabled must
+self-heal IN-PROCESS — rollback to the last committed checkpoint, skip the
+poisoned data window, run to completion without exiting — with
+`resilience/rollbacks == 1` in telemetry and a `== Recovery ==` section in
+the rendered report.
 
 Usage: `python scripts/force_nan_smoke.py <scratch-dir>` (exit 0 = pass).
 `scripts/precommit.sh` runs it on CPU after the report smoke.
@@ -21,6 +28,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
+import jax
 import jax.numpy as jnp
 
 from llm_training_tpu.callbacks import JsonlLogger, JsonlLoggerConfig, NanGuard, NanGuardConfig, NonFiniteLossError
@@ -94,9 +102,72 @@ def main(scratch: str) -> int:
             return 1
         print(f"OK: {message.splitlines()[0]}")
         print(f"OK: dump {dumps[0]} offending_layers={payload['offending_layers']}")
-        return 0
+        return recovery_leg(scratch)
     print("FAIL: fit completed without NonFiniteLossError")
     return 1
+
+
+def recovery_leg(scratch: str) -> int:
+    """Auto-recovery gate: a chaos-injected NaN at step 4 must self-heal
+    in the SAME process (rollback to the step-2 checkpoint + skip the
+    poisoned window), and the run dir's report must render `== Recovery ==`."""
+    from llm_training_tpu.resilience import ChaosConfig, RecoveryConfig, ResilienceConfig
+    from llm_training_tpu.telemetry.report import render_report
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    objective = CLM(
+        CLMConfig(
+            model=ModelProvider(
+                model_class="Llama",
+                model_kwargs=dict(
+                    vocab_size=128, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2, max_position_embeddings=64,
+                    attention_impl="xla", param_dtype="float32",
+                    compute_dtype="float32",
+                ),
+            )
+        )
+    )
+    datamodule = DummyDataModule(
+        DummyDataModuleConfig(batch_size=8, max_length=32, num_samples=64, vocab_size=128)
+    )
+    logger = JsonlLogger(JsonlLoggerConfig(save_dir=scratch, name="recovery-smoke"))
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=6, log_every_n_steps=1, checkpoint_every_n_steps=2,
+            mesh=MeshConfig(),
+            resilience={
+                "chaos": {"nan_step": 4},
+                "recovery": {"max_rollbacks": 2, "skip_window_steps": 1},
+            },
+        ),
+        callbacks=[logger, NanGuard(NanGuardConfig(patience=0, action="raise"))],
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=f"{scratch}/recovery-ckpt", async_save=False)
+        ),
+    )
+    try:
+        state = trainer.fit(objective, datamodule)
+    except Exception as e:
+        print(f"FAIL: recovery fit did not self-heal: {type(e).__name__}: {e}")
+        return 1
+    if int(jax.device_get(state.step)) != 6:
+        print(f"FAIL: recovery fit stopped at step {int(jax.device_get(state.step))}")
+        return 1
+    snapshot = trainer.telemetry.snapshot()
+    if snapshot.get("resilience/rollbacks") != 1:
+        print(f"FAIL: expected resilience/rollbacks == 1, got {snapshot}")
+        return 1
+    report = render_report(Path(logger.run_dir))
+    if "== Recovery ==" not in report:
+        print(f"FAIL: report lacks '== Recovery ==' section:\n{report}")
+        return 1
+    print("OK: chaos NaN at step 4 self-healed in-process "
+          f"(rollbacks={int(snapshot['resilience/rollbacks'])}, "
+          f"skipped_steps={int(snapshot.get('resilience/skipped_steps', 0))})")
+    print("OK: report renders == Recovery ==")
+    return 0
 
 
 if __name__ == "__main__":

@@ -53,16 +53,22 @@ grep -q "== Inference ==" "${SMOKE_ROOT}/report_infer.log"
 grep -q "decode_tokens_per_sec" "${SMOKE_ROOT}/report_infer.log"
 grep -q "perplexity" "${SMOKE_ROOT}/report_infer.log"
 
-# NaN-provenance gate: a forced non-finite micro-fit must name the offending
-# layer path in the NonFiniteLossError AND write an anomaly-<step>.json dump
-echo "== precommit: forced-NaN anomaly dump smoke =="
+# NaN-provenance + auto-recovery gates: a forced non-finite micro-fit must
+# name the offending layer path in the NonFiniteLossError AND write an
+# anomaly-<step>.json dump; then a chaos-injected NaN with
+# trainer.resilience.recovery set must self-heal IN-PROCESS (rollback to
+# the last checkpoint + skip the poisoned window, no relaunch) with
+# resilience/rollbacks == 1 and a "== Recovery ==" report section
+echo "== precommit: forced-NaN anomaly dump + auto-recovery smoke =="
 JAX_PLATFORMS=cpu python scripts/force_nan_smoke.py "${SMOKE_ROOT}/nan-smoke"
 
 # resilience gate (docs/resilience.md): chaos SIGTERM mid-fit -> committed
 # emergency checkpoint + resumable exit code + loss-exact resume; injected
 # checkpoint I/O error retried; corrupt latest checkpoint falls back on
-# restore; a forced stall produces the watchdog's thread-stack dump
-echo "== precommit: kill-and-resume smoke =="
+# restore; injected loss spike exits with exactly 77 (the documented
+# divergence code); a child SIGKILLed mid-fit is relaunched by `supervise`
+# and completes; a forced stall produces the watchdog's thread-stack dump
+echo "== precommit: kill-and-resume + supervise smoke =="
 JAX_PLATFORMS=cpu python scripts/crash_resume_smoke.py "${SMOKE_ROOT}/resilience"
 
 # note: under axon the sitecustomize registers the TPU backend at interpreter

@@ -1,0 +1,796 @@
+"""Self-healing training: DataSkipList determinism, rollback-and-skip
+recovery, LR cooldown, budget escalation, the crash-restart supervisor, and
+the recovery exit-code/report surfaces (docs/resilience.md#recovery)."""
+
+import json
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from llm_training_tpu.callbacks import NanGuard, NanGuardConfig
+from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+from llm_training_tpu.resilience import (
+    LOSS_SPIKE_EXIT_CODE,
+    NON_FINITE_EXIT_CODE,
+    RECOVERY_EXHAUSTED_EXIT_CODE,
+    RESUMABLE_EXIT_CODE,
+    ChaosConfig,
+    DataSkipList,
+    RecoveryConfig,
+    RecoveryExhaustedError,
+    RecoveryManager,
+    ResilienceConfig,
+    Supervisor,
+    SupervisorConfig,
+    cooldown_schedule,
+    config_from_env,
+    install_chaos,
+    uninstall_chaos,
+)
+from llm_training_tpu.telemetry import TelemetryRegistry
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    yield
+    uninstall_chaos()
+
+
+def _dummy(num_samples=64, batch_size=8):
+    dm = DummyDataModule(
+        DummyDataModuleConfig(
+            batch_size=batch_size, max_length=16, num_samples=num_samples,
+            vocab_size=64,
+        )
+    )
+    dm.setup()
+    return dm
+
+
+def _take(stream, n):
+    return [next(stream)["input_ids"] for _ in range(n)]
+
+
+# ---------------------------------------------------------------- skip list
+
+
+def test_skip_list_windows_and_ordinals():
+    skips = DataSkipList(windows=[(3, 2), (10, 1)], reserve=4)
+    assert skips.is_skipped(3) and skips.is_skipped(4) and skips.is_skipped(10)
+    assert not skips.is_skipped(2) and not skips.is_skipped(5)
+    assert skips.skipped_steps == 3
+    # ordinal = skipped steps in [epoch_start, step)
+    assert skips.replacement_ordinal(3, 0) == 0
+    assert skips.replacement_ordinal(4, 0) == 1
+    assert skips.replacement_ordinal(10, 0) == 2
+    assert skips.replacement_ordinal(10, 8) == 0  # epoch-local
+
+
+def test_skip_list_metadata_roundtrip():
+    skips = DataSkipList(windows=[(3, 2)], reserve=5)
+    restored = DataSkipList.from_metadata(skips.to_metadata())
+    assert restored.windows == [(3, 2)]
+    assert restored.reserve == 5
+    assert DataSkipList.from_metadata(None) is None
+    assert DataSkipList.from_metadata({}) is None
+
+
+def test_stream_without_skip_list_is_unchanged():
+    """The recovery-off data order must be byte-identical to the historical
+    stream (the acceptance bar: recovery unset == HEAD)."""
+    a = _take(_dummy().train_batches(start_step=0), 10)
+    b = _take(_dummy().train_batches(start_step=0, skip_list=None), 10)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_skipped_step_serves_reserved_batch_and_rest_unchanged():
+    # 64 samples / batch 8 = 8 batches; reserve 2 -> 6 served per epoch
+    skips = DataSkipList(windows=[(2, 1)], reserve=2)
+    plain = _take(_dummy().train_batches(start_step=0), 8)
+    skipped = _take(_dummy().train_batches(start_step=0, skip_list=skips), 6)
+    for step in (0, 1, 3, 4, 5):
+        np.testing.assert_array_equal(skipped[step], plain[step])
+    # step 2 serves the FIRST reserved batch (batch index 6 of the epoch)
+    np.testing.assert_array_equal(skipped[2], plain[6])  # pool[0] = batch 6
+    assert not np.array_equal(skipped[2], plain[2])
+
+
+def test_skip_replacements_stable_across_resume():
+    """Resume mid-window must serve the same replacements as a from-scratch
+    stream with the same skip list — the checkpoint-metadata contract."""
+    skips = DataSkipList(windows=[(2, 2), (9, 1)], reserve=4)
+    full = _take(_dummy().train_batches(start_step=0, skip_list=skips), 12)
+    for start in (2, 3, 5, 9):
+        resumed = _take(
+            _dummy().train_batches(start_step=start, skip_list=skips), 12 - start
+        )
+        for offset, batch in enumerate(resumed):
+            np.testing.assert_array_equal(
+                batch, full[start + offset],
+                err_msg=f"start={start} step={start + offset}",
+            )
+
+
+def test_no_duplicate_or_lost_batches_per_epoch():
+    """Within an epoch: every sample served exactly once, replacements come
+    from the reserved tail (disjoint from the served set), and the epoch
+    still has served-count batches."""
+    dm = _dummy(num_samples=64, batch_size=8)  # 8 batches/epoch
+    skips = DataSkipList(windows=[(1, 1), (4, 2)], reserve=3)
+    served = 8 - 3  # 5 per epoch
+    epoch = _take(dm.train_batches(start_step=0, skip_list=skips), served)
+    rows = np.concatenate([b for b in epoch], axis=0)
+    flat = [tuple(r) for r in rows]
+    assert len(flat) == len(set(flat)), "duplicate samples within an epoch"
+    # second epoch starts right after `served` steps and is internally
+    # deduplicated too (windows are epoch-local via the ordinal)
+    epoch2 = _take(dm.train_batches(start_step=served, skip_list=skips), served)
+    rows2 = np.concatenate([b for b in epoch2], axis=0)
+    flat2 = [tuple(r) for r in rows2]
+    assert len(flat2) == len(set(flat2))
+
+
+def test_reserve_consuming_whole_epoch_raises():
+    dm = _dummy(num_samples=16, batch_size=8)  # 2 batches/epoch
+    skips = DataSkipList(windows=[(0, 1)], reserve=2)
+    with pytest.raises(ValueError, match="reserve"):
+        next(dm.train_batches(start_step=0, skip_list=skips))
+
+
+def test_skip_pool_wraps_when_exhausted():
+    dm = _dummy(num_samples=32, batch_size=8)  # 4 batches/epoch
+    skips = DataSkipList(windows=[(0, 3)], reserve=1)  # 3 skips, 1 reserved
+    batches = _take(dm.train_batches(start_step=0, skip_list=skips), 3)
+    # every skipped step wraps onto the single reserved batch
+    np.testing.assert_array_equal(batches[0], batches[1])
+    np.testing.assert_array_equal(batches[1], batches[2])
+
+
+# ---------------------------------------------------------------- cooldown
+
+
+def test_cooldown_schedule_decays_and_expires():
+    base = lambda count: 2.0
+    cooled = cooldown_schedule(base, [(10, 5, 0.1)])
+    assert float(cooled(9)) == pytest.approx(2.0)
+    for count in range(10, 15):
+        assert float(cooled(count)) == pytest.approx(0.2)
+    assert float(cooled(15)) == pytest.approx(2.0)
+
+
+def test_cooldown_schedule_stacks_windows():
+    cooled = cooldown_schedule(lambda c: 1.0, [(0, 4, 0.5), (2, 4, 0.5)])
+    assert float(cooled(1)) == pytest.approx(0.5)
+    assert float(cooled(3)) == pytest.approx(0.25)  # overlap multiplies
+    assert float(cooled(5)) == pytest.approx(0.5)
+    assert float(cooled(7)) == pytest.approx(1.0)
+
+
+def test_cooldown_schedule_traces_under_jit():
+    cooled = cooldown_schedule(lambda c: 1.0, [(2, 2, 0.25)])
+    values = jax.jit(jax.vmap(cooled))(np.arange(6))
+    np.testing.assert_allclose(
+        np.asarray(values), [1.0, 1.0, 0.25, 0.25, 1.0, 1.0]
+    )
+
+
+# ---------------------------------------------------------------- manager
+
+
+def _manager(registry=None, metadata=None, **overrides):
+    kwargs = dict(max_rollbacks=2, skip_window_steps=2, escalate_after=3)
+    kwargs.update(overrides)
+    return RecoveryManager(
+        RecoveryConfig(**kwargs), registry=registry, metadata=metadata
+    )
+
+
+def test_manager_budget_exhaustion_escalates():
+    registry = TelemetryRegistry()
+    manager = _manager(registry=registry)
+    manager.on_failure(RuntimeError("boom"), failed_step=4)
+    manager.on_failure(RuntimeError("boom"), failed_step=9)
+    with pytest.raises(RecoveryExhaustedError, match="budget exhausted"):
+        manager.on_failure(RuntimeError("boom"), failed_step=14)
+    snapshot = registry.snapshot()
+    assert snapshot["resilience/rollbacks"] == 2
+    assert snapshot["resilience/recovery_escalations"] == 1
+
+
+def test_manager_same_step_failures_escalate_early():
+    manager = _manager(max_rollbacks=10, escalate_after=2)
+    manager.on_failure(RuntimeError("a"), failed_step=5)
+    manager.on_failure(RuntimeError("b"), failed_step=5)
+    with pytest.raises(RecoveryExhaustedError, match="escalating"):
+        manager.on_failure(RuntimeError("c"), failed_step=5)
+
+
+def test_manager_skip_window_clamped_to_restore_point():
+    manager = _manager(skip_window_steps=4)
+    # failure at micro end 6, restored to micro 4: only [4, 6) is skippable
+    start, length = manager.register_skip(6, floor_micro=4)
+    assert (start, length) == (4, 2)
+    assert manager.skip_list.windows == [(4, 2)]
+
+
+def test_manager_metadata_roundtrip_replays_skips_and_cooldowns():
+    registry = TelemetryRegistry()
+    manager = _manager(registry=registry, lr_cooldown_steps=3)
+    manager.on_failure(RuntimeError("x"), failed_step=3)
+    manager.register_skip(3, floor_micro=0)
+    assert manager.register_cooldown(2)
+    meta = manager.metadata()
+    resumed = _manager(metadata=meta, lr_cooldown_steps=3)
+    assert resumed.skip_list.windows == manager.skip_list.windows
+    assert resumed.skip_list.reserve == manager.skip_list.reserve
+    assert resumed.cooldowns == manager.cooldowns
+    assert resumed.schedule_transform() is not None
+
+
+def test_manager_reserve_ignores_preset_windows():
+    """The default reserve must depend only on the stable budget knobs —
+    NOT on preset windows — or a healed run and its clean comparison run
+    (same knobs, different windows) would serve different epochs."""
+    a = _manager(max_rollbacks=3, skip_window_steps=2)
+    b = _manager(max_rollbacks=3, skip_window_steps=2, skip_windows=((5, 1),))
+    assert a.skip_list.reserve == b.skip_list.reserve == 6
+
+
+def test_recovery_config_in_trainer_config():
+    from llm_training_tpu.trainer import TrainerConfig
+
+    config = TrainerConfig(
+        resilience={"recovery": {"max_rollbacks": 5, "skip_window_steps": 2,
+                                 "lr_cooldown_steps": 10}}
+    )
+    assert config.resilience.recovery.max_rollbacks == 5
+    assert TrainerConfig().resilience.recovery is None  # default: off
+    with pytest.raises(Exception):
+        TrainerConfig(resilience={"recovery": {"max_rollbakcs": 1}})
+
+
+# ---------------------------------------------------------------- chaos
+
+
+def test_chaos_nan_injection_fires_once_at_first_log_step():
+    chaos = install_chaos(ChaosConfig(nan_step=3))
+    metrics = {"loss": 2.0, "grad_norm": 1.0}
+    assert chaos.maybe_poison_metrics(2, metrics) == []
+    assert np.isfinite(metrics["loss"])
+    # trigger step was not a log step: fires at the FIRST log step past it
+    assert chaos.maybe_poison_metrics(4, metrics) == ["nan"]
+    assert np.isnan(metrics["loss"]) and np.isnan(metrics["grad_norm"])
+    assert chaos.maybe_poison_metrics(5, {"loss": 1.0}) == []  # once
+
+
+def test_chaos_spike_injection_scales_metrics():
+    chaos = install_chaos(ChaosConfig(spike_step=2, spike_scale=100.0))
+    metrics = {"loss": 2.0, "grad_norm": 0.5}
+    assert chaos.maybe_poison_metrics(2, metrics) == ["spike"]
+    assert metrics["loss"] == pytest.approx(200.0)
+    assert metrics["grad_norm"] == pytest.approx(50.0)
+
+
+def test_chaos_sigkill_requires_fresh_start():
+    """The supervise-gate contract: the SIGKILL trigger must be inert in a
+    resumed run, or the supervisor's relaunch would crash-loop on it."""
+    chaos = install_chaos(ChaosConfig(sigkill_step=3))
+    # resumed run (fresh_start=False) crossing the trigger: must survive
+    chaos.maybe_sigkill(3, fresh_start=False)  # would SIGKILL the test if broken
+    # wrong step in a fresh run: also inert
+    chaos.maybe_sigkill(2, fresh_start=True)
+
+
+def test_chaos_env_overlay_covers_new_triggers(monkeypatch):
+    monkeypatch.setenv("LLMT_CHAOS_NAN_STEP", "7")
+    monkeypatch.setenv("LLMT_CHAOS_SIGKILL_STEP", "9")
+    monkeypatch.setenv("LLMT_CHAOS_SPIKE_STEP", "4")
+    monkeypatch.setenv("LLMT_CHAOS_SPIKE_SCALE", "12.5")
+    config = config_from_env(ChaosConfig())
+    assert config.nan_step == 7
+    assert config.sigkill_step == 9
+    assert config.spike_step == 4
+    assert config.spike_scale == 12.5
+    assert config.any_active()
+
+
+# ---------------------------------------------------------------- nan guard state
+
+
+def test_nan_guard_state_roundtrip():
+    guard = NanGuard(NanGuardConfig(spike_zscore=6.0, spike_warmup_steps=3))
+    for value in (2.0, 2.1, 1.9, 2.0, 2.05):
+        for detector in guard._detectors.values():
+            detector.update(value)
+    guard.non_finite_steps = 2
+    guard.spike_steps = 1
+    state = guard.state_dict()
+    assert json.dumps(state)  # JSON-serializable (checkpoint metadata rider)
+
+    fresh = NanGuard(NanGuardConfig(spike_zscore=6.0, spike_warmup_steps=3))
+    fresh.load_state_dict(state)
+    assert fresh.non_finite_steps == 2
+    assert fresh.spike_steps == 1
+    for name, detector in guard._detectors.items():
+        restored = fresh._detectors[name]
+        assert restored.count == detector.count
+        assert restored.mean == pytest.approx(detector.mean)
+        assert restored.var == pytest.approx(detector.var)
+    # the restored detector is armed (past warmup) — no blind window
+    assert fresh._detectors["loss"].score(100.0) is not None
+
+
+def test_nan_guard_state_ignored_when_spike_detection_off():
+    armed = NanGuard(NanGuardConfig(spike_zscore=6.0))
+    state = armed.state_dict()
+    plain = NanGuard(NanGuardConfig())  # no detectors configured
+    plain.load_state_dict(state)  # must not invent detectors
+    assert plain._detectors == {}
+
+
+def test_nan_guard_on_rollback_clears_streaks_keeps_totals():
+    guard = NanGuard(NanGuardConfig(patience=5))
+    guard.non_finite_steps = 3
+    guard._streak = 3
+    guard._spike_streak = 2
+    guard.on_rollback(trainer=None, step=4)
+    assert guard._streak == 0 and guard._spike_streak == 0
+    assert guard.non_finite_steps == 3  # lifetime total survives
+
+
+# ---------------------------------------------------------------- supervisor
+
+
+def _fake_child(script: list[int]):
+    """Returns a run_child(argv) that pops scripted exit codes."""
+    remaining = list(script)
+
+    def run(argv):
+        return remaining.pop(0)
+
+    return run
+
+
+def test_supervisor_restarts_on_resumable_and_hard_deaths(tmp_path):
+    log = tmp_path / "supervisor.jsonl"
+    sup = Supervisor(
+        ["child"],
+        SupervisorConfig(max_restarts=5, backoff_base_s=0.0, log_path=str(log)),
+        run_child=_fake_child([RESUMABLE_EXIT_CODE, -9, -6, 0]),
+        sleep=lambda s: None,
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 3
+    events = [json.loads(line) for line in log.read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert kinds.count("launch") == 4
+    assert kinds.count("restart") == 3
+    assert kinds[-1] == "complete"
+    sigkill_exit = next(e for e in events if e["event"] == "exit" and e["rc"] == -9)
+    assert sigkill_exit["signal"] == "SIGKILL"
+
+
+def test_supervisor_gives_up_on_non_resumable_exit():
+    sup = Supervisor(
+        ["child"],
+        SupervisorConfig(max_restarts=5, backoff_base_s=0.0),
+        run_child=_fake_child([RECOVERY_EXHAUSTED_EXIT_CODE]),
+        sleep=lambda s: None,
+    )
+    assert sup.run() == RECOVERY_EXHAUSTED_EXIT_CODE
+    assert sup.restarts == 0
+    assert sup.events[-1]["event"] == "giveup"
+
+
+def test_supervisor_restart_budget_propagates_last_code():
+    sup = Supervisor(
+        ["child"],
+        SupervisorConfig(max_restarts=2, backoff_base_s=0.0),
+        run_child=_fake_child([-9, -9, -9]),
+        sleep=lambda s: None,
+    )
+    # a raw -9 would be truncated mod 256 by the OS; signal deaths
+    # propagate as the shell convention 128+signum
+    assert sup.run() == 128 + 9
+    assert sup.restarts == 2
+
+
+def test_supervisor_backoff_is_exponential_and_resets_when_healthy():
+    sleeps = []
+    clock = {"t": 0.0}
+    script = iter([(1.0, RESUMABLE_EXIT_CODE), (1.0, RESUMABLE_EXIT_CODE),
+                   (1000.0, RESUMABLE_EXIT_CODE), (1.0, 0)])
+
+    def run(argv):
+        runtime, rc = next(script)
+        clock["t"] += runtime
+        return rc
+
+    sup = Supervisor(
+        ["child"],
+        SupervisorConfig(
+            max_restarts=10, backoff_base_s=1.0, backoff_max_s=60.0,
+            healthy_runtime_s=600.0,
+        ),
+        run_child=run,
+        sleep=sleeps.append,
+        clock=lambda: clock["t"],
+    )
+    assert sup.run() == 0
+    # 1.0, 2.0 (two crash-loops), then the healthy child reset -> 1.0
+    assert sleeps == [1.0, 2.0, 1.0]
+
+
+def test_supervisor_uses_relaunch_argv_after_first_launch():
+    seen = []
+
+    def run(argv):
+        seen.append(list(argv))
+        return RESUMABLE_EXIT_CODE if len(seen) == 1 else 0
+
+    sup = Supervisor(
+        ["fit", "--ckpt-path", "3"],
+        SupervisorConfig(backoff_base_s=0.0),
+        run_child=run,
+        sleep=lambda s: None,
+        relaunch_argv=["fit"],
+    )
+    assert sup.run() == 0
+    assert seen == [["fit", "--ckpt-path", "3"], ["fit"]]
+
+
+def test_supervisor_runs_real_child_processes(tmp_path):
+    """End to end with actual subprocesses: the child exits 75 until a
+    marker file exists (it creates it on the first run), then 0."""
+    marker = tmp_path / "resumed"
+    child = (
+        "import pathlib, sys; m = pathlib.Path(sys.argv[1]); "
+        "sys.exit(0) if m.exists() else (m.touch(), sys.exit(75))"
+    )
+    sup = Supervisor(
+        [sys.executable, "-c", child, str(marker)],
+        SupervisorConfig(max_restarts=3, backoff_base_s=0.0,
+                         log_path=str(tmp_path / "supervisor.jsonl")),
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 1
+
+
+# ---------------------------------------------------------------- CLI codes
+
+
+def _tiny_cli_config(tmp_path) -> Path:
+    config = {
+        "trainer": {"max_steps": 2},
+        "model": {
+            "class_path": "llm_training_tpu.lms.CLM",
+            "init_args": {
+                "model": {
+                    "model_class": "llm_training_tpu.models.Llama",
+                    "model_kwargs": {
+                        "vocab_size": 64, "hidden_size": 16,
+                        "intermediate_size": 32, "num_hidden_layers": 1,
+                        "num_attention_heads": 2, "num_key_value_heads": 2,
+                        "max_position_embeddings": 32,
+                    },
+                },
+                "optim": {"learning_rate": 1e-3},
+            },
+        },
+        "data": {
+            "class_path": "llm_training_tpu.data.DummyDataModule",
+            "init_args": {"batch_size": 8, "max_length": 16, "num_samples": 16,
+                          "vocab_size": 64},
+        },
+    }
+    path = tmp_path / "config.yaml"
+    path.write_text(yaml.safe_dump(config))
+    return path
+
+
+@pytest.mark.parametrize(
+    "error,expected",
+    [
+        (RecoveryExhaustedError("budget gone", step=4), RECOVERY_EXHAUSTED_EXIT_CODE),
+        ("LossSpikeError", LOSS_SPIKE_EXIT_CODE),
+        ("NonFiniteLossError", NON_FINITE_EXIT_CODE),
+    ],
+)
+def test_cli_maps_recovery_errors_to_documented_codes(
+    tmp_path, monkeypatch, error, expected
+):
+    from llm_training_tpu.callbacks.nan_guard import (
+        LossSpikeError,
+        NonFiniteLossError,
+    )
+    from llm_training_tpu.cli.main import main
+    from llm_training_tpu.trainer import Trainer
+
+    if error == "LossSpikeError":
+        error = LossSpikeError("spiked")
+    elif error == "NonFiniteLossError":
+        error = NonFiniteLossError("diverged")
+
+    def fake_fit(self, objective, datamodule, resume_step=None, state=None):
+        raise error
+
+    monkeypatch.setattr(Trainer, "fit", fake_fit)
+    assert main(["fit", "--config", str(_tiny_cli_config(tmp_path))]) == expected
+    # the contract is documented and distinct
+    assert len({RESUMABLE_EXIT_CODE, RECOVERY_EXHAUSTED_EXIT_CODE,
+                LOSS_SPIKE_EXIT_CODE, NON_FINITE_EXIT_CODE}) == 4
+
+
+# ---------------------------------------------------------------- report
+
+
+def test_report_renders_recovery_section(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "loss": 2.0, "steps_per_sec": 1.0}) + "\n"
+    )
+    (tmp_path / "telemetry.jsonl").write_text(
+        json.dumps({
+            "step": 1, "goodput/total_s": 10.0, "goodput/step_compute_s": 8.0,
+            "resilience/rollbacks": 1.0, "resilience/skip_windows": 1.0,
+            "resilience/skipped_steps": 2.0, "resilience/lr_cooldowns": 1.0,
+        }) + "\n"
+    )
+    report = render_report(tmp_path)
+    assert "== Recovery ==" in report
+    assert "in-process rollbacks (rewind + resume): 1" in report
+    assert "micro-steps served from the reserve pool: 2" in report
+
+
+def test_report_omits_recovery_section_for_clean_runs(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    (tmp_path / "metrics.jsonl").write_text(
+        json.dumps({"step": 1, "loss": 2.0}) + "\n"
+    )
+    (tmp_path / "telemetry.jsonl").write_text(
+        json.dumps({"step": 1, "goodput/total_s": 10.0,
+                    "resilience/rollbacks": 0.0}) + "\n"
+    )
+    assert "== Recovery ==" not in render_report(tmp_path)
+
+
+# ---------------------------------------------------------------- fit-level
+
+
+TINY_MODEL = dict(
+    model_class="llm_training_tpu.models.Llama",
+    model_kwargs=dict(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, attention_impl="xla",
+        param_dtype="float32", compute_dtype="float32",
+    ),
+)
+
+
+def _objective():
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.optim import OptimConfig
+
+    return CLM(
+        CLMConfig(
+            model=ModelProvider(**TINY_MODEL),
+            optim=OptimConfig(learning_rate=1e-3, warmup_steps=2,
+                              lr_scheduler="constant"),
+        )
+    )
+
+
+def _data():
+    return DummyDataModule(
+        DummyDataModuleConfig(batch_size=8, max_length=32, num_samples=64,
+                              vocab_size=128)
+    )
+
+
+class _Rec:
+    def __init__(self):
+        self.losses = {}
+
+    def on_step_end(self, trainer, step, metrics):
+        self.losses[step] = float(metrics["loss"])
+
+
+def _trainer(tmp_path, name, callbacks, **overrides):
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    kwargs = dict(max_steps=6, log_every_n_steps=1, checkpoint_every_n_steps=2)
+    kwargs.update(overrides)
+    return Trainer(
+        TrainerConfig(**kwargs),
+        callbacks=callbacks,
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=str(tmp_path / name), async_save=False)
+        ),
+    )
+
+
+@pytest.mark.slow
+def test_chaos_nan_self_heals_and_matches_clean_skip_run(devices, tmp_path):
+    """The acceptance path: chaos NaN at step 4 -> NanGuard raises ->
+    rollback to the step-2 checkpoint IN-PROCESS -> skip micro-step 3 ->
+    run completes with rollbacks == 1 and losses identical to a clean run
+    configured to skip the same window."""
+    rec_heal = _Rec()
+    healed = _trainer(
+        tmp_path, "heal",
+        [rec_heal, NanGuard(NanGuardConfig(patience=0, action="raise"))],
+        resilience=ResilienceConfig(
+            chaos=ChaosConfig(nan_step=4),
+            recovery=RecoveryConfig(max_rollbacks=3, skip_window_steps=1),
+        ),
+    )
+    state = healed.fit(_objective(), _data())
+    assert int(jax.device_get(state.step)) == 6  # SAME process, no relaunch
+    snapshot = healed.telemetry.snapshot()
+    assert snapshot["resilience/rollbacks"] == 1
+    assert snapshot["resilience/skip_windows"] == 1
+    assert snapshot["resilience/skipped_steps"] == 1
+
+    rec_clean = _Rec()
+    clean = _trainer(
+        tmp_path, "clean", [rec_clean],
+        resilience=ResilienceConfig(
+            recovery=RecoveryConfig(
+                max_rollbacks=3, skip_window_steps=1, skip_windows=((3, 1),)
+            ),
+        ),
+    )
+    clean.fit(_objective(), _data())
+    # post-rollback steps replay against the skip list: every loss the two
+    # runs share must match exactly
+    for step in (5, 6):
+        np.testing.assert_allclose(
+            rec_heal.losses[step], rec_clean.losses[step], rtol=1e-6,
+            err_msg=f"step {step}",
+        )
+    assert healed.counters == clean.counters
+
+
+@pytest.mark.slow
+def test_rollback_restores_loss_exact_state(devices, tmp_path):
+    """The replayed step right after a rollback must reproduce the loss a
+    clean run saw at that step (the restore is value-exact and the data
+    stream repositions correctly)."""
+    rec_plain = _Rec()
+    plain = _trainer(
+        tmp_path, "plain", [rec_plain],
+        resilience=ResilienceConfig(
+            recovery=RecoveryConfig(max_rollbacks=2, skip_window_steps=1,
+                                    skip_windows=((3, 1),))
+        ),
+    )
+    plain.fit(_objective(), _data())
+
+    rec_heal = _Rec()
+    healed = _trainer(
+        tmp_path, "healed",
+        [rec_heal, NanGuard(NanGuardConfig(patience=0, action="raise"))],
+        resilience=ResilienceConfig(
+            chaos=ChaosConfig(nan_step=4),
+            recovery=RecoveryConfig(max_rollbacks=2, skip_window_steps=1),
+        ),
+    )
+    healed.fit(_objective(), _data())
+    # step 3 replays the same (unskipped) batch the clean run served at
+    # step 3 from the restored step-2 state: loss must match exactly
+    np.testing.assert_allclose(rec_heal.losses[3], rec_plain.losses[3], rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_recovery_budget_exhaustion_escalates_in_fit(devices, tmp_path):
+    """A failure that data-skipping cannot cure (poisoned objective) burns
+    the budget and escalates with RecoveryExhaustedError."""
+    import jax.numpy as jnp
+
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.lms.clm import _get_path
+    from llm_training_tpu.optim import OptimConfig
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+
+    class PoisonedCLM(CLM):
+        def loss_and_metrics(self, params, batch, rng=None, train=True,
+                             with_health=False):
+            loss, metrics = super().loss_and_metrics(
+                params, batch, rng=rng, train=train, with_health=with_health
+            )
+            p = params["params"] if "params" in params else params
+            embed = _get_path(p, self.model.get_input_embeddings_path())
+            loss = loss + jnp.float32(0.0) * (
+                jnp.float32(jnp.inf) * embed.astype(jnp.float32).sum()
+            )
+            metrics["loss"] = loss
+            return loss, metrics
+
+    objective = PoisonedCLM(
+        CLMConfig(model=ModelProvider(**TINY_MODEL),
+                  optim=OptimConfig(learning_rate=1e-3, lr_scheduler="constant"))
+    )
+    trainer = Trainer(
+        TrainerConfig(
+            max_steps=4, log_every_n_steps=1,
+            resilience=ResilienceConfig(
+                recovery=RecoveryConfig(max_rollbacks=2, escalate_after=1)
+            ),
+        ),
+        callbacks=[NanGuard(NanGuardConfig(patience=0, action="raise"))],
+    )
+    with pytest.raises(RecoveryExhaustedError):
+        trainer.fit(objective, _data())
+    snapshot = trainer.telemetry.snapshot()
+    assert snapshot["resilience/recovery_escalations"] == 1
+    assert snapshot["resilience/rollbacks"] >= 1
+
+
+@pytest.mark.slow
+def test_lr_cooldown_applies_after_rollback_and_expires(devices, tmp_path):
+    rec = _Rec()
+
+    class LrRec:
+        def __init__(self):
+            self.lrs = {}
+
+        def on_step_end(self, trainer, step, metrics):
+            self.lrs[step] = float(metrics["lr"])
+
+    lrs = LrRec()
+    trainer = _trainer(
+        tmp_path, "cooldown",
+        [rec, lrs, NanGuard(NanGuardConfig(patience=0, action="raise"))],
+        max_steps=8,
+        resilience=ResilienceConfig(
+            chaos=ChaosConfig(nan_step=4),
+            recovery=RecoveryConfig(
+                max_rollbacks=2, skip_window_steps=1,
+                lr_cooldown_factor=0.1, lr_cooldown_steps=2,
+            ),
+        ),
+    )
+    trainer.fit(_objective(), _data())
+    assert trainer.telemetry.snapshot()["resilience/lr_cooldowns"] == 1
+    base = lrs.lrs[8]
+    # cooldown armed at restored opt step 2: the replayed step 3 logs the
+    # cooled LR; by step 5 the window [2, 4) has expired on its own
+    assert lrs.lrs[3] == pytest.approx(0.1 * base)
+    assert lrs.lrs[5] == pytest.approx(base)
+
+
+@pytest.mark.slow
+def test_nan_guard_ema_state_survives_resume(devices, tmp_path):
+    """After a preemption-style stop and relaunch, the spike detector must
+    be armed immediately (its EMA state rides checkpoint metadata) instead
+    of re-warming blind."""
+    from llm_training_tpu.resilience import PreemptionInterrupt
+
+    guard_a = NanGuard(NanGuardConfig(spike_zscore=6.0, spike_warmup_steps=3))
+    t1 = _trainer(
+        tmp_path, "resume", [guard_a],
+        resilience=ResilienceConfig(chaos=ChaosConfig(sigterm_step=4)),
+        checkpoint_every_n_steps=2, max_steps=8,
+    )
+    with pytest.raises(PreemptionInterrupt):
+        t1.fit(_objective(), _data())
+    warm_count = guard_a._detectors["loss"].count
+    assert warm_count >= 3  # armed before the preemption
+
+    guard_b = NanGuard(NanGuardConfig(spike_zscore=6.0, spike_warmup_steps=3))
+    t2 = _trainer(
+        tmp_path, "resume", [guard_b],
+        checkpoint_every_n_steps=2, max_steps=8,
+    )
+    t2.fit(_objective(), _data())
+    # the relaunch started from the persisted tracker, not from zero
+    assert guard_b._detectors["loss"].count > warm_count
