@@ -330,6 +330,11 @@ def main(argv: list[str] | None = None) -> int:
     evaluate.add_argument("overrides", nargs="*")
     report = sub.add_parser("report", help="render a run summary from a run directory")
     report.add_argument("run_dir", help="dir holding metrics.jsonl / telemetry.jsonl")
+    report.add_argument(
+        "--bench-dir", default=None,
+        help="dir searched first for the newest BENCH_r*.json / bench*.json "
+        "record (== Perf == section); falls back to run_dir, then cwd",
+    )
     supervise = sub.add_parser(
         "supervise",
         help="run fit as a supervised child process; restart it on "
@@ -354,7 +359,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "report":
         from llm_training_tpu.telemetry.report import report_main
 
-        return report_main(args.run_dir)
+        return report_main(args.run_dir, bench_dir=args.bench_dir)
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import

@@ -137,6 +137,10 @@ def dot_product_attention(
     q_offset: int = 0,
     impl: str = "auto",
     sinks: jnp.ndarray | None = None,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
 ) -> jnp.ndarray:
     """Multi-head attention over packed sequences.
 
@@ -153,6 +157,10 @@ def dot_product_attention(
     sinks: [num_q_heads] learned per-head sink logits (gpt-oss); joins each
         softmax denominator with zero value (both impls — the flash kernel
         seeds its online-softmax denominator with the sink mass).
+    block_q/block_k/bwd_block_q/bwd_block_k: flash-kernel tile overrides
+        (fwd and bwd independently); None resolves at call time through
+        `ops/pallas/tuning.py` (env > tuning table > default). Ignored on
+        the XLA path.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -178,6 +186,10 @@ def dot_product_attention(
             scale=scale,
             q_offset=q_offset,
             sinks=sinks,
+            block_q=block_q,
+            block_k=block_k,
+            bwd_block_q=bwd_block_q,
+            bwd_block_k=bwd_block_k,
         )
 
     mask = None

@@ -27,7 +27,6 @@ wins in the reference, reproduced at the tile level.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -36,16 +35,29 @@ from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from llm_training_tpu.ops.pallas.tuning import (
+    SOURCE_ORDER,
+    BlockChoice,
+    bwd_env_override,
+    fit_block,
+    record_block_choice,
+    resolve_block_sizes,
+)
+
 _MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 _LANES = 128
 
-# default tile sizes (overridable per call, or via env for experiments).
-# 1024x1024 measured best on v5e @ seq 2048: per-invocation grid overhead
-# (~us of scalar-core dispatch + DMA descriptor setup) dominates the 0.7us
-# of MXU work in a 512 tile; quadrupling the tile amortizes it 4x and still
-# fits VMEM (scores f32 4M + q/k/v/acc ~1.3M of ~16M).
-_DEFAULT_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", 1024))
-_DEFAULT_BLOCK_K = int(os.environ.get("FLASH_BLOCK_K", 1024))
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; the r04/r05
+# bench machine and this CPU container sit on opposite sides of the rename,
+# so resolve whichever exists (the 17 flash tests were dead-on-arrival in
+# the CPU container on the missing new name alone)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
+# block sizes are resolved at CALL time by ops/pallas/tuning.py (explicit
+# arg > FLASH_BLOCK_* env > config/tuning table > 1024 default) — never at
+# import, so tests and the offline sweep can override without re-importing.
+# The old import-time constants lived here; see tuning.DEFAULT_BLOCK for
+# the v5e rationale behind the 1024x1024 fallback.
 
 
 def _round_up(x: int, m: int) -> int:
@@ -180,6 +192,40 @@ def _bounded_idx(pos_clamp, heads_divisor: int):
         return jnp.clip(xx, lo[batch_i, a], jnp.maximum(hi[batch_i, a], lo[batch_i, a]))
 
     return idx
+
+
+def _resolve_flat_blocks(
+    kind: str,
+    sq: int,
+    skv: int,
+    head_dim: int,
+    dtype,
+    causal: bool,
+    sliding_window: int | None,
+    block_q: int | None,
+    block_k: int | None,
+) -> tuple[int, int]:
+    """Fill unset block knobs for a flat-kernel call via the tuning layer,
+    then fit the RESOLVED (non-explicit) knobs to the actual sequence
+    lengths — a table/default block that doesn't divide the input degrades
+    to the nearest dividing tile; an explicit block that doesn't divide
+    still raises through `_check_block_divisibility` (caller bug)."""
+    explicit_q, explicit_k = block_q is not None, block_k is not None
+    if explicit_q and explicit_k:
+        return block_q, block_k
+    choice = resolve_block_sizes(
+        kind, seq_len=max(sq, skv), head_dim=head_dim, dtype=dtype,
+        causal=causal, sliding_window=sliding_window,
+        block_q=block_q, block_k=block_k,
+    )
+    bq, bk = choice.block_q, choice.block_k
+    if not explicit_q and sq % _LANES == 0:
+        bq = fit_block(bq, sq)
+    if not explicit_k and skv % _LANES == 0:
+        bk = fit_block(bk, skv)
+    # record the post-fit tiles (what actually compiles), not the raw pick
+    record_block_choice(kind, BlockChoice(bq, bk, choice.source))
+    return bq, bk
 
 
 def _check_block_divisibility(sq: int, skv: int, block_q: int, block_k: int) -> None:
@@ -620,8 +666,8 @@ def flash_fwd_flat(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     q_offset: int = 0,
-    block_q: int = _DEFAULT_BLOCK_Q,
-    block_k: int = _DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
     sinks: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -634,6 +680,9 @@ def flash_fwd_flat(
     lse)."""
     bh, sq, d = q.shape
     skv = k.shape[1]
+    block_q, block_k = _resolve_flat_blocks(
+        "fwd", sq, skv, d, q.dtype, causal, sliding_window, block_q, block_k
+    )
     _check_block_divisibility(sq, skv, block_q, block_k)
     nq, nk = sq // block_q, skv // block_k
     hyper = dict(
@@ -695,7 +744,7 @@ def flash_fwd_flat(
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -726,16 +775,23 @@ def flash_bwd_flat(
     sliding_window: int | None = None,
     logits_soft_cap: float | None = None,
     q_offset: int = 0,
-    block_q: int = _DEFAULT_BLOCK_Q,
-    block_k: int = _DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Backward kernels over flat padded inputs. `lse`/`delta` are [B*Hq, Sq]
     fp32 — for ring attention they are the globally-combined values, which is
     exactly what makes per-chunk dQ/dK/dV contributions sum to the full-
-    sequence gradient."""
+    sequence gradient.
+
+    `block_q`/`block_k` are the BACKWARD tiles (tuning kind "bwd") — the
+    dq/dkv kernels carry different scratch footprints than the forward, so
+    their optimal blocks are tuned independently."""
     bh, sq, d = q.shape
     skv = k.shape[1]
+    block_q, block_k = _resolve_flat_blocks(
+        "bwd", sq, skv, d, q.dtype, causal, sliding_window, block_q, block_k
+    )
     _check_block_divisibility(sq, skv, block_q, block_k)
     nq, nk = sq // block_q, skv // block_k
     bh_kv = k.shape[0]
@@ -786,7 +842,7 @@ def flash_bwd_flat(
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -839,7 +895,7 @@ def flash_bwd_flat(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,
@@ -858,9 +914,17 @@ def _make_attention(
     q_offset: int,
     block_q: int,
     block_k: int,
+    bwd_block_q: int,
+    bwd_block_k: int,
     interpret: bool,
+    bwd_source: str = "call",
 ):
-    """Build the custom-VJP flash attention over padded flat inputs."""
+    """Build the custom-VJP flash attention over padded flat inputs.
+
+    `block_q/block_k` tile the forward kernel; `bwd_block_q/bwd_block_k`
+    tile the dq/dkv kernels (independent knobs — the backward's scratch
+    footprints want different VMEM trade-offs). `bwd_source` is only
+    telemetry provenance for the bwd-tile gauges."""
     hyper = dict(
         num_q_heads=num_q_heads,
         num_kv_heads=num_kv_heads,
@@ -869,27 +933,36 @@ def _make_attention(
         sliding_window=sliding_window,
         logits_soft_cap=logits_soft_cap,
         q_offset=q_offset,
-        block_q=block_q,
-        block_k=block_k,
         interpret=interpret,
     )
+    fwd_blocks = dict(block_q=block_q, block_k=block_k)
+    bwd_blocks = dict(block_q=bwd_block_q, block_k=bwd_block_k)
 
     @jax.custom_vjp
     def attention(q, k, v, seg_q, seg_kv, sinks):
-        o, _ = flash_fwd_flat(q, k, v, seg_q, seg_kv, sinks=sinks, **hyper)
+        o, _ = flash_fwd_flat(q, k, v, seg_q, seg_kv, sinks=sinks, **hyper, **fwd_blocks)
         return o
 
     def attention_fwd(q, k, v, seg_q, seg_kv, sinks):
-        o, lse = flash_fwd_flat(q, k, v, seg_q, seg_kv, sinks=sinks, **hyper)
+        o, lse = flash_fwd_flat(q, k, v, seg_q, seg_kv, sinks=sinks, **hyper, **fwd_blocks)
         return o, (q, k, v, seg_q, seg_kv, sinks, o, lse)
 
     def attention_bwd(res, do):
         q, k, v, seg_q, seg_kv, sinks, o, lse = res
+        # record the bwd tiles HERE, not in the wrapper: this rule only
+        # traces when a backward exists in the program, so forward-only
+        # traces (eval/validation) never report bwd gauges for kernels
+        # they never compile
+        record_block_choice(
+            "bwd", BlockChoice(bwd_block_q, bwd_block_k, bwd_source)
+        )
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
         # the dQ/dK/dV kernels are sink-agnostic: with the sink mass folded
         # into lse, p = exp(s - lse) already sums to < 1 per row and
         # delta == sum_k p_k dP_k still holds (the sink's value is zero)
-        dq, dk, dv = flash_bwd_flat(q, k, v, seg_q, seg_kv, do, lse, delta, **hyper)
+        dq, dk, dv = flash_bwd_flat(
+            q, k, v, seg_q, seg_kv, do, lse, delta, **hyper, **bwd_blocks
+        )
         if sinks is None:
             d_sinks = None
         else:
@@ -921,8 +994,10 @@ def flash_attention(
     logits_soft_cap: float | None = None,
     scale: float | None = None,
     q_offset: int = 0,
-    block_q: int = _DEFAULT_BLOCK_Q,
-    block_k: int = _DEFAULT_BLOCK_K,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
     interpret: bool | None = None,
     sinks: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
@@ -932,6 +1007,11 @@ def flash_attention(
     num_kv_heads, head_dim]; segment ids as in
     `llm_training_tpu.ops.attention.dot_product_attention` (0 = padding).
     Runs compiled on TPU, interpreted elsewhere (tests).
+
+    Block sizes left as None resolve at call time through
+    `ops/pallas/tuning.py` (env > tuning table > default), independently
+    for the forward (`block_q/block_k`) and backward
+    (`bwd_block_q/bwd_block_k`) kernels.
     """
     batch, q_len, num_q_heads, head_dim = q.shape
     kv_len, num_kv_heads = k.shape[1], k.shape[2]
@@ -971,16 +1051,72 @@ def flash_attention(
     q_segment_ids = q_segment_ids.astype(jnp.int32)
     segment_ids = segment_ids.astype(jnp.int32)
 
+    # resolve fwd/bwd tile sizes at call time (explicit arg > FLASH_BLOCK_*
+    # env > tuning table > default). Backward knobs resolve PER KNOB:
+    # explicit bwd_block_* arg > bwd-specific FLASH_BLOCK_{Q,K}_BWD env >
+    # the same-knob explicit fwd tile (the pre-tuning-layer contract every
+    # sweep/microbench call site relies on — a tile you pin tiles BOTH
+    # passes, and a stale table entry can never retile a pinned knob) >
+    # the shared env/table/default chain for knobs the caller never pinned.
+    explicit_bwd_q, explicit_bwd_k = bwd_block_q is not None, bwd_block_k is not None
+    fwd_choice = resolve_block_sizes(
+        "fwd", seq_len=max(q_len, kv_len), head_dim=head_dim, dtype=q.dtype,
+        causal=causal, sliding_window=sliding_window,
+        block_q=block_q, block_k=block_k,
+    )
+    spec = []
+    for name, bwd_arg, fwd_arg, fwd_val in (
+        ("block_q", bwd_block_q, block_q, fwd_choice.block_q),
+        ("block_k", bwd_block_k, block_k, fwd_choice.block_k),
+    ):
+        if bwd_arg is not None:
+            value, src = int(bwd_arg), "call"
+        else:
+            env_value = bwd_env_override(name)
+            if env_value is not None:
+                value, src = env_value, "env"
+            elif fwd_arg is not None:
+                value, src = fwd_val, "call"  # inherited pinned fwd tile
+            else:
+                value, src = None, None  # shared chain below
+        if value is not None and (value < _LANES or value % _LANES):
+            raise ValueError(
+                f"bwd {name} must be a positive multiple of {_LANES}, got {value}"
+            )
+        spec.append((value, src))
+    if any(value is None for value, _ in spec):
+        shared = resolve_block_sizes(
+            "bwd", seq_len=max(q_len, kv_len), head_dim=head_dim, dtype=q.dtype,
+            causal=causal, sliding_window=sliding_window,
+        )
+        chain = ((shared.block_q, shared.source_q), (shared.block_k, shared.source_k))
+        spec = [pinned if pinned[0] is not None else fallthrough
+                for pinned, fallthrough in zip(spec, chain)]
+    (bq, src_q), (bk, src_k) = spec
+    bwd_choice = BlockChoice(bq, bk, min((src_q, src_k), key=SOURCE_ORDER.index))
+
     # pad sequence dims to block multiples and head_dim to the lane width;
     # padded tokens get segment id 0, so they are masked not attended.
     # head_dim needs NO padding when the blocks cover it exactly and it is
     # sublane-aligned (64 = Llama-style head dim): Mosaic accepts full-array
     # blocks, and skipping the pad saves ~25% attention time vs 64->128
     # zero-padding (measured on v5e)
-    block_q = min(block_q, _round_up(q_len, _LANES))
-    block_k = min(block_k, _round_up(kv_len, _LANES))
+    block_q = min(fwd_choice.block_q, _round_up(q_len, _LANES))
+    block_k = min(fwd_choice.block_k, _round_up(kv_len, _LANES))
     sq_pad = _round_up(q_len, block_q) - q_len
     skv_pad = _round_up(kv_len, block_k) - kv_len
+    # the padded lengths are multiples of the FWD blocks; non-explicit bwd
+    # tiles (env/table-resolved, or inherited from the fwd pair) degrade to
+    # the nearest dividing block, while explicitly-passed bwd_block_* stay
+    # strict (flash_bwd_flat raises on non-divisibility — caller bug)
+    if not explicit_bwd_q:
+        bwd_block_q = fit_block(bwd_choice.block_q, q_len + sq_pad)
+    if not explicit_bwd_k:
+        bwd_block_k = fit_block(bwd_choice.block_k, kv_len + skv_pad)
+    # record the tiles the kernels will actually compile with (post
+    # clamp/fit), not the raw resolution; the bwd gauges are recorded
+    # inside the VJP's bwd rule so forward-only traces don't report them
+    record_block_choice("fwd", BlockChoice(block_q, block_k, fwd_choice.source))
     d_pad = (
         0
         if head_dim == 64 or head_dim % _LANES == 0
@@ -1009,7 +1145,10 @@ def flash_attention(
         q_offset=q_offset,
         block_q=block_q,
         block_k=block_k,
+        bwd_block_q=bwd_block_q,
+        bwd_block_k=bwd_block_k,
         interpret=interpret,
+        bwd_source=bwd_choice.source,
     )
     of = attention(qf, kf, vf, q_segment_ids, segment_ids, sinks)
 

@@ -240,6 +240,33 @@ def _ring_block(c: int) -> int:
     raise ValueError(f"chunk length {c} is not a multiple of 128")
 
 
+def _ring_blocks(
+    kind: str, q, k, causal: bool, sliding_window: int | None
+) -> tuple[int, int]:
+    """Chunk-kernel tiles via the tuning layer (keyed at the CHUNK length
+    and the chunk pair's actual causality — the ring runs causal diagonal
+    pairs AND non-causal off-diagonal pairs, tuned separately). Env/table
+    choices win, fitted to divide the chunk; an untuned resolution keeps
+    the conservative <=512 heuristic the ring was measured with rather
+    than inheriting the full-sequence 1024 default."""
+    from llm_training_tpu.ops.pallas import tuning
+
+    choice = tuning.resolve_block_sizes(
+        kind, seq_len=max(q.shape[1], k.shape[1]), head_dim=q.shape[-1],
+        dtype=q.dtype, causal=causal, sliding_window=sliding_window,
+    )
+    if choice.source == "default":
+        block_q, block_k = _ring_block(q.shape[1]), _ring_block(k.shape[1])
+    else:
+        block_q = tuning.fit_block(choice.block_q, q.shape[1])
+        block_k = tuning.fit_block(choice.block_k, k.shape[1])
+    # record what actually compiles (post-fit), not the raw pick
+    tuning.record_block_choice(
+        kind, tuning.BlockChoice(block_q, block_k, choice.source)
+    )
+    return block_q, block_k
+
+
 def _pallas_ok(q, k) -> bool:
     return (
         q.shape[1] % 128 == 0
@@ -258,12 +285,13 @@ def _chunk_fwd(
 
         batch, _, hq, _ = q.shape
         hkv = k.shape[2]
+        block_q, block_k = _ring_blocks("fwd", q, k, causal, sliding_window)
         o, lse = flash_fwd_flat(
             _to_flat(q), _to_flat(k), _to_flat(v), seg_q, seg_kv,
             num_q_heads=hq, num_kv_heads=hkv, scale=scale, causal=causal,
             logits_soft_cap=logits_soft_cap,
             sliding_window=sliding_window, q_offset=q_offset,
-            block_q=_ring_block(q.shape[1]), block_k=_ring_block(k.shape[1]),
+            block_q=block_q, block_k=block_k,
             interpret=jax.default_backend() != "tpu",
         )
         return _from_flat(o, batch).astype(jnp.float32), lse.reshape(batch, hq, -1)
@@ -283,13 +311,14 @@ def _chunk_bwd(
         batch, _, hq, _ = q.shape
         hkv = k.shape[2]
         flat = lambda x: x.reshape(batch * hq, -1)
+        block_q, block_k = _ring_blocks("bwd", q, k, causal, sliding_window)
         dq, dk, dv = flash_bwd_flat(
             _to_flat(q), _to_flat(k), _to_flat(v), seg_q, seg_kv,
             _to_flat(do), flat(lse), flat(delta),
             num_q_heads=hq, num_kv_heads=hkv, scale=scale, causal=causal,
             logits_soft_cap=logits_soft_cap,
             sliding_window=sliding_window, q_offset=q_offset,
-            block_q=_ring_block(q.shape[1]), block_k=_ring_block(k.shape[1]),
+            block_q=block_q, block_k=block_k,
             interpret=jax.default_backend() != "tpu",
         )
         return _from_flat(dq, batch), _from_flat(dk, batch), _from_flat(dv, batch)

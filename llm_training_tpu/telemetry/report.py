@@ -181,6 +181,103 @@ def _decode_section(telemetry: dict) -> list[str]:
     return ["", "== Inference =="] + lines
 
 
+def _newest_bench_record(dirs: list[Path]) -> tuple[dict, str] | None:
+    """The newest bench record reachable from `dirs` (first match wins the
+    directory tie; within a directory, newest mtime then name — BENCH_rNN
+    names sort by round). Accepts both shapes: a raw bench.py summary
+    record and the driver's wrapper {n, cmd, rc, tail, parsed}."""
+    candidates: list[Path] = []
+    for d in dirs:
+        if d is None or not d.is_dir():
+            continue
+        for pattern in ("BENCH_r*.json", "bench*.json"):
+            candidates.extend(d.glob(pattern))
+        if candidates:
+            break
+    if not candidates:
+        return None
+    newest = max(candidates, key=lambda p: (p.stat().st_mtime, p.name))
+    try:
+        record = json.loads(newest.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(record, dict):
+        return None
+    if "parsed" in record:  # driver wrapper
+        parsed = record.get("parsed")
+        if not isinstance(parsed, dict):
+            parsed = {"error": f"bench crashed before emitting a record "
+                               f"(rc {record.get('rc')})"}
+        record = parsed
+    return record, newest.name
+
+
+def _perf_section(bench: tuple[dict, str] | None) -> list[str]:
+    """Newest bench record (MFU, vs_baseline, flash blocks used, per-stage
+    status — docs/performance.md). Omitted when no bench record is
+    reachable from the run/bench dir."""
+    if bench is None:
+        return []
+    record, name = bench
+    header = ["", "== Perf ==", f"bench record: {name}"]
+    try:
+        return header + _perf_lines(record)
+    except (KeyError, TypeError, ValueError, AttributeError):
+        # the broad bench*.json glob (and its cwd fallback) can pick up a
+        # foreign or malformed file — that must cost one honest line, not
+        # crash the whole report for a run that never touched bench
+        return header + ["unreadable bench record — malformed fields"]
+
+
+def _perf_lines(record: dict) -> list[str]:
+    lines = []
+    value = record.get("value")
+    if value is not None:
+        line = f"mfu: {float(value):.4f}"
+        if record.get("vs_baseline") is not None:
+            line += f" (vs_baseline {float(record['vs_baseline']):.3f})"
+        lines.append(line)
+        extras = []
+        if record.get("tokens_per_sec_per_chip") is not None:
+            extras.append(f"tokens/sec/chip {float(record['tokens_per_sec_per_chip']):,.1f}")
+        if record.get("sec_per_step") is not None:
+            extras.append(f"sec_per_step {float(record['sec_per_step']):.4f}")
+        if record.get("goodput_pct") is not None:
+            extras.append(f"goodput {float(record['goodput_pct']):.1f}%")
+        if extras:
+            lines.append("  ".join(extras))
+    else:
+        lines.append(f"mfu: unavailable — {record.get('error', 'no value recorded')}")
+    blocks = record.get("blocks") or {}
+    if blocks:
+        parts = [
+            f"{kind} {int(bq)}x{int(bk)}"
+            for kind, (bq, bk) in sorted(blocks.items())
+        ]
+        sources = record.get("block_sources") or {}
+        src = ", ".join(f"{k} x{v}" for k, v in sorted(sources.items()))
+        lines.append("flash blocks: " + "  ".join(parts) + (f"  (resolved: {src})" if src else ""))
+    stages = record.get("stages") or {}
+    if stages:
+        parts = []
+        for stage, info in stages.items():
+            status = info.get("status", "?")
+            part = f"{stage} {status}"
+            if status == "error" and info.get("error"):
+                part += f" ({info['error']})"
+            parts.append(part)
+        lines.append("stages: " + "  ".join(parts))
+    if record.get("health_overhead_pct") is not None:
+        lines.append(f"health_overhead_pct: {float(record['health_overhead_pct']):.2f}")
+    if record.get("decode_tokens_per_sec") is not None:
+        lines.append(
+            f"decode: {float(record['decode_tokens_per_sec']):,.1f} tokens/sec"
+            + (f"  prefill {float(record['prefill_time_s']):.3f}s"
+               if record.get("prefill_time_s") is not None else "")
+        )
+    return lines
+
+
 def _counter_section(title: str, rows: list[tuple[str, str]], telemetry: dict) -> list[str]:
     """An event-counter section: one `label: count` line per nonzero
     counter, the whole section omitted when nothing fired — a clean run's
@@ -224,7 +321,7 @@ def _resilience_section(telemetry: dict) -> list[str]:
     ], telemetry)
 
 
-def render_report(run_dir: str | Path) -> str:
+def render_report(run_dir: str | Path, bench_dir: str | Path | None = None) -> str:
     run_dir = Path(run_dir)
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
     if not metrics:
@@ -315,16 +412,19 @@ def render_report(run_dir: str | Path) -> str:
         lines.append(peak_line)
 
     lines.extend(_health_section(telemetry))
+    lines.extend(_perf_section(_newest_bench_record([
+        Path(bench_dir) if bench_dir else None, run_dir, Path.cwd(),
+    ])))
     lines.extend(_decode_section(telemetry))
     lines.extend(_recovery_section(telemetry))
     lines.extend(_resilience_section(telemetry))
     return "\n".join(lines)
 
 
-def report_main(run_dir: str) -> int:
+def report_main(run_dir: str, bench_dir: str | None = None) -> int:
     """`llm-training-tpu report <run_dir>` entry point."""
     try:
-        print(render_report(run_dir))
+        print(render_report(run_dir, bench_dir=bench_dir))
     except FileNotFoundError as e:
         print(f"report: {e}", file=sys.stderr)
         return 2

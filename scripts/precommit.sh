@@ -71,13 +71,61 @@ JAX_PLATFORMS=cpu python scripts/force_nan_smoke.py "${SMOKE_ROOT}/nan-smoke"
 echo "== precommit: kill-and-resume + supervise smoke =="
 JAX_PLATFORMS=cpu python scripts/crash_resume_smoke.py "${SMOKE_ROOT}/resilience"
 
+# bench harness gate (docs/performance.md): the full stage/subprocess/
+# partial-JSON plumbing must work on CPU so bench wiring can't rot unnoticed
+# between hardware rounds — every stage ok, a real MFU value, a summary
+# record with the stage/partial schema, and the report CLI's == Perf ==
+# section rendering it. Dry children self-demote to CPU via the jax config
+# API (bench.py main), so these legs stay off the chip even under the axon
+# sitecustomize, where env JAX_PLATFORMS=cpu alone does not demote
+echo "== precommit: bench dry (stage/partial-JSON plumbing) =="
+BENCH_OUT="${SMOKE_ROOT}/bench_dry.json" python bench.py --dry \
+    | tee "${SMOKE_ROOT}/bench_dry.log"
+python - "${SMOKE_ROOT}/bench_dry.log" <<'EOF'
+import json, sys
+records = [json.loads(l) for l in open(sys.argv[1]) if l.strip().startswith("{")]
+partials = [r for r in records if r.get("partial")]
+summary = records[-1]
+assert partials, "no per-stage partial records emitted"
+assert summary["stage"] == "summary" and summary["partial"] is False, summary
+assert summary["value"] is not None, f"dry bench produced no MFU: {summary}"
+bad = {s: i for s, i in summary["stages"].items() if i["status"] != "ok"}
+assert not bad, f"dry bench stages failed: {bad}"
+print("bench dry: OK", {s: i["status"] for s, i in summary["stages"].items()})
+EOF
+JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
+    --bench-dir "${SMOKE_ROOT}" | tee "${SMOKE_ROOT}/report_perf.log"
+grep -q "== Perf ==" "${SMOKE_ROOT}/report_perf.log"
+grep -q "bench record: bench_dry.json" "${SMOKE_ROOT}/report_perf.log"
+
+# chaos leg: an env-forced wedge in ONE stage must degrade to an error
+# record while the remaining stages still land valid partial JSON and the
+# summary stays parseable (the r04/r05 failure mode, made survivable)
+echo "== precommit: bench chaos wedge (degrade-not-die) =="
+rc=0
+BENCH_CHAOS_WEDGE=train BENCH_RUN_TIMEOUT=15 BENCH_HEALTH=0 \
+    python bench.py --dry | tee "${SMOKE_ROOT}/bench_wedge.log" || rc=$?
+test "$rc" -eq 1  # train (the headline) failed -> documented exit 1
+python - "${SMOKE_ROOT}/bench_wedge.log" <<'EOF'
+import json, sys
+records = [json.loads(l) for l in open(sys.argv[1]) if l.strip().startswith("{")]
+summary = records[-1]
+assert summary["stage"] == "summary" and summary["value"] is None, summary
+stages = summary["stages"]
+assert stages["train"]["status"] == "error", stages
+assert "wedged" in stages["train"]["error"], stages["train"]
+assert stages["backend_init"]["status"] == "ok", stages
+assert stages["decode"]["status"] == "ok", stages  # survived the wedge
+print("bench chaos wedge: OK", {s: i["status"] for s, i in stages.items()})
+EOF
+
 # note: under axon the sitecustomize registers the TPU backend at interpreter
 # start, so JAX_PLATFORMS=cpu does NOT demote this to a CPU smoke — when a
 # chip is attached this runs the REAL default bench (and must print rc=0 with
-# a sane MFU); on CPU-only machines it runs the tiny smoke config.
-# The axon tunnel can wedge for hours (verify-skill gotcha); a backend probe
-# gates the bench so an infra outage warns loudly instead of hanging the
-# commit — code problems still fail the gate whenever the chip is reachable.
+# a sane MFU); on CPU-only machines it runs the tiny smoke config. The
+# orchestrator itself never touches jax, so a wedged tunnel now costs the
+# per-stage timeouts instead of hanging the commit; the backend probe is
+# kept so a known-down tunnel skips the wait entirely.
 echo "== precommit: bench smoke (default bench path must run rc=0) =="
 if timeout 120 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     JAX_PLATFORMS=cpu python bench.py

@@ -257,6 +257,122 @@ def test_sinks_match_xla(sliding_window):
         )
 
 
+@pytest.mark.parametrize("name,hq,hkv,window,cap,packed", CASES, ids=[c[0] for c in CASES])
+def test_backward_matches_xla(name, hq, hkv, window, cap, packed):
+    """Fast-tier grad parity vs the einsum path across the full config
+    grid (causal / GQA / packed / sliding-window / softcap / everything) —
+    the BENCH_r04 crash class (`_dq_kernel` arity at trace time) can never
+    again reach hardware untraced, and dq/dk/dv stay numerically pinned.
+    GQA cases (group 2) drive the dkv kernel's 4-D (bh_kv, nk, group, nq)
+    grid."""
+    rng = np.random.default_rng(zlib.crc32(("bwd" + name).encode()))
+    batch, seq, d = 2, 256, 32
+    q, k, v = _make_qkv(rng, batch, seq, seq, hq, hkv, d)
+    seg = _packed_segments(rng, batch, seq) if packed else None
+    cot = jnp.asarray(_rand(rng, (batch, seq, hq, d)))
+    kwargs = dict(segment_ids=seg, causal=True, sliding_window=window, logits_soft_cap=cap)
+
+    gx = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, impl="xla", **kwargs) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gp = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, block_q=128, block_k=128, **kwargs) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, grad_name in zip(gx, gp, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=3e-3, atol=3e-3, err_msg=f"d{grad_name}")
+
+
+def test_backward_traces_with_resolved_blocks():
+    """The exact r04 call path: NO explicit blocks, so the backward traces
+    with tuning-layer-resolved tiles (table/default). A fwd/bwd kernel-arity
+    or resolution regression fails here before any hardware round."""
+    rng = np.random.default_rng(41)
+    q, k, v = _make_qkv(rng, 1, 256, 256, 4, 2, 32)
+    cot = jnp.asarray(_rand(rng, (1, 256, 4, 32)))
+
+    gx = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, impl="xla", causal=True) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gp = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal=True) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, grad_name in zip(gx, gp, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=3e-3, atol=3e-3, err_msg=f"d{grad_name}")
+
+
+def test_backward_independent_fwd_bwd_blocks():
+    """fwd and bwd tiles are independent knobs; mixing them must be
+    numerically invisible (same grads as uniform tiles)."""
+    rng = np.random.default_rng(42)
+    q, k, v = _make_qkv(rng, 1, 512, 512, 4, 2, 32)
+    seg = jnp.asarray(np.repeat([1, 2], 256)[None], jnp.int32)
+    cot = jnp.asarray(_rand(rng, (1, 512, 4, 32)))
+
+    def grads(**blocks):
+        return jax.grad(
+            lambda q, k, v: (flash_attention(
+                q, k, v, segment_ids=seg, causal=True, sliding_window=100, **blocks
+            ) * cot).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+
+    base = grads(block_q=128, block_k=128, bwd_block_q=128, bwd_block_k=128)
+    mixed = grads(block_q=256, block_k=128, bwd_block_q=128, bwd_block_k=256)
+    for a, b, grad_name in zip(base, mixed, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-5, err_msg=f"d{grad_name}")
+
+
+def test_flash_bwd_flat_kernel_arity():
+    """Direct flat-kernel call (the layer ring attention uses): the dq
+    pallas_call hands the kernel 2 scalar-prefetch + 8 input refs + 1
+    output + 1 scratch, the dkv call 2+8+2+2 on its 4-D grid — a parameter
+    drift in either kernel body TypeErrors at trace time right here."""
+    from llm_training_tpu.ops.pallas.flash_attention import (
+        flash_bwd_flat, flash_fwd_flat,
+    )
+
+    rng = np.random.default_rng(43)
+    batch, seq, hq, hkv, d = 2, 256, 4, 2, 64
+    q = jnp.asarray(_rand(rng, (batch * hq, seq, d)))
+    k = jnp.asarray(_rand(rng, (batch * hkv, seq, d)))
+    v = jnp.asarray(_rand(rng, (batch * hkv, seq, d)))
+    seg = jnp.asarray(np.tile(np.repeat([1, 2], seq // 2)[None], (batch, 1)), jnp.int32)
+    kw = dict(num_q_heads=hq, num_kv_heads=hkv, scale=d**-0.5, causal=True,
+              block_q=128, block_k=128, interpret=True)
+
+    o, lse = flash_fwd_flat(q, k, v, seg, seg, **kw)
+    do = jnp.asarray(_rand(rng, (batch * hq, seq, d)))
+    delta = jnp.sum(do * o.astype(jnp.float32), axis=-1)
+    dq, dk, dv = flash_bwd_flat(q, k, v, seg, seg, do, lse, delta, **kw)
+    assert dq.shape == q.shape and dk.shape == k.shape and dv.shape == v.shape
+    for name, g in (("dq", dq), ("dk", dk), ("dv", dv)):
+        assert np.isfinite(np.asarray(g)).all(), f"{name} has non-finite entries"
+
+
+def test_backward_gqa_group4_dkv_grid():
+    """Group-4 GQA: the dkv kernel's group axis is length 4, so its
+    (g == ng-1) flush gate and q-head indexing get a non-trivial workout."""
+    rng = np.random.default_rng(44)
+    q, k, v = _make_qkv(rng, 1, 256, 256, 8, 2, 32)
+    cot = jnp.asarray(_rand(rng, (1, 256, 8, 32)))
+    kwargs = dict(causal=True, sliding_window=70)
+
+    gx = jax.grad(
+        lambda q, k, v: (dot_product_attention(q, k, v, impl="xla", **kwargs) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gp = jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, block_q=128, block_k=128, **kwargs) * cot).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b, grad_name in zip(gx, gp, "qkv"):
+        np.testing.assert_allclose(b, a, rtol=3e-3, atol=3e-3, err_msg=f"d{grad_name}")
+
+
 @pytest.mark.parametrize("case", [
     # (seq, docs spec, window, gqa, block)  — layouts chosen to stress the
     # DMA-elision index maps: block-aligned boundaries, a doc spanning
