@@ -9,6 +9,7 @@ process (the PR 3/PR 5 `Supervisor` + `HangWatchdog` machinery):
   train         the headline MFU fit
   health        A/B fit with the model-health layer on (health_overhead_pct)
   decode        tiny-model generate (decode-program overhead trend)
+  serve         tiny-model continuous batching (serve tokens/s/chip + TTFT)
 
 The PARENT never imports jax — a wedged backend can only hang a child,
 which the per-stage timeout kills (and the fit stages arm the in-process
@@ -43,7 +44,7 @@ import subprocess
 import sys
 import time
 
-STAGES = ("backend_init", "train", "health", "decode")
+STAGES = ("backend_init", "train", "health", "decode", "serve")
 
 # peak bf16 FLOP/s per chip by TPU generation (public specs)
 _PEAK_FLOPS = {
@@ -470,11 +471,60 @@ def stage_decode() -> dict:
     }
 
 
+def stage_serve() -> dict:
+    """Serving-path gauge (docs/serving.md): a TINY-model continuous-
+    batching run through the `ServingEngine` — paged pool, chunked prefill,
+    per-slot ragged decode. Like the decode stage this tracks the serve
+    program's dispatch/step overhead trend, not model-scale throughput.
+    A warm-up run absorbs the prefill/decode compiles, so the measured
+    run's TTFT percentiles are scheduling numbers, not compile numbers."""
+    import jax
+    import numpy as np
+
+    from llm_training_tpu.models import Llama, LlamaConfig
+    from llm_training_tpu.serve import ServeConfig, ServingEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    tiny = Llama(LlamaConfig(
+        vocab_size=2048, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512,
+        compute_dtype="float32" if not on_tpu else "bfloat16",
+    ))
+    variables = tiny.init(jax.random.key(0), np.zeros((1, 4), np.int32))
+    engine = ServingEngine(tiny, variables, ServeConfig(
+        max_batch=4, max_model_len=96, prefill_chunk=16, eos_token_id=None,
+    ))
+
+    def traffic(tag, n_tokens):
+        return [
+            {"id": f"{tag}{row}", "prompt": [int(t) for t in np.arange(1, 9 + 4 * row)],
+             "max_new_tokens": n_tokens}
+            for row in range(4)
+        ]
+
+    engine.run(traffic("warm", 4))
+    t0 = time.perf_counter()
+    events = engine.run(traffic("r", 32))
+    wall = time.perf_counter() - t0
+    done = [e for e in events if e["type"] == "done"]
+    assert len(done) == 4, f"serve bench dropped requests: {done}"
+    tokens = sum(e["n_tokens"] for e in done)
+    ttft = [e["ttft_ms"] for e in done if "ttft_ms" in e]
+    tps_chip = tokens / wall / max(1, len(jax.devices()))
+    return {
+        "serve_tokens_per_sec_per_chip": round(tps_chip, 1),
+        "serve_ttft_p50_ms": round(float(np.percentile(ttft, 50)), 3),
+        "serve_ttft_p99_ms": round(float(np.percentile(ttft, 99)), 3),
+    }
+
+
 _STAGE_FNS = {
     "backend_init": stage_backend_init,
     "train": stage_train,
     "health": stage_health,
     "decode": stage_decode,
+    "serve": stage_serve,
 }
 
 
@@ -502,6 +552,7 @@ def _stage_timeout(stage: str) -> float:
         "train": run_timeout,
         "health": env("BENCH_HEALTH_TIMEOUT", run_timeout),
         "decode": env("BENCH_DECODE_TIMEOUT", 600),
+        "serve": env("BENCH_SERVE_TIMEOUT", 600),
     }[stage]
 
 
@@ -510,6 +561,8 @@ def _stage_enabled(stage: str) -> bool:
         return os.environ.get("BENCH_HEALTH", "1") != "0"
     if stage == "decode":
         return os.environ.get("BENCH_DECODE", "1") != "0"
+    if stage == "serve":
+        return os.environ.get("BENCH_SERVE", "1") != "0"
     return True
 
 
@@ -638,6 +691,10 @@ def summarize(results: dict) -> dict:
     decode = results.get("decode", {})
     summary["prefill_time_s"] = decode.get("prefill_time_s")
     summary["decode_tokens_per_sec"] = decode.get("decode_tokens_per_sec")
+    serve = results.get("serve", {})
+    for key in ("serve_tokens_per_sec_per_chip", "serve_ttft_p50_ms",
+                "serve_ttft_p99_ms"):
+        summary[key] = serve.get(key)
 
     summary["stages"] = {
         stage: {

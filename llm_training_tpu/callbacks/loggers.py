@@ -22,7 +22,7 @@ logger = logging.getLogger(__name__)
 # record a `report` invocation reads (docs/observability.md)
 TELEMETRY_PREFIXES = (
     "goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/",
-    "health/", "nan_guard/", "resilience/", "decode/", "eval/",
+    "health/", "nan_guard/", "resilience/", "decode/", "eval/", "serve/",
 )
 TELEMETRY_KEYS = ("compile_time_s",)
 

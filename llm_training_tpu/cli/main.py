@@ -1,4 +1,5 @@
-"""Console entry: fit / validate / generate / evaluate / report / supervise.
+"""Console entry: fit / validate / generate / serve / evaluate / report /
+supervise.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -10,7 +11,11 @@ its run directory (docs/observability.md) — no config or backend needed.
 read-only and drive the inference subsystem (`llm_training_tpu.infer`):
 batched KV-cache decoding with sampling, and packed-perplexity held-out
 scoring; both merge their `decode/*` / `eval/*` telemetry into the run
-directory's telemetry.jsonl so `report` renders it. `supervise`
+directory's telemetry.jsonl so `report` renders it. `serve`
+(docs/serving.md) is the continuous-batching tier over the same restored
+checkpoint: JSONL requests on stdin, streamed token/done chunks on stdout,
+paged KV cache with mid-flight admission — its `serve/*` gauges merge the
+same way and render as `== Serving ==`. `supervise`
 (docs/resilience.md) runs `fit` as a child process and relaunches it on
 preemption (exit 75) and hard deaths (SIGKILL/segfault/SIGABRT), with a
 restart budget, backoff, and a supervisor.jsonl event log.
@@ -214,9 +219,110 @@ def _run_generate(args, config: dict) -> int:
             "prompt": prompts[row],
             "tokens": tokens,
             "sequence": result["sequences"][row],
+            "n_tokens": result["lengths"][row],
+            "stop_reason": result["stop_reasons"][row],
         }))
     print(json.dumps({"stats": result["stats"]}))
     _publish_run_telemetry(config, result["stats"])
+    return 0
+
+
+def _run_serve(args, config: dict) -> int:
+    """`serve`: continuous-batching generation over a JSONL stdin/stdout
+    protocol (docs/serving.md#protocol). One request per input line
+    ({"id", "prompt": [ids], "max_new_tokens"?, "priority"?}); the engine
+    streams {"type": "token"} chunks and a {"type": "done"} terminator per
+    request as they land, interleaving new admissions with in-flight
+    decodes. stdin EOF drains the queue, then a final {"type": "stats"}
+    record carries the serve/* gauges (also merged into the run dir's
+    telemetry.jsonl for `report`)."""
+    import json
+    import queue
+    import threading
+
+    from llm_training_tpu.infer import SamplingConfig
+    from llm_training_tpu.serve import ServeConfig, ServingEngine
+    from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+    trainer, objective, _ = _build(config)
+    _require_single_model_objective(objective, "serve")
+    state = trainer.restore_for_inference(
+        objective, int(args.ckpt_path) if args.ckpt_path else None
+    )
+    serve_config = ServeConfig(
+        max_batch=args.max_batch,
+        max_model_len=args.max_model_len,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        prefill_chunk=args.prefill_chunk,
+        cache_dtype=args.cache_dtype,
+        seed=args.seed,
+        eos_token_id=(
+            args.eos_token_id if args.eos_token_id is not None
+            else _scalar_eos(objective.model.config)
+        ),
+        sampling=SamplingConfig(
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+        ),
+    )
+    engine = ServingEngine(
+        objective.model, state.params, serve_config,
+        mesh=trainer.mesh, rules=LOGICAL_AXIS_RULES,
+    )
+
+    # a reader thread feeds stdin lines into a queue so request intake
+    # never blocks the decode loop — that interleave IS continuous
+    # batching: a request arriving mid-decode is admitted at the next step
+    lines: queue.Queue = queue.Queue()
+    _EOF = object()
+
+    def read_stdin():
+        for line in sys.stdin:
+            lines.put(line)
+        lines.put(_EOF)
+
+    threading.Thread(target=read_stdin, daemon=True).start()
+
+    def emit(events):
+        for event in events:
+            print(json.dumps(event), flush=True)
+
+    def ingest(line) -> bool:
+        """One stdin line -> submit; False at EOF."""
+        if line is _EOF:
+            return False
+        line = line.strip()
+        if not line:
+            return True
+        try:
+            request = json.loads(line)
+            emit(engine.submit(
+                id=request["id"], prompt=request["prompt"],
+                max_new_tokens=int(
+                    request.get("max_new_tokens", args.max_new_tokens)
+                ),
+                priority=int(request.get("priority", 0)),
+            ))
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            print(json.dumps({
+                "type": "error", "error": f"bad request line: {e}"
+            }), flush=True)
+        return True
+
+    open_stdin = True
+    while open_stdin or not engine.scheduler.idle:
+        if engine.scheduler.idle:
+            open_stdin = ingest(lines.get())  # nothing in flight: block
+            continue
+        try:  # in flight: drain whatever arrived, never stall the batch
+            while open_stdin:
+                open_stdin = ingest(lines.get_nowait()) and open_stdin
+        except queue.Empty:
+            pass
+        emit(engine.step())
+    stats = engine.stats()
+    print(json.dumps({"type": "stats", "stats": stats}), flush=True)
+    _publish_run_telemetry(config, stats)
     return 0
 
 
@@ -320,6 +426,50 @@ def main(argv: list[str] | None = None) -> int:
         help="stop token (default: the model config's scalar eos, if any)",
     )
     generate.add_argument("overrides", nargs="*")
+    serve = sub.add_parser(
+        "serve",
+        help="continuous-batching generation server: JSONL requests on "
+        "stdin, streamed token/done chunks on stdout (docs/serving.md)",
+    )
+    serve.add_argument("--config", required=True)
+    serve.add_argument("--ckpt-path", default=None, help="checkpoint step to restore")
+    serve.add_argument(
+        "--max-batch", type=int, default=4, help="decode slots (static batch)"
+    )
+    serve.add_argument(
+        "--max-model-len", type=int, default=256,
+        help="per-request cap: prompt + generated tokens",
+    )
+    serve.add_argument(
+        "--block-size", type=int, default=None,
+        help="KV-pool tokens per block (default: PAGED_BLOCK_K env > "
+        "tuning table > 16)",
+    )
+    serve.add_argument(
+        "--num-blocks", type=int, default=None,
+        help="KV-pool capacity in blocks (default: max_batch full-length "
+        "requests — no block pressure)",
+    )
+    serve.add_argument(
+        "--prefill-chunk", type=int, default=32,
+        help="prompt tokens prefilled per step (interleaved with decode)",
+    )
+    serve.add_argument(
+        "--max-new-tokens", type=int, default=32,
+        help="default generation budget for requests that omit it",
+    )
+    serve.add_argument(
+        "--cache-dtype", default=None, choices=("param", "float32", "bfloat16")
+    )
+    serve.add_argument("--temperature", type=float, default=0.0)
+    serve.add_argument("--top-k", type=int, default=None)
+    serve.add_argument("--top-p", type=float, default=None)
+    serve.add_argument("--seed", type=int, default=0)
+    serve.add_argument(
+        "--eos-token-id", type=int, default=None,
+        help="stop token (default: the model config's scalar eos, if any)",
+    )
+    serve.add_argument("overrides", nargs="*")
     evaluate = sub.add_parser(
         "evaluate", help="packed perplexity / per-token NLL from a checkpoint"
     )
@@ -381,6 +531,8 @@ def main(argv: list[str] | None = None) -> int:
 
     if args.command == "generate":
         return _run_generate(args, config)
+    if args.command == "serve":
+        return _run_serve(args, config)
     if args.command == "evaluate":
         return _run_evaluate(args, config)
 

@@ -115,11 +115,24 @@ def init_decode_state(
         )
 
     if mesh is None:
-        return build()
-    shardings = decode_state_shardings(
-        config, batch_size, max_length, mesh, rules or (), rope_length=rope_length
-    )
-    return jax.jit(build, out_shardings=shardings)()
+        state = build()
+    else:
+        shardings = decode_state_shardings(
+            config, batch_size, max_length, mesh, rules or (), rope_length=rope_length
+        )
+        state = jax.jit(build, out_shardings=shardings)()
+    _publish_cache_bytes(state)
+    return state
+
+
+def _publish_cache_bytes(state: DecodeState) -> None:
+    """Every cache construction lands its HBM footprint in telemetry
+    (`decode/cache_bytes`) — callers used to re-publish this themselves,
+    which left non-engine constructions (eval, serve warm-up) invisible in
+    telemetry.jsonl and `report`."""
+    from llm_training_tpu.telemetry import get_registry
+
+    get_registry().gauge("decode/cache_bytes").set(cache_bytes(state))
 
 
 def cache_bytes(state: DecodeState) -> int:

@@ -23,6 +23,7 @@ through the process registry, so the `generate` CLI lands it in
 
 from __future__ import annotations
 
+import contextlib
 import inspect
 import logging
 import time
@@ -68,6 +69,19 @@ class GenerateConfig(BaseModel):
         if self.max_length is not None and self.max_length < 1:
             raise ValueError(f"max_length must be >= 1, got {self.max_length}")
         return self
+
+
+def mesh_context(mesh: Any, rules: Any = ()) -> contextlib.ExitStack:
+    """The ambience every sharded inference/serving program runs under:
+    the mesh + the logical axis rules, or nothing off-mesh. Shared by
+    `InferenceEngine` and `serve.ServingEngine`."""
+    context = contextlib.ExitStack()
+    if mesh is not None:
+        import flax.linen as nn
+
+        context.enter_context(mesh)
+        context.enter_context(nn.logical_axis_rules(rules or ()))
+    return context
 
 
 def supports_decoding(model: Any) -> bool:
@@ -171,7 +185,9 @@ class InferenceEngine:
         config: GenerateConfig | None = None,
     ) -> dict[str, Any]:
         """-> {"tokens": new tokens per row (truncated after eos),
-        "sequences": prompt + new tokens, "stats": decode telemetry}."""
+        "sequences": prompt + new tokens, "lengths": generated count per
+        row, "stop_reasons": "eos" | "max_tokens" per row, "stats": decode
+        telemetry}."""
         from llm_training_tpu.telemetry import get_registry
 
         config = config or GenerateConfig()
@@ -187,15 +203,7 @@ class InferenceEngine:
             )
         self._build_programs(config.sampling)
 
-        import contextlib
-
-        context = contextlib.ExitStack()
-        if self.mesh is not None:
-            import flax.linen as nn
-
-            context.enter_context(self.mesh)
-            context.enter_context(nn.logical_axis_rules(self.rules))
-        with context:
+        with mesh_context(self.mesh, self.rules):
             state = init_decode_state(
                 model_config, batch, max_length,
                 mesh=self.mesh, rules=self.rules,
@@ -204,8 +212,8 @@ class InferenceEngine:
                 # length the generation will REACH, not the cache capacity
                 rope_length=width + config.max_new_tokens,
             )
+            # decode/cache_bytes is published by init_decode_state itself
             registry = get_registry()
-            registry.gauge("decode/cache_bytes").set(cache_bytes(state))
             registry.gauge("decode/max_length").set(max_length)
 
             # a prompt may legitimately CONTAIN pad_id tokens, so padding is
@@ -271,12 +279,16 @@ class InferenceEngine:
                     steady_s = time.perf_counter() - t_steady
                     steady_steps = config.max_new_tokens - 2
                 grid = np.stack([np.asarray(t) for t in host], axis=1)
-        tokens, sequences = [], []
+        tokens, sequences, lengths, stop_reasons = [], [], [], []
         for row in range(batch):
             emitted = grid[row].tolist()
             if eos is not None and eos in emitted:
                 emitted = emitted[: emitted.index(eos) + 1]
+                stop_reasons.append("eos")
+            else:
+                stop_reasons.append("max_tokens")
             tokens.append(emitted)
+            lengths.append(len(emitted))
             sequences.append(list(prompts[row]) + emitted)
 
         # steady-state decode rate: the first decode step carries the
@@ -296,7 +308,16 @@ class InferenceEngine:
             "%.1f tokens/s decode",
             batch, stats["decode/new_tokens"], prefill_s, decode_tps,
         )
-        return {"tokens": tokens, "sequences": sequences, "stats": stats}
+        return {
+            "tokens": tokens,
+            "sequences": sequences,
+            # per-row generated length + why each row stopped ("eos" |
+            # "max_tokens") — callers (serve scheduler, evaluate, bench)
+            # no longer re-scan the outputs for the eos token
+            "lengths": lengths,
+            "stop_reasons": stop_reasons,
+            "stats": stats,
+        }
 
     def _place(self, ids, segment_ids, position_ids, pad_lens):
         """Host arrays -> device, batch-sharded over the mesh when the

@@ -144,6 +144,52 @@ class DecodeState:
 
 
 @flax.struct.dataclass
+class PagedDecodeState:
+    """Block-table KV cache for the serving subsystem (`serve/`,
+    docs/serving.md) — the continuous-batching successor to `DecodeState`'s
+    shared-append-index layout.
+
+    `k`/`v` are `[num_layers, num_blocks, block_size, num_kv_heads,
+    head_dim]` POOL buffers: fixed-size blocks allocated to requests by the
+    host-side `serve.paged_cache.BlockAllocator` (physical block 0 is a
+    reserved trash block — idle decode slots and padded chunk positions
+    write there, so garbage rows can never corrupt a live request's cache).
+    `block_tables [batch, max_blocks_per_request]` maps each row's logical
+    block index to a physical pool block; `lengths [batch]` is each row's
+    token count already written — per-row, unlike `DecodeState.index`,
+    which is what lets a finished request's blocks be recycled and a new
+    request join mid-flight without left-padding anyone.
+
+    The decoder stacks thread this through the SAME `layer_kv`/`kv_index`/
+    `kv_segment_ids` plumbing as `DecodeState` (kv_index carries the [B]
+    lengths, kv_segment_ids carries the block tables); attention layers
+    dispatch on `kv_index.ndim` to `ops.paged_attention`."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    block_tables: jnp.ndarray
+    lengths: jnp.ndarray
+    # STATIC: planned total sequence length for length-dependent RoPE table
+    # selection (same contract as DecodeState.rope_length); None = the
+    # per-request capacity block_tables can address.
+    rope_length: int | None = flax.struct.field(pytree_node=False, default=None)
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def max_length(self) -> int:
+        """Per-request addressable capacity (blocks per table x block size)."""
+        return self.block_tables.shape[1] * self.block_size
+
+    @property
+    def table_length(self) -> int:
+        """The length RoPE table selection should see (static)."""
+        return self.rope_length or self.max_length
+
+
+@flax.struct.dataclass
 class CausalLMOutput:
     """Forward output (reference `modeling_outputs.py:11-13`).
 

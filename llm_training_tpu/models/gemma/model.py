@@ -32,7 +32,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from llm_training_tpu.models.base import CausalLMOutput, DecodeState
+from llm_training_tpu.models.base import (
+    CausalLMOutput,
+    DecodeState,
+    PagedDecodeState,
+)
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.gemma.config import GemmaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention
@@ -98,6 +102,22 @@ class GemmaAttention(nn.Module):
             q = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="q_norm")(q)
             k = GemmaRMSNorm(cfg.rms_norm_eps, cfg.param_jnp_dtype, name="k_norm")(k)
         q, k = apply_rope(q, k, cos, sin)
+        if layer_kv is not None and kv_index.ndim == 1:
+            # paged cache (serve/): kv_index = per-row lengths,
+            # kv_segment_ids = block table — see LlamaAttention
+            from llm_training_tpu.ops.paged_attention import paged_cached_attention
+
+            out, new_kv = paged_cached_attention(
+                q, k, v, layer_kv, kv_index, kv_segment_ids,
+                segment_ids=segment_ids,
+                sliding_window=self.sliding_window,
+                logits_soft_cap=cfg.attn_logit_softcapping,
+                scale=cfg.attention_scale,
+            )
+            out = out.astype(hidden.dtype).reshape(
+                batch, seq, cfg.num_attention_heads * cfg.head_dim
+            )
+            return _dense(cfg, cfg.hidden_size, ("heads", "embed"), "o_proj")(out), new_kv
         if layer_kv is not None:
             ck, cv = layer_kv
             ck = jax.lax.dynamic_update_slice(
@@ -326,8 +346,9 @@ class Gemma(nn.Module):
         hidden = inputs_embeds * normalizer
         seq = hidden.shape[1]
 
+        paged = isinstance(decode_state, PagedDecodeState)
         kv_segment_ids = None
-        if decode_state is not None:
+        if decode_state is not None and not paged:
             # shared-stack KV-cache convention (llama/model.py): merge the
             # chunk's segment ids into the cache's filled-slot map up front
             if segment_ids is None:
@@ -336,6 +357,12 @@ class Gemma(nn.Module):
                 decode_state.segment_ids, segment_ids.astype(jnp.int32),
                 (0, decode_state.index),
             )
+        elif paged:
+            # paged plumbing (llama/model.py): kv_index carries the per-row
+            # lengths, kv_segment_ids the block table
+            if segment_ids is None:
+                segment_ids = jnp.ones((hidden.shape[0], seq), jnp.int32)
+            kv_segment_ids = decode_state.block_tables
 
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
@@ -359,11 +386,21 @@ class Gemma(nn.Module):
                 None if decode_state is None
                 else (decode_state.k, decode_state.v)
             ),
-            kv_index=None if decode_state is None else decode_state.index,
+            kv_index=(
+                None if decode_state is None
+                else decode_state.lengths if paged
+                else decode_state.index
+            ),
             kv_segment_ids=kv_segment_ids,
         )
         new_decode_state = None
-        if decode_state is not None:
+        if paged:
+            new_decode_state = decode_state.replace(
+                k=new_kv[0], v=new_kv[1],
+                lengths=decode_state.lengths
+                + jnp.sum(segment_ids > 0, axis=1).astype(jnp.int32),
+            )
+        elif decode_state is not None:
             new_decode_state = decode_state.replace(
                 k=new_kv[0], v=new_kv[1],
                 index=decode_state.index + seq,

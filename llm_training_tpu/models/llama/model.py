@@ -28,7 +28,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from llm_training_tpu.models.base import CausalLMOutput, DecodeState, RouterStats
+from llm_training_tpu.models.base import (
+    CausalLMOutput,
+    DecodeState,
+    PagedDecodeState,
+    RouterStats,
+)
 from llm_training_tpu.models.remat import remat_policy as _remat_policy
 from llm_training_tpu.models.llama.config import LlamaConfig
 from llm_training_tpu.ops import apply_rope, dot_product_attention, rms_norm
@@ -267,15 +272,29 @@ class LlamaAttention(nn.Module):
         kv_index) hides slots written after this chunk, and `kv_segment_ids`
         (0 on unwritten/pad slots) hides garbage — so ONE program serves
         both prefill (chunk at index 0) and single-token decode steps.
-        Always the XLA einsum path: the flash kernel's block tiling assumes
-        q_len ≥ a block and a static q_offset; a ragged-paged decode kernel
-        (PAPERS.md, arxiv 2604.15464) is the designated successor."""
+        Dense-cache attention is always the XLA einsum path: the flash
+        kernel's block tiling assumes q_len ≥ a block and a static q_offset.
+
+        A PAGED cache (`PagedDecodeState`, serve/ subsystem) arrives through
+        the same plumbing with per-ROW lengths in `kv_index` ([B], vs the
+        dense scalar) and the block table in `kv_segment_ids` — dispatched
+        to `ops.paged_attention` (ragged Pallas decode kernel on TPU, XLA
+        gather fallback elsewhere)."""
         cfg = self.config
         window = (
             getattr(cfg, "sliding_window", None)
             if self.sliding_window_override == "unset"
             else self.sliding_window_override
         )
+        if kv_index.ndim == 1:
+            from llm_training_tpu.ops.paged_attention import paged_cached_attention
+
+            return paged_cached_attention(
+                q, k, v, layer_kv, kv_index, kv_segment_ids,
+                segment_ids=segment_ids,
+                sliding_window=window,
+                scale=getattr(cfg, "attention_multiplier", None),
+            )
         ck, cv = layer_kv
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, kv_index, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, kv_index, 0, 0))
@@ -668,8 +687,9 @@ class Llama(nn.Module):
             hidden = hidden * jnp.asarray(em, hidden.dtype)
         seq = hidden.shape[1]
 
+        paged = isinstance(decode_state, PagedDecodeState)
         kv_segment_ids = None
-        if decode_state is not None:
+        if decode_state is not None and not paged:
             # the chunk's q-side segment ids (pads 0, real tokens 1) double
             # as the cache-slot ids for the slots it writes; merge them into
             # the cache's filled-slot map BEFORE the layers so every layer
@@ -680,6 +700,15 @@ class Llama(nn.Module):
                 decode_state.segment_ids, segment_ids.astype(jnp.int32),
                 (0, decode_state.index),
             )
+        elif paged:
+            # paged plumbing reuses the dense arg slots: kv_index carries
+            # the per-row lengths, kv_segment_ids the block table (see
+            # LlamaAttention._cached_attention); q-side segment ids mark
+            # padded chunk positions, which the paged append redirects to
+            # the trash block
+            if segment_ids is None:
+                segment_ids = jnp.ones((hidden.shape[0], seq), jnp.int32)
+            kv_segment_ids = decode_state.block_tables
 
         if position_ids is None:
             position_ids = jnp.arange(seq)[None, :]
@@ -748,11 +777,23 @@ class Llama(nn.Module):
                 None if decode_state is None
                 else (decode_state.k, decode_state.v)
             ),
-            kv_index=None if decode_state is None else decode_state.index,
+            kv_index=(
+                None if decode_state is None
+                else decode_state.lengths if paged
+                else decode_state.index
+            ),
             kv_segment_ids=kv_segment_ids,
         )
         new_decode_state = None
-        if decode_state is not None:
+        if paged:
+            # per-row advance by the chunk's REAL token count (padded tail
+            # positions of a final prefill chunk don't occupy cache slots)
+            new_decode_state = decode_state.replace(
+                k=new_kv[0], v=new_kv[1],
+                lengths=decode_state.lengths
+                + jnp.sum(segment_ids > 0, axis=1).astype(jnp.int32),
+            )
+        elif decode_state is not None:
             new_decode_state = decode_state.replace(
                 k=new_kv[0], v=new_kv[1],
                 index=decode_state.index + seq,

@@ -43,11 +43,26 @@ from pathlib import Path
 
 DEFAULT_BLOCK = 1024
 _LANES = 128
+_SUBLANES = 8
 
 # env knobs (read at CALL time, never at import)
 ENV_FWD = {"block_q": "FLASH_BLOCK_Q", "block_k": "FLASH_BLOCK_K"}
 ENV_BWD = {"block_q": "FLASH_BLOCK_Q_BWD", "block_k": "FLASH_BLOCK_K_BWD"}
+# the ragged paged-decode kernel (ops/pallas/paged_attention.py): block_k is
+# the KV-pool page size — one page IS the kernel's kv tile, so page size is
+# this kernel family's tile knob; block_q is reserved (decode q_len == 1)
+ENV_PAGED = {"block_q": "PAGED_BLOCK_Q", "block_k": "PAGED_BLOCK_K"}
 ENV_TABLE = "FLASH_TUNING_TABLE"
+
+# the paged kernel's page axis sits in the SUBLANE dimension of its
+# [group, page] score tile (lanes carry head_dim), so its knobs align to 8,
+# not 128 — and serving pools want small pages (16-64 tokens) anyway
+_KIND_ALIGN = {"fwd": _LANES, "bwd": _LANES, "paged": _SUBLANES}
+_KIND_DEFAULT = {
+    "fwd": (DEFAULT_BLOCK, DEFAULT_BLOCK),
+    "bwd": (DEFAULT_BLOCK, DEFAULT_BLOCK),
+    "paged": (_SUBLANES, 16),
+}
 
 _REPO_ROOT = Path(__file__).resolve().parents[3]
 DEFAULT_TABLE_PATH = _REPO_ROOT / "config" / "tuning" / "flash_blocks.json"
@@ -147,17 +162,19 @@ def clear_table_cache() -> None:
         _table_cache.clear()
 
 
-def _entry_blocks(entry) -> tuple[int, int] | None:
+def _entry_blocks(entry, align: int = _LANES) -> tuple[int, int] | None:
     """Blocks from one table entry, or None when the entry is malformed
-    (not a dict, missing/non-int blocks, or not lane-aligned). A bad entry
-    must degrade exactly like a corrupt table — skipped, never a trace-time
-    crash in a training run (env/call-sourced values raising IS correct:
-    those are deliberate per-run intent, this file is ambient state)."""
+    (not a dict, missing/non-int blocks, or not aligned to `align` — the
+    lane width for the flash kinds, the sublane width for paged). A bad
+    entry must degrade exactly like a corrupt table — skipped, never a
+    trace-time crash in a training run (env/call-sourced values raising IS
+    correct: those are deliberate per-run intent, this file is ambient
+    state)."""
     try:
         bq, bk = int(entry["block_q"]), int(entry["block_k"])
     except (KeyError, TypeError, ValueError):
         return None
-    if bq < _LANES or bq % _LANES or bk < _LANES or bk % _LANES:
+    if bq < align or bq % align or bk < align or bk % align:
         return None
     return bq, bk
 
@@ -188,10 +205,11 @@ def _table_lookup(
     table = load_table()
     if table is None:
         return None
+    align = _KIND_ALIGN.get(kind, _LANES)
     entries = table["entries"]
     exact = entries.get(table_key(kind, seq_len, head_dim, dtype, causal, sliding_window))
     if exact is not None:
-        blocks = _entry_blocks(exact)
+        blocks = _entry_blocks(exact, align)
         if blocks is not None and _entry_applies(exact):
             return blocks
     # nearest-seq fallback among entries matching every other field: ties go
@@ -206,7 +224,7 @@ def _table_lookup(
     best = None
     for key, entry in entries.items():
         parsed = _parse_key(key)
-        blocks = _entry_blocks(entry)
+        blocks = _entry_blocks(entry, align)
         if parsed is None or blocks is None or not _entry_applies(entry):
             continue
         if {k: parsed[k] for k in want} != want:
@@ -242,13 +260,16 @@ def resolve_block_sizes(
 ) -> BlockChoice:
     """Resolve `(block_q, block_k)` for one kernel kind at one shape.
 
-    Priority per knob: explicit arg > env > tuning table > DEFAULT_BLOCK.
-    The reported `source` is the most specific origin that contributed
-    either knob (call > env > table > default).
+    Priority per knob: explicit arg > env > tuning table > the kind's
+    default. The reported `source` is the most specific origin that
+    contributed either knob (call > env > table > default). `kind="paged"`
+    resolves the ragged paged-decode kernel's knobs: block_k is the KV-pool
+    page size (the kernel's kv tile), sublane-aligned (8) instead of
+    lane-aligned; block_q is reserved (decode q_len == 1).
     """
-    if kind not in ("fwd", "bwd"):
-        raise ValueError(f"kind must be 'fwd' or 'bwd', got {kind!r}")
-    env = ENV_BWD if kind == "bwd" else ENV_FWD
+    if kind not in ("fwd", "bwd", "paged"):
+        raise ValueError(f"kind must be 'fwd', 'bwd' or 'paged', got {kind!r}")
+    env = {"fwd": ENV_FWD, "bwd": ENV_BWD, "paged": ENV_PAGED}[kind]
 
     def knob(explicit: int | None, env_name: str, fallback_env: str | None):
         if explicit is not None:
@@ -269,15 +290,17 @@ def resolve_block_sizes(
 
     if q_src is None or k_src is None:
         hit = _table_lookup(kind, seq_len, head_dim, dtype, causal, sliding_window)
+        default_q, default_k = _KIND_DEFAULT[kind]
         if q_src is None:
-            bq, q_src = (hit[0], "table") if hit else (DEFAULT_BLOCK, "default")
+            bq, q_src = (hit[0], "table") if hit else (default_q, "default")
         if k_src is None:
-            bk, k_src = (hit[1], "table") if hit else (DEFAULT_BLOCK, "default")
+            bk, k_src = (hit[1], "table") if hit else (default_k, "default")
 
+    align = _KIND_ALIGN[kind]
     for name, value in (("block_q", bq), ("block_k", bk)):
-        if value < _LANES or value % _LANES:
+        if value < align or value % align:
             raise ValueError(
-                f"{kind} {name} must be a positive multiple of {_LANES}, got {value}"
+                f"{kind} {name} must be a positive multiple of {align}, got {value}"
             )
     source = min((q_src, k_src), key=SOURCE_ORDER.index)
     return BlockChoice(
@@ -319,3 +342,22 @@ def record_block_choice(kind: str, choice: BlockChoice) -> None:
     registry.gauge(f"flash/{kind}/block_q").set(choice.block_q)
     registry.gauge(f"flash/{kind}/block_k").set(choice.block_k)
     registry.counter(f"flash/tuning_table_hit/{choice.source}").inc()
+
+
+def resolve_paged_block_size(
+    *,
+    max_model_len: int,
+    head_dim: int,
+    dtype,
+    block_size: int | None = None,
+) -> BlockChoice:
+    """Resolve the serving pool's KV block (page) size — the paged-decode
+    kernel's tile knob (`block_k` of the "paged" kind): explicit config >
+    PAGED_BLOCK_K env > tuning table > 16. Recorded into telemetry like
+    every other kernel tile resolution."""
+    choice = resolve_block_sizes(
+        "paged", seq_len=max_model_len, head_dim=head_dim, dtype=dtype,
+        causal=True, block_k=block_size,
+    )
+    record_block_choice("paged", choice)
+    return choice

@@ -1,0 +1,44 @@
+"""Serving subsystem (docs/serving.md): continuous batching over a paged
+KV cache.
+
+The serving tier ROADMAP item 2 names: a block-pool KV cache with
+per-request block tables (`paged_cache.py` + `models/base.PagedDecodeState`),
+a ragged paged-decode attention path (`ops/paged_attention.py`, Pallas
+kernel in `ops/pallas/paged_attention.py`), a request scheduler with
+admission / chunked-prefill interleaving / eviction (`scheduler.py`), and
+the jitted continuous-batching engine (`engine.py`) — behind the streaming
+`serve` CLI subcommand and `scripts/serve_loadgen.py`.
+
+Scheduler and allocator import eagerly (host-only, no jax); the engine is
+lazy, mirroring `llm_training_tpu.infer`.
+"""
+
+from llm_training_tpu.serve.paged_cache import BlockAllocator, init_paged_pool
+from llm_training_tpu.serve.scheduler import (
+    Scheduler,
+    SchedulerConfig,
+    ServeRequest,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "Scheduler",
+    "SchedulerConfig",
+    "ServeConfig",
+    "ServeRequest",
+    "ServingEngine",
+    "init_paged_pool",
+]
+
+_LAZY = {
+    "ServeConfig": "llm_training_tpu.serve.engine",
+    "ServingEngine": "llm_training_tpu.serve.engine",
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,422 @@
+"""Continuous-batching serving engine (docs/serving.md).
+
+Two jitted programs over the SAME sharded decoder stack the trainer runs,
+both against the paged pool (donated — the cache mutates in place in HBM):
+
+- `prefill_chunk`: ONE request's next prompt chunk (batch 1, static chunk
+  width) written into its own blocks; samples the first new token when the
+  chunk completes the prompt;
+- `decode_step`: one token for EVERY decoding slot (static `max_batch`
+  rows) through the ragged paged-attention path — each row at its own
+  length, no shared append index, no left padding. Idle slots carry the
+  trash-block table and cost one garbage row.
+
+The host loop (`step()`) executes what the `Scheduler` decides: admission
+when free blocks suffice, one prefill chunk interleaved between decode
+steps, eviction/requeue under block pressure, slot recycling on eos /
+max-tokens. Per-request TTFT/TPOT and engine throughput publish as
+`serve/*` gauges (rendered by `report`'s `== Serving ==` section).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from llm_training_tpu.infer.sampling import SamplingConfig, sample_tokens
+from llm_training_tpu.models.base import PagedDecodeState
+from llm_training_tpu.serve.paged_cache import (
+    BlockAllocator,
+    init_paged_pool,
+    pool_bytes,
+    resolve_block_size,
+)
+from llm_training_tpu.serve.scheduler import (
+    Scheduler,
+    SchedulerConfig,
+    ServeRequest,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ServeConfig(BaseModel):
+    """Serving knobs (docs/serving.md#knobs)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    max_batch: int = 4  # decode slots (static decode-program batch)
+    max_model_len: int = 256  # per-request cap: prompt + generation
+    # tokens per KV block; None resolves via ops/pallas/tuning.py
+    # (PAGED_BLOCK_K env > tuning table > 16)
+    block_size: int | None = None
+    # pool capacity in blocks (excl. the trash block); None sizes for
+    # max_batch full-length requests — no block pressure by default
+    num_blocks: int | None = None
+    prefill_chunk: int = 32  # tokens per prefill-chunk program call
+    cache_dtype: str | None = None
+    seed: int = 0
+    eos_token_id: int | None = None
+    sampling: SamplingConfig = SamplingConfig()
+
+    @model_validator(mode="after")
+    def _validate(self) -> "ServeConfig":
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_model_len < 2:
+            raise ValueError(
+                f"max_model_len must be >= 2, got {self.max_model_len}"
+            )
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}"
+            )
+        if self.block_size is not None and self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {self.block_size}")
+        if self.num_blocks is not None and self.num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {self.num_blocks}")
+        return self
+
+
+class ServingEngine:
+    """Drives a restored model under continuous batching. Construction
+    mirrors `InferenceEngine` (model, variables, optional mesh+rules);
+    traffic goes through `submit()` + `step()` (or `run()` for a closed
+    request set)."""
+
+    def __init__(
+        self,
+        model: Any,
+        variables: Any,
+        config: ServeConfig | None = None,
+        mesh: Any | None = None,
+        rules: Any = (),
+    ):
+        from llm_training_tpu.infer.engine import supports_decoding
+
+        if not supports_decoding(model):
+            raise NotImplementedError(
+                f"{type(model).__name__} does not support KV-cache decoding "
+                "(no decode_state in its __call__) — see docs/inference.md"
+            )
+        self.model = model
+        self.variables = variables
+        self.mesh = mesh
+        self.rules = rules
+        self.config = config or ServeConfig()
+
+        model_config = model.config
+        self.block_size = resolve_block_size(
+            model_config, self.config.max_model_len,
+            self.config.block_size, self.config.cache_dtype,
+        )
+        self.pages_per_request = math.ceil(
+            self.config.max_model_len / self.block_size
+        )
+        num_blocks = self.config.num_blocks
+        if num_blocks is None:
+            num_blocks = self.config.max_batch * self.pages_per_request
+        with self._ctx():
+            self._pool_k, self._pool_v = init_paged_pool(
+                model_config, num_blocks + 1, self.block_size,
+                mesh=self.mesh, rules=self.rules,
+                cache_dtype=self.config.cache_dtype,
+            )
+        self.allocator = BlockAllocator(num_blocks + 1)
+        self.scheduler = Scheduler(
+            SchedulerConfig(
+                max_batch=self.config.max_batch,
+                max_model_len=self.config.max_model_len,
+                block_size=self.block_size,
+                prefill_chunk=self.config.prefill_chunk,
+            ),
+            self.allocator,
+        )
+        self._build_programs()
+        self._rng = jax.random.key(self.config.seed)
+        self._call = 0
+        self._t0: float | None = None
+        self.tokens_generated = 0
+        self.peak_running = 0
+
+    # ------------------------------------------------------------ programs
+
+    def _ctx(self):
+        from llm_training_tpu.infer.engine import mesh_context
+
+        return mesh_context(self.mesh, self.rules)
+
+    def _build_programs(self) -> None:
+        model = self.model
+        sampling = self.config.sampling
+        rope_length = self.config.max_model_len
+
+        def prefill_chunk(variables, ids, seg, pos, pool_k, pool_v,
+                          tables, length, last_pos, rng):
+            state = PagedDecodeState(
+                k=pool_k, v=pool_v, block_tables=tables, lengths=length,
+                rope_length=rope_length,
+            )
+            out = model.apply(
+                variables, input_ids=ids, segment_ids=seg,
+                position_ids=pos, decode_state=state,
+            )
+            logits = jax.lax.dynamic_index_in_dim(
+                out.logits[0], last_pos, axis=0, keepdims=False
+            ).astype(jnp.float32)
+            token = sample_tokens(logits[None], rng, sampling)[0]
+            state = out.decode_state
+            return state.k, state.v, token
+
+        def decode_step(variables, tokens, pool_k, pool_v, tables, lengths, rng):
+            state = PagedDecodeState(
+                k=pool_k, v=pool_v, block_tables=tables, lengths=lengths,
+                rope_length=rope_length,
+            )
+            out = model.apply(
+                variables, input_ids=tokens[:, None],
+                position_ids=lengths[:, None], decode_state=state,
+            )
+            logits = out.logits[:, -1].astype(jnp.float32)
+            state = out.decode_state
+            return state.k, state.v, sample_tokens(logits, rng, sampling)
+
+        self._prefill_jit = jax.jit(prefill_chunk, donate_argnums=(4, 5))
+        self._decode_jit = jax.jit(decode_step, donate_argnums=(2, 3))
+
+    def _next_rng(self):
+        self._call += 1
+        return jax.random.fold_in(self._rng, self._call)
+
+    def _table_row(self, request: ServeRequest) -> np.ndarray:
+        row = np.zeros((self.pages_per_request,), np.int32)
+        row[: len(request.blocks)] = request.blocks
+        return row
+
+    # -------------------------------------------------------------- intake
+
+    def submit(
+        self,
+        id: str,
+        prompt: Sequence[int],
+        max_new_tokens: int = 32,
+        priority: int = 0,
+    ) -> list[dict]:
+        """Queue one request; returns immediately-emittable events (a
+        rejection completes synchronously)."""
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        request = ServeRequest(
+            # coerce every token NOW: a non-int prompt (e.g. a JSON string
+            # that slipped through the CLI) must fail at submit — where the
+            # caller's error handling lives — not steps later inside the
+            # decode loop, taking every in-flight request with it
+            id=str(id), prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens), priority=int(priority),
+        )
+        rejected = self.scheduler.submit(request)
+        if rejected is not None:
+            return [self._done_event(rejected)]
+        return []
+
+    # ---------------------------------------------------------------- step
+
+    def step(self) -> list[dict]:
+        """One scheduler round: admissions, at most one prefill chunk, one
+        decode step over every decoding row. Returns the streamed events
+        ({'type': 'token', ...} per new token, {'type': 'done', ...} per
+        completion)."""
+        events: list[dict] = []
+        with self._ctx():
+            before = len(self.scheduler.completed)
+            self.scheduler.admit()
+            # admit() can terminate a head-of-queue request the pool can
+            # NEVER hold (stop_reason='capacity') — that is a completion,
+            # and the protocol owes it a done chunk like any other
+            for request in self.scheduler.completed[before:]:
+                events.append(self._done_event(request))
+            self.peak_running = max(self.peak_running, len(self.scheduler.running))
+            plan = self.scheduler.next_prefill()
+            if plan is not None:
+                events.extend(self._run_prefill(*plan))
+            rows = self.scheduler.decode_rows()
+            if rows:
+                events.extend(self._run_decode(rows))
+        return events
+
+    def _emit_token(self, request: ServeRequest, token: int, events: list[dict]) -> None:
+        now = time.perf_counter()
+        request.generated.append(token)
+        self.tokens_generated += 1
+        if request.first_token_s is None:
+            request.first_token_s = now
+        request.last_token_s = now
+        # an evicted-then-resumed request regenerates nothing (its progress
+        # rode along in the re-prefill), so every append past `emitted` is
+        # genuinely new — emit it
+        while request.emitted < len(request.generated):
+            events.append({
+                "type": "token", "id": request.id,
+                "token": request.generated[request.emitted],
+            })
+            request.emitted += 1
+        eos = self.config.eos_token_id
+        if eos is not None and token == eos:
+            self.scheduler.finish(request, "eos")
+            events.append(self._done_event(request))
+        elif len(request.generated) >= request.max_new_tokens:
+            self.scheduler.finish(request, "max_tokens")
+            events.append(self._done_event(request))
+
+    def _run_prefill(self, request: ServeRequest, chunk: list[int], start: int) -> list[dict]:
+        events: list[dict] = []
+        width = self.config.prefill_chunk
+        ids = np.zeros((1, width), np.int32)
+        seg = np.zeros((1, width), np.int32)
+        ids[0, : len(chunk)] = chunk
+        seg[0, : len(chunk)] = 1
+        pos = np.minimum(
+            start + np.arange(width), self.config.max_model_len - 1
+        ).astype(np.int32)[None, :]
+        tables = self._table_row(request)[None, :]
+        final = start + len(chunk) >= len(request.prefill_tokens)
+        self._pool_k, self._pool_v, token = self._prefill_jit(
+            self.variables, jnp.asarray(ids), jnp.asarray(seg),
+            jnp.asarray(pos), self._pool_k, self._pool_v,
+            jnp.asarray(tables), jnp.asarray([start], jnp.int32),
+            jnp.int32(len(chunk) - 1), self._next_rng(),
+        )
+        request.prefilled += len(chunk)
+        request.cache_len += len(chunk)
+        if final:
+            self._emit_token(request, int(jax.device_get(token)), events)
+        return events
+
+    def _run_decode(self, rows: list[ServeRequest]) -> list[dict]:
+        events: list[dict] = []
+        # grow each row's blocks for this step's write; under pool pressure
+        # this evicts lowest-priority requests (possibly out of `rows`)
+        survivors = []
+        for request in rows:
+            if request.slot is not None and self.scheduler.ensure_decode_blocks(request):
+                survivors.append(request)
+        # a LATER row's block-pressure eviction can take an EARLIER
+        # survivor (lower priority, mid-page so its own check passed) —
+        # its slot is gone and its blocks may already belong to the
+        # evictor, so it must not decode this step
+        survivors = [r for r in survivors if r.slot is not None]
+        if not survivors:
+            return events
+        batch = self.config.max_batch
+        tokens = np.zeros((batch,), np.int32)
+        lengths = np.zeros((batch,), np.int32)
+        tables = np.zeros((batch, self.pages_per_request), np.int32)
+        for request in survivors:
+            tokens[request.slot] = request.generated[-1]
+            lengths[request.slot] = request.cache_len
+            tables[request.slot] = self._table_row(request)
+        self._pool_k, self._pool_v, out = self._decode_jit(
+            self.variables, jnp.asarray(tokens), self._pool_k, self._pool_v,
+            jnp.asarray(tables), jnp.asarray(lengths), self._next_rng(),
+        )
+        host = np.asarray(jax.device_get(out))
+        for request in survivors:
+            request.cache_len += 1
+            self._emit_token(request, int(host[request.slot]), events)
+        return events
+
+    def _done_event(self, request: ServeRequest) -> dict:
+        event = {
+            "type": "done", "id": request.id,
+            "stop_reason": request.stop_reason,
+            "tokens": list(request.generated),
+            "n_tokens": len(request.generated),
+            "evictions": request.evictions,
+        }
+        if request.first_token_s is not None:
+            event["ttft_ms"] = round(
+                1000.0 * (request.first_token_s - request.arrival_s), 3
+            )
+        if request.last_token_s is not None and len(request.generated) > 1:
+            event["tpot_ms"] = round(
+                1000.0 * (request.last_token_s - request.first_token_s)
+                / (len(request.generated) - 1), 3,
+            )
+        return event
+
+    # ----------------------------------------------------------------- run
+
+    def run(self, requests: Sequence[dict], max_steps: int = 100_000) -> list[dict]:
+        """Submit a closed request set and step until drained. Each request
+        dict: {'id', 'prompt', 'max_new_tokens'?, 'priority'?}. Returns all
+        events in emission order."""
+        events: list[dict] = []
+        for request in requests:
+            events.extend(self.submit(**request))
+        for _ in range(max_steps):
+            if self.scheduler.idle:
+                break
+            events.extend(self.step())
+        else:
+            raise RuntimeError(f"serve loop not drained after {max_steps} steps")
+        return events
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict[str, float]:
+        """Engine/latency summary, published as `serve/*` gauges (merged
+        into telemetry.jsonl by the CLI; `report` renders `== Serving ==`)."""
+        from llm_training_tpu.telemetry import get_registry
+
+        completed = [
+            r for r in self.scheduler.completed
+            if r.stop_reason in ("eos", "max_tokens")
+        ]
+        ttft = [
+            1000.0 * (r.first_token_s - r.arrival_s)
+            for r in completed if r.first_token_s is not None
+        ]
+        tpot = [
+            1000.0 * (r.last_token_s - r.first_token_s) / (len(r.generated) - 1)
+            for r in completed
+            if r.last_token_s is not None and len(r.generated) > 1
+        ]
+        wall = (time.perf_counter() - self._t0) if self._t0 is not None else 0.0
+        n_chips = max(1, jax.device_count())
+        tps = self.tokens_generated / wall if wall > 0 else 0.0
+        stats = {
+            "serve/requests_completed": float(len(completed)),
+            "serve/requests_failed": float(
+                len(self.scheduler.completed) - len(completed)
+            ),
+            "serve/requests_evicted": float(self.scheduler.evictions),
+            "serve/tokens_generated": float(self.tokens_generated),
+            "serve/tokens_per_sec": tps,
+            "serve/tokens_per_sec_per_chip": tps / n_chips,
+            "serve/peak_running": float(self.peak_running),
+            "decode/cache_bytes": float(pool_bytes(self._pool_k, self._pool_v)),
+            "decode/cache_blocks_total": float(self.allocator.num_blocks - 1),
+            "decode/cache_blocks_in_use": float(self.allocator.blocks_in_use),
+            "decode/cache_peak_blocks_in_use": float(self.allocator.peak_in_use),
+        }
+        if ttft:
+            stats["serve/ttft_p50_ms"] = float(np.percentile(ttft, 50))
+            stats["serve/ttft_p99_ms"] = float(np.percentile(ttft, 99))
+        if tpot:
+            stats["serve/tpot_p50_ms"] = float(np.percentile(tpot, 50))
+            stats["serve/tpot_p99_ms"] = float(np.percentile(tpot, 99))
+        registry = get_registry()
+        for key, value in stats.items():
+            registry.gauge(key).set(value)
+        logger.info(
+            "serve: %d completed (%d evictions) | %.1f tokens/s (%.1f/chip)",
+            len(completed), self.scheduler.evictions, tps, stats["serve/tokens_per_sec_per_chip"],
+        )
+        return stats

@@ -1,0 +1,158 @@
+"""Paged KV-cache pool + host-side block allocator (docs/serving.md).
+
+The pool is the device half: `[num_layers, num_blocks, block_size,
+kv_heads, head_dim]` k/v buffers built from the SAME training rule table
+`infer/cache.py` uses (kv heads shard over 'tensor'; the block axis stays
+replicated — each data-parallel serving replica owns its whole pool).
+Physical block 0 is a reserved TRASH block: idle decode slots and padded
+chunk positions write there, so a garbage row can never touch a live
+request's cache.
+
+The `BlockAllocator` is the host half: a free list handing fixed-size
+blocks to requests and taking them back on completion/eviction, publishing
+pool occupancy as `decode/cache_blocks_total` / `decode/cache_blocks_in_use`
+/ `decode/cache_peak_blocks_in_use` gauges so telemetry.jsonl and `report`
+show block pressure (and the serve-smoke gate can assert leak-freedom).
+
+The block size is the paged-decode kernel's tile knob and resolves through
+`ops/pallas/tuning.py` (config > PAGED_BLOCK_K env > tuning table > 16)
+when the serve config leaves it unset.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+# jax — and everything that drags it in: the infer.cache helpers AND the
+# `llm_training_tpu.ops` package (whose __init__ loads every kernel) —
+# loads lazily inside the pool constructors so the allocator stays
+# importable from jax-free host processes (loadgen / bench parents), the
+# package docstring's contract
+if TYPE_CHECKING:
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+# pool layout: [num_layers, num_blocks, block_size, num_kv_heads, head_dim]
+POOL_LOGICAL_AXES = ("layers", None, None, "kv_heads", None)
+
+TRASH_BLOCK = 0  # physical block 0 is never allocated
+
+
+def resolve_block_size(
+    model_config, max_model_len: int, block_size: int | None = None,
+    cache_dtype: str | None = None,
+) -> int:
+    """The pool's tokens-per-block, via the tuning layer (kind='paged')."""
+    from llm_training_tpu.infer.cache import cache_dims, resolve_cache_dtype
+    from llm_training_tpu.ops.pallas.tuning import resolve_paged_block_size
+
+    _, _, head_dim = cache_dims(model_config)
+    choice = resolve_paged_block_size(
+        max_model_len=max_model_len, head_dim=head_dim,
+        dtype=resolve_cache_dtype(model_config, cache_dtype),
+        block_size=block_size,
+    )
+    return choice.block_k
+
+
+def init_paged_pool(
+    model_config,
+    num_blocks: int,
+    block_size: int,
+    mesh: Mesh | None = None,
+    rules=None,
+    cache_dtype: str | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fresh all-zeros (k, v) pool, created ALREADY sharded under a mesh
+    (kv heads over 'tensor', like the dense cache). Publishes the pool
+    footprint as the `decode/cache_bytes` gauge."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from llm_training_tpu.infer.cache import (
+        _divisible_spec,
+        cache_dims,
+        resolve_cache_dtype,
+    )
+
+    num_layers, kv_heads, head_dim = cache_dims(model_config)
+    dtype = resolve_cache_dtype(model_config, cache_dtype)
+    shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+
+    def build():
+        return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+    if mesh is None:
+        k, v = build()
+    else:
+        spec = NamedSharding(
+            mesh, _divisible_spec(shape, POOL_LOGICAL_AXES, mesh, rules or ())
+        )
+        k, v = jax.jit(build, out_shardings=(spec, spec))()
+    _publish_pool_gauges(k, v, num_blocks)
+    return k, v
+
+
+def pool_bytes(k: jnp.ndarray, v: jnp.ndarray) -> int:
+    return sum(leaf.size * leaf.dtype.itemsize for leaf in (k, v))
+
+
+def _publish_pool_gauges(k, v, num_blocks: int) -> None:
+    from llm_training_tpu.telemetry import get_registry
+
+    registry = get_registry()
+    registry.gauge("decode/cache_bytes").set(pool_bytes(k, v))
+    registry.gauge("decode/cache_blocks_total").set(num_blocks - 1)  # minus trash
+
+
+class BlockAllocator:
+    """Host-side free list over the pool's physical blocks (block 0
+    reserved as trash). All-or-nothing `alloc`, idempotence-free `free`
+    (double-free is a bug and raises), occupancy gauges on every change."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (1 usable + trash), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, TRASH_BLOCK, -1))  # pop() -> low ids first
+        self._in_use: set[int] = set()
+        self.peak_in_use = 0
+        self._publish()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return len(self._in_use)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n blocks, or None (nothing allocated) when fewer are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._in_use.update(blocks)
+        self.peak_in_use = max(self.peak_in_use, len(self._in_use))
+        self._publish()
+        return blocks
+
+    def free(self, blocks: list[int]) -> None:
+        for block in blocks:
+            if block not in self._in_use:
+                raise ValueError(f"free of unallocated block {block}")
+            self._in_use.remove(block)
+            self._free.append(block)
+        self._publish()
+
+    def _publish(self) -> None:
+        from llm_training_tpu.telemetry import get_registry
+
+        registry = get_registry()
+        registry.gauge("decode/cache_blocks_in_use").set(len(self._in_use))
+        registry.gauge("decode/cache_peak_blocks_in_use").set(self.peak_in_use)

@@ -1,0 +1,214 @@
+"""Continuous-batching request scheduler (docs/serving.md). Pure host
+logic — no jax — so policy is unit-testable without a model.
+
+Per engine step the scheduler decides three things:
+
+- **admission**: the head of the waiting queue joins when a decode slot is
+  free AND the pool has blocks for its whole (re)prefill plus one decode
+  block of headroom — all-or-nothing, so a half-admitted request can never
+  deadlock the pool;
+- **chunked prefill**: at most ONE fixed-width prompt chunk per step, so a
+  long prompt streams into its blocks across steps while every in-flight
+  decode row keeps producing a token per step (the interleave that keeps
+  TTFT of short requests flat under long-prompt traffic);
+- **eviction**: when a decode row needs its next block and the pool is
+  dry, the LOWEST-priority running request (ties: youngest arrival) is
+  evicted — blocks freed, request requeued at the FRONT of the waiting
+  queue with its progress folded into the prompt (`prompt + generated`),
+  so on re-admission it re-prefills and CONTINUES; greedy decode makes the
+  continuation token-identical to an uninterrupted run.
+
+Slots recycle on eos / max-tokens: blocks return to the pool and the row
+becomes admissible immediately (the "slot stranding" the dense
+`InferenceEngine` batch could not avoid).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ServeRequest:
+    """One generation request plus its scheduler-owned runtime state."""
+
+    id: str
+    prompt: list[int]
+    max_new_tokens: int
+    priority: int = 0  # higher = more important (evicted last)
+    arrival_s: float = field(default_factory=time.perf_counter)
+
+    # runtime (scheduler-owned)
+    generated: list[int] = field(default_factory=list)
+    emitted: int = 0  # tokens already streamed (an evict/resume never re-emits)
+    slot: int | None = None
+    blocks: list[int] = field(default_factory=list)
+    prefill_tokens: list[int] = field(default_factory=list)  # this residency's prefill
+    prefilled: int = 0  # prefill_tokens positions already written
+    cache_len: int = 0  # tokens whose KV is in the pool
+    first_token_s: float | None = None
+    last_token_s: float | None = None
+    evictions: int = 0
+    stop_reason: str | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.stop_reason is not None
+
+    @property
+    def decoding(self) -> bool:
+        """Prefill complete for the current residency — the row produces
+        one token per decode step."""
+        return (
+            self.slot is not None
+            and not self.done
+            and self.prefilled >= len(self.prefill_tokens)
+        )
+
+
+@dataclass
+class SchedulerConfig:
+    max_batch: int  # decode slots (the decode program's static batch)
+    max_model_len: int  # per-request cap: len(prompt) + max_new_tokens
+    block_size: int
+    prefill_chunk: int  # tokens per prefill-chunk program call
+
+
+class Scheduler:
+    """Owns the waiting queue, the slot map, and the block accounting
+    policy; the `ServingEngine` executes what `admit`/`next_prefill`/
+    `ensure_decode_blocks` decide."""
+
+    def __init__(self, config: SchedulerConfig, allocator):
+        if config.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        self.config = config
+        self.allocator = allocator
+        self.waiting: deque[ServeRequest] = deque()
+        self.running: dict[int, ServeRequest] = {}  # slot -> request
+        self._free_slots = list(range(config.max_batch - 1, -1, -1))
+        self.completed: list[ServeRequest] = []
+        self.evictions = 0
+
+    # ------------------------------------------------------------ intake
+
+    def submit(self, request: ServeRequest) -> ServeRequest | None:
+        """Queue a request; returns it REJECTED (stop_reason='rejected')
+        instead when it can never fit max_model_len."""
+        total = len(request.prompt) + request.max_new_tokens
+        if len(request.prompt) == 0 or request.max_new_tokens < 1:
+            request.stop_reason = "rejected"
+        elif total > self.config.max_model_len:
+            request.stop_reason = "rejected"
+        if request.done:
+            self.completed.append(request)
+            return request
+        self.waiting.append(request)
+        return None
+
+    @property
+    def idle(self) -> bool:
+        return not self.waiting and not self.running
+
+    def _blocks_for(self, tokens: int) -> int:
+        return math.ceil(tokens / self.config.block_size)
+
+    # --------------------------------------------------------- admission
+
+    def admit(self) -> list[ServeRequest]:
+        """Admit waiting requests while a slot is free and the pool covers
+        each one's (re)prefill + one decode-step write. A head-of-queue
+        request the pool can NEVER satisfy (even with everything else
+        drained) fails with stop_reason='capacity' rather than starving
+        the queue behind it."""
+        admitted = []
+        while self.waiting and self._free_slots:
+            request = self.waiting[0]
+            resident = request.prompt + request.generated
+            needed = self._blocks_for(len(resident) + 1)
+            blocks = self.allocator.alloc(needed)
+            if blocks is None:
+                if not self.running and not admitted:
+                    # nothing left to drain — this request cannot ever fit
+                    self.waiting.popleft()
+                    request.stop_reason = "capacity"
+                    self.completed.append(request)
+                    continue
+                break
+            self.waiting.popleft()
+            request.slot = self._free_slots.pop()
+            request.blocks = blocks
+            request.prefill_tokens = resident
+            request.prefilled = 0
+            request.cache_len = 0
+            self.running[request.slot] = request
+            admitted.append(request)
+        return admitted
+
+    # ----------------------------------------------------------- prefill
+
+    def next_prefill(self) -> tuple[ServeRequest, list[int], int] | None:
+        """(request, chunk_tokens, chunk_start) for the oldest running
+        request with prompt left to prefill, or None."""
+        pending = [
+            r for r in self.running.values()
+            if r.prefilled < len(r.prefill_tokens)
+        ]
+        if not pending:
+            return None
+        request = min(pending, key=lambda r: r.arrival_s)
+        start = request.prefilled
+        chunk = request.prefill_tokens[start:start + self.config.prefill_chunk]
+        return request, chunk, start
+
+    # ------------------------------------------------------------ decode
+
+    def decode_rows(self) -> list[ServeRequest]:
+        return [r for r in self.running.values() if r.decoding]
+
+    def ensure_decode_blocks(self, request: ServeRequest) -> bool:
+        """Guarantee the row's next token has a cache slot, evicting under
+        block pressure. False when the request itself got evicted."""
+        while self._blocks_for(request.cache_len + 1) > len(request.blocks):
+            grown = self.allocator.alloc(1)
+            if grown is not None:
+                request.blocks.extend(grown)
+                return True
+            victim = self._eviction_victim()
+            self.evict(victim)
+            if victim is request:
+                return False
+        return True
+
+    def _eviction_victim(self) -> ServeRequest:
+        return min(
+            self.running.values(), key=lambda r: (r.priority, -r.arrival_s)
+        )
+
+    def evict(self, request: ServeRequest) -> None:
+        """Free the request's residency and requeue it (front) with its
+        progress folded in; already-streamed tokens are never re-emitted."""
+        self._release(request)
+        request.evictions += 1
+        self.evictions += 1
+        request.prefill_tokens = []
+        request.prefilled = 0
+        request.cache_len = 0
+        self.waiting.appendleft(request)
+
+    # -------------------------------------------------------- completion
+
+    def finish(self, request: ServeRequest, stop_reason: str) -> None:
+        self._release(request)
+        request.stop_reason = stop_reason
+        self.completed.append(request)
+
+    def _release(self, request: ServeRequest) -> None:
+        del self.running[request.slot]
+        self._free_slots.append(request.slot)
+        self.allocator.free(request.blocks)
+        request.slot = None
+        request.blocks = []

@@ -181,6 +181,61 @@ def _decode_section(telemetry: dict) -> list[str]:
     return ["", "== Inference =="] + lines
 
 
+def _serving_section(telemetry: dict) -> list[str]:
+    """Serving telemetry (`serve/*` from the `serve` CLI / loadgen —
+    docs/serving.md#telemetry): throughput, latency percentiles, and
+    paged-pool pressure. Rendered only when a serve invocation merged its
+    gauges into telemetry.jsonl."""
+    def num(key):
+        try:
+            return float(telemetry[key])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    completed = num("serve/requests_completed")
+    tps = num("serve/tokens_per_sec")
+    if completed is None and tps is None:
+        return []
+    lines = ["", "== Serving =="]
+    line = f"requests: {int(completed or 0)} completed"
+    failed = num("serve/requests_failed")
+    if failed:
+        line += f", {int(failed)} failed"
+    evicted = num("serve/requests_evicted")
+    if evicted:
+        line += f", {int(evicted)} evictions"
+    peak = num("serve/peak_running")
+    if peak is not None:
+        line += f" (peak concurrency {int(peak)})"
+    lines.append(line)
+    if tps is not None:
+        line = f"throughput: {tps:,.1f} tokens/s"
+        per_chip = num("serve/tokens_per_sec_per_chip")
+        if per_chip is not None:
+            line += f" ({per_chip:,.1f}/chip)"
+        tokens = num("serve/tokens_generated")
+        if tokens is not None:
+            line += f" over {int(tokens):,} tokens"
+        lines.append(line)
+    for stat, label in (("ttft", "ttft"), ("tpot", "tpot")):
+        p50, p99 = num(f"serve/{stat}_p50_ms"), num(f"serve/{stat}_p99_ms")
+        if p50 is not None:
+            line = f"{label}: p50 {p50:,.1f} ms"
+            if p99 is not None:
+                line += f"  p99 {p99:,.1f} ms"
+            lines.append(line)
+    total = num("decode/cache_blocks_total")
+    peak_blocks = num("decode/cache_peak_blocks_in_use")
+    if total:
+        line = f"kv pool: {int(total)} blocks, peak {int(peak_blocks or 0)} in use"
+        line += f" ({100.0 * (peak_blocks or 0) / total:.0f}%)"
+        leaked = num("decode/cache_blocks_in_use")
+        if leaked:
+            line += f" — {int(leaked)} still held at exit (leak?)"
+        lines.append(line)
+    return lines
+
+
 def _newest_bench_record(dirs: list[Path]) -> tuple[dict, str] | None:
     """The newest bench record reachable from `dirs` (first match wins the
     directory tie; within a directory, newest mtime then name — BENCH_rNN
@@ -416,6 +471,7 @@ def render_report(run_dir: str | Path, bench_dir: str | Path | None = None) -> s
         Path(bench_dir) if bench_dir else None, run_dir, Path.cwd(),
     ])))
     lines.extend(_decode_section(telemetry))
+    lines.extend(_serving_section(telemetry))
     lines.extend(_recovery_section(telemetry))
     lines.extend(_resilience_section(telemetry))
     return "\n".join(lines)

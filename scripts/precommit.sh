@@ -53,6 +53,26 @@ grep -q "== Inference ==" "${SMOKE_ROOT}/report_infer.log"
 grep -q "decode_tokens_per_sec" "${SMOKE_ROOT}/report_infer.log"
 grep -q "perplexity" "${SMOKE_ROOT}/report_infer.log"
 
+# serving gate (docs/serving.md): synthetic overlapping traffic through the
+# real `serve` CLI + JSONL protocol. The loadgen itself exits nonzero when
+# any request fails to terminate, a done arrives with no streamed chunks,
+# the pool leaks blocks at exit, or arrivals never overlapped
+# (serve/peak_running < 2 — i.e. continuous batching demonstrably admitted
+# a request while another was mid-decode); then the merged serve/* gauges
+# must render as report's == Serving == section
+echo "== precommit: serve smoke (continuous-batching loadgen -> report) =="
+JAX_PLATFORMS=cpu python scripts/serve_loadgen.py \
+    --config config/examples/smoke/cpu-smoke.yaml \
+    --requests 4 --max-new-tokens 16 \
+    --out "${SMOKE_ROOT}/serve_loadgen.json" \
+    "run_root=${SMOKE_ROOT}" --max-batch 2 --max-model-len 64 \
+    --prefill-chunk 4 --eos-token-id -1 \
+    | tee "${SMOKE_ROOT}/serve_smoke.log"
+JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
+    | tee "${SMOKE_ROOT}/report_serve.log"
+grep -q "== Serving ==" "${SMOKE_ROOT}/report_serve.log"
+grep -q "ttft" "${SMOKE_ROOT}/report_serve.log"
+
 # NaN-provenance + auto-recovery gates: a forced non-finite micro-fit must
 # name the offending layer path in the NonFiniteLossError AND write an
 # anomaly-<step>.json dump; then a chaos-injected NaN with

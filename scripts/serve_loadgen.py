@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Synthetic-traffic load driver for the `serve` CLI (docs/serving.md).
+
+Spawns `llm-training-tpu serve` as a child process and drives the real
+JSONL stdin/stdout protocol with OVERLAPPING arrivals: the first request
+goes in immediately; every later request is held until the first streamed
+token chunk proves decode is in flight, then submitted with a small gap —
+so continuous batching (admission mid-decode) is what the run exercises,
+not a closed batch.
+
+Client-side latency is measured per request from its submit time: TTFT to
+the first token chunk, TPOT across subsequent chunks. The summary merges
+the engine's own `serve/*` stats record (throughput, pool pressure) with
+the client percentiles, prints one JSON object, and exits nonzero when
+
+- any request fails to terminate (no `done` chunk),
+- a `done` arrives with no preceding token chunks for that id,
+- the engine leaks pool blocks (`decode/cache_blocks_in_use` != 0), or
+- arrivals never overlapped (`serve/peak_running` < 2).
+
+The child merges its gauges into the run dir's telemetry.jsonl as usual,
+so a following `report` renders `== Serving ==` — the precommit
+serve-smoke gate asserts exactly that chain.
+
+Usage:
+    python scripts/serve_loadgen.py --config <yaml> [overrides...] \
+        [--requests 4] [--max-new-tokens 8] [--arrival-gap-s 0.05] \
+        [--out summary.json] [-- <extra serve args>]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import subprocess
+import sys
+import threading
+import time
+
+
+def percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile; avoids a numpy import in this jax-free
+    parent (the child owns the devices)."""
+    ordered = sorted(values)
+    rank = max(0, min(len(ordered) - 1, round(q / 100.0 * (len(ordered) - 1))))
+    return ordered[rank]
+
+
+def build_requests(args) -> list[dict]:
+    rng = random.Random(args.seed)
+    requests = []
+    for n in range(args.requests):
+        length = rng.randint(args.min_prompt, args.max_prompt)
+        requests.append({
+            "id": f"req-{n}",
+            "prompt": [rng.randint(3, args.vocab - 1) for _ in range(length)],
+            "max_new_tokens": args.max_new_tokens,
+        })
+    return requests
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--config", required=True)
+    parser.add_argument("--requests", type=int, default=4)
+    parser.add_argument("--max-new-tokens", type=int, default=8)
+    parser.add_argument("--min-prompt", type=int, default=2)
+    parser.add_argument("--max-prompt", type=int, default=6)
+    parser.add_argument("--vocab", type=int, default=64, help="synthetic token id bound")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--arrival-gap-s", type=float, default=0.05,
+        help="gap between follow-up arrivals (all after the first token)",
+    )
+    parser.add_argument(
+        "--idle-timeout-s", type=float, default=600.0,
+        help="kill the child when no stdout line lands for this long",
+    )
+    parser.add_argument("--out", default=None, help="also write the summary JSON here")
+    parser.add_argument(
+        "serve_args", nargs="*",
+        help="config overrides and extra `serve` flags (e.g. run_root=... "
+        "--max-batch 2)",
+    )
+    # unknown flags (e.g. --max-batch) pass through to the serve child
+    args, passthrough = parser.parse_known_args()
+    args.serve_args += passthrough
+
+    requests = build_requests(args)
+    argv = [
+        sys.executable, "-m", "llm_training_tpu", "serve",
+        "--config", args.config, *args.serve_args,
+    ]
+    child = subprocess.Popen(
+        argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, bufsize=1
+    )
+
+    submit_s: dict[str, float] = {}
+    first_token_s: dict[str, float] = {}
+    last_token_s: dict[str, float] = {}
+    chunks: dict[str, int] = {}
+    done: dict[str, dict] = {}
+    stats: dict[str, float] = {}
+    errors: list[str] = []
+    first_token_seen = threading.Event()
+
+    def send(request: dict) -> None:
+        submit_s[request["id"]] = time.perf_counter()
+        child.stdin.write(json.dumps(request) + "\n")
+        child.stdin.flush()
+
+    def feed() -> None:
+        try:
+            send(requests[0])
+            # hold the rest until decode is demonstrably in flight, so
+            # every later arrival exercises mid-stream admission; the first
+            # follow-up goes immediately (a warm decode step is ~ms — any
+            # fixed gap risks outliving the whole first generation)
+            first_token_seen.wait()
+            for n, request in enumerate(requests[1:]):
+                if n:
+                    time.sleep(args.arrival_gap_s)
+                send(request)
+        except BrokenPipeError:
+            pass  # child died; the reader loop reports it
+        finally:
+            try:
+                child.stdin.close()
+            except OSError:
+                pass
+
+    feeder = threading.Thread(target=feed, daemon=True)
+    feeder.start()
+
+    timer = threading.Timer(args.idle_timeout_s, child.kill)
+    timer.start()
+    try:
+        for line in child.stdout:
+            timer.cancel()
+            timer = threading.Timer(args.idle_timeout_s, child.kill)
+            timer.start()
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # interleaved logging, not a protocol chunk
+            now = time.perf_counter()
+            kind = event.get("type")
+            if kind == "token":
+                rid = event["id"]
+                chunks[rid] = chunks.get(rid, 0) + 1
+                first_token_s.setdefault(rid, now)
+                last_token_s[rid] = now
+                first_token_seen.set()
+            elif kind == "done":
+                done[event["id"]] = event
+                # a token-less termination (rejected / capacity) must also
+                # unblock the feeder, or a first request that never streams
+                # wedges the whole run until the idle timeout
+                first_token_seen.set()
+            elif kind == "stats":
+                stats = event["stats"]
+            elif kind == "error":
+                errors.append(event.get("error", "unknown"))
+                first_token_seen.set()
+    finally:
+        timer.cancel()
+        first_token_seen.set()  # unblock the feeder if the child died early
+    rc = child.wait()
+
+    for request in requests:
+        rid = request["id"]
+        if rid not in done:
+            errors.append(f"{rid}: no done chunk (rc {rc})")
+        elif done[rid].get("stop_reason") in ("eos", "max_tokens") and not chunks.get(rid):
+            errors.append(f"{rid}: done without any streamed token chunks")
+    leaked = stats.get("decode/cache_blocks_in_use")
+    if leaked is None:
+        errors.append("no stats record from the child")
+    elif leaked:
+        errors.append(f"pool leak: {int(leaked)} blocks still in use at exit")
+    peak = stats.get("serve/peak_running", 0)
+    if len(requests) > 1 and peak < 2:
+        errors.append(
+            f"arrivals never overlapped (peak_running {peak}) — raise "
+            "--max-new-tokens or check --max-batch > 1"
+        )
+
+    ttft = [
+        1000.0 * (first_token_s[r] - submit_s[r]) for r in first_token_s
+    ]
+    tpot = [
+        1000.0 * (last_token_s[r] - first_token_s[r]) / (chunks[r] - 1)
+        for r in first_token_s if chunks.get(r, 0) > 1
+    ]
+    summary = {
+        "requests": len(requests),
+        "completed": sum(
+            1 for d in done.values() if d.get("stop_reason") in ("eos", "max_tokens")
+        ),
+        "streamed_chunks": sum(chunks.values()),
+        "errors": errors,
+        "engine": stats,
+    }
+    if ttft:
+        summary["client_ttft_p50_ms"] = round(percentile(ttft, 50), 3)
+        summary["client_ttft_p99_ms"] = round(percentile(ttft, 99), 3)
+    if tpot:
+        summary["client_tpot_p50_ms"] = round(percentile(tpot, 50), 3)
+        summary["client_tpot_p99_ms"] = round(percentile(tpot, 99), 3)
+    if "serve/tokens_per_sec_per_chip" in stats:
+        summary["tokens_per_sec_per_chip"] = stats["serve/tokens_per_sec_per_chip"]
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

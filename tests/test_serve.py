@@ -1,0 +1,538 @@
+"""Serving subsystem tests (docs/serving.md): paged-decode parity against
+the dense `DecodeState` path and the full-forward oracle, continuous-
+batching behaviours (mid-stream admission, eviction-then-resume, slot
+recycling), the block allocator / scheduler policy units, the ragged
+paged-decode kernel vs the XLA gather fallback, and the `== Serving ==`
+report section."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_training_tpu.infer import GenerateConfig, InferenceEngine
+from llm_training_tpu.models import Gemma, GemmaConfig, Llama, LlamaConfig
+from llm_training_tpu.serve import (
+    BlockAllocator,
+    Scheduler,
+    SchedulerConfig,
+    ServeConfig,
+    ServeRequest,
+    ServingEngine,
+)
+from llm_training_tpu.serve.paged_cache import TRASH_BLOCK, resolve_block_size
+from llm_training_tpu.telemetry import get_registry
+
+TINY = dict(
+    vocab_size=64, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    max_position_embeddings=64, attention_impl="xla",
+    compute_dtype="float32", param_dtype="float32",
+)
+
+
+def _init(model, seed=0):
+    return model.init(jax.random.key(seed), np.zeros((1, 4), np.int32))
+
+
+_ORACLE_WIDTH = 32  # static pad width: covers every prompt + n in this file
+_oracle_cache: dict[int, tuple] = {}  # id(model) -> (model, jitted forward)
+
+
+def _full_forward_greedy(model, variables, prompt, n):
+    """The oracle (test_infer.py): n argmax tokens from n full forwards —
+    jitted ONCE per model at a padded static width (length traced, pads
+    masked via segment ids) so each step is a cheap cached call, not an
+    eager CPU forward."""
+    entry = _oracle_cache.get(id(model))
+    if entry is None or entry[0] is not model:
+
+        @jax.jit
+        def fwd(variables, ids, length):
+            seg = (jnp.arange(ids.shape[1]) < length).astype(jnp.int32)[None]
+            out = model.apply(variables, input_ids=ids, segment_ids=seg)
+            logits = jax.lax.dynamic_index_in_dim(
+                out.logits[0], length - 1, axis=0, keepdims=False
+            )
+            return jnp.argmax(logits)
+
+        entry = (model, fwd)  # strong model ref: id() can't be recycled
+        _oracle_cache[id(model)] = entry
+    fwd = entry[1]
+    seq = list(prompt)
+    for _ in range(n):
+        ids = np.zeros((1, _ORACLE_WIDTH), np.int32)
+        ids[0, : len(seq)] = seq
+        seq.append(int(fwd(variables, jnp.asarray(ids), jnp.int32(len(seq)))))
+    return seq[len(prompt):]
+
+
+def _serve_all(model, variables, prompts, n, **overrides):
+    """Drain `prompts` through a ServingEngine; -> ({id: tokens}, engine)."""
+    config = ServeConfig(**{
+        "max_batch": 2, "max_model_len": 48, "block_size": 8,
+        "prefill_chunk": 4, "eos_token_id": None, **overrides,
+    })
+    engine = ServingEngine(model, variables, config)
+    events = engine.run([
+        {"id": str(row), "prompt": list(p), "max_new_tokens": n}
+        for row, p in enumerate(prompts)
+    ])
+    done = {e["id"]: e for e in events if e["type"] == "done"}
+    assert engine.allocator.blocks_in_use == 0, "pool leak after drain"
+    return done, engine
+
+
+# ------------------------------------------------------- allocator unit
+
+
+def test_allocator_alloc_free_roundtrip():
+    allocator = BlockAllocator(num_blocks=5)  # 4 usable + trash
+    assert allocator.free_blocks == 4
+    blocks = allocator.alloc(3)
+    assert len(blocks) == 3 and TRASH_BLOCK not in blocks
+    # all-or-nothing: asking past the remaining 1 allocates NOTHING
+    assert allocator.alloc(2) is None
+    assert allocator.free_blocks == 1
+    allocator.free(blocks)
+    assert allocator.free_blocks == 4 and allocator.blocks_in_use == 0
+    assert allocator.peak_in_use == 3
+    with pytest.raises(ValueError):
+        allocator.free([blocks[0]])  # double free is a bug, not a no-op
+    with pytest.raises(ValueError):
+        BlockAllocator(num_blocks=1)  # trash block only — unusable
+
+
+def test_allocator_occupancy_gauges():
+    allocator = BlockAllocator(num_blocks=4)
+    blocks = allocator.alloc(2)
+    registry = get_registry()
+    assert registry.gauge("decode/cache_blocks_in_use").value == 2
+    allocator.free(blocks)
+    assert registry.gauge("decode/cache_blocks_in_use").value == 0
+    assert registry.gauge("decode/cache_peak_blocks_in_use").value == 2
+
+
+# ------------------------------------------------------- scheduler unit
+
+
+def _scheduler(max_batch=2, blocks=8, block_size=8, max_len=32, chunk=4):
+    return Scheduler(
+        SchedulerConfig(
+            max_batch=max_batch, max_model_len=max_len,
+            block_size=block_size, prefill_chunk=chunk,
+        ),
+        BlockAllocator(blocks + 1),
+    )
+
+
+def test_scheduler_rejects_impossible_requests():
+    scheduler = _scheduler(max_len=16)
+    over = ServeRequest(id="over", prompt=[1] * 10, max_new_tokens=10)
+    assert scheduler.submit(over) is over and over.stop_reason == "rejected"
+    empty = ServeRequest(id="empty", prompt=[], max_new_tokens=4)
+    assert scheduler.submit(empty) is empty
+    ok = ServeRequest(id="ok", prompt=[1, 2], max_new_tokens=4)
+    assert scheduler.submit(ok) is None and scheduler.waiting[0] is ok
+
+
+def test_scheduler_admission_is_all_or_nothing():
+    scheduler = _scheduler(blocks=2, max_len=32)
+    long = ServeRequest(id="long", prompt=[1] * 20, max_new_tokens=4)
+    short = ServeRequest(id="short", prompt=[1, 2], max_new_tokens=4)
+    scheduler.submit(long)
+    scheduler.submit(short)
+    # head of queue needs ceil(21/8)=3 blocks, pool holds 2, nothing is
+    # running to drain -> head fails with 'capacity' instead of starving
+    # the queue; the short request behind it admits normally
+    admitted = scheduler.admit()
+    assert long.stop_reason == "capacity"
+    assert admitted == [short] and short.slot is not None
+    assert scheduler.allocator.blocks_in_use == 1
+
+
+def test_scheduler_chunked_prefill_is_oldest_first():
+    scheduler = _scheduler(chunk=4)
+    first = ServeRequest(id="first", prompt=[1] * 6, max_new_tokens=2, arrival_s=1.0)
+    second = ServeRequest(id="second", prompt=[2] * 3, max_new_tokens=2, arrival_s=2.0)
+    scheduler.submit(first)
+    scheduler.submit(second)
+    scheduler.admit()
+    request, chunk, start = scheduler.next_prefill()
+    assert request is first and chunk == [1, 1, 1, 1] and start == 0
+    request.prefilled += len(chunk)
+    request, chunk, start = scheduler.next_prefill()
+    assert request is first and chunk == [1, 1] and start == 4
+    request.prefilled += len(chunk)
+    request.cache_len = 6
+    assert first.decoding
+    request, chunk, start = scheduler.next_prefill()
+    assert request is second
+
+
+def test_scheduler_evicts_lowest_priority_then_youngest():
+    scheduler = _scheduler(blocks=2, max_batch=3, chunk=8)
+    vip = ServeRequest(id="vip", prompt=[1] * 4, max_new_tokens=8,
+                       priority=1, arrival_s=1.0)
+    old = ServeRequest(id="old", prompt=[2] * 4, max_new_tokens=8, arrival_s=2.0)
+    young = ServeRequest(id="young", prompt=[3] * 4, max_new_tokens=8, arrival_s=3.0)
+    for request in (vip, old, young):
+        scheduler.submit(request)
+    # blocks=2 admits exactly two 1-block residencies; 'young' waits
+    assert scheduler.admit() == [vip, old]
+    vip.cache_len = old.cache_len = 8  # both pages now full
+    # vip's next token needs a second block: pool dry -> the LOWEST
+    # priority running request is the victim ('old', not the vip)
+    assert scheduler.ensure_decode_blocks(vip)
+    assert old.slot is None and old.evictions == 1
+    assert scheduler.waiting[0] is old  # requeued at the FRONT
+    assert len(vip.blocks) == 2
+
+
+def test_scheduler_eviction_folds_progress_into_prompt():
+    scheduler = _scheduler(blocks=1, max_batch=2)
+    request = ServeRequest(id="r", prompt=[1, 2, 3], max_new_tokens=8)
+    scheduler.submit(request)
+    scheduler.admit()
+    request.generated = [7, 8]
+    request.cache_len = 5
+    scheduler.evict(request)
+    assert scheduler.allocator.blocks_in_use == 0
+    readmitted = scheduler.admit()
+    assert readmitted == [request]
+    # the re-prefill replays prompt + generated, so greedy continuation
+    # is token-identical to the uninterrupted run
+    assert request.prefill_tokens == [1, 2, 3, 7, 8]
+    assert request.prefilled == 0 and request.cache_len == 0
+
+
+# -------------------------------------------------- paged tuning / pool
+
+
+def test_resolve_block_size_paged_kind(monkeypatch):
+    config = LlamaConfig(**TINY)
+    monkeypatch.delenv("PAGED_BLOCK_K", raising=False)
+    assert resolve_block_size(config, max_model_len=64) == 16  # paged default
+    monkeypatch.setenv("PAGED_BLOCK_K", "32")
+    assert resolve_block_size(config, max_model_len=64) == 32
+    # explicit config wins over env; sublane (8) alignment enforced
+    assert resolve_block_size(config, 64, block_size=8) == 8
+    with pytest.raises(ValueError):
+        resolve_block_size(config, 64, block_size=12)
+
+
+def test_paged_append_pads_go_to_trash():
+    from llm_training_tpu.ops.paged_attention import paged_append
+
+    pool = jnp.zeros((4, 8, 1, 4))  # [blocks, page, h, d]
+    k = jnp.ones((1, 4, 1, 4))
+    seg = jnp.asarray([[1, 1, 0, 0]])  # 2 real tokens, 2 pads
+    tables = jnp.asarray([[2, 3]])
+    new_k, _ = paged_append(
+        pool, pool, k, k, jnp.asarray([7]), tables, seg
+    )
+    # row length 7: real tokens land at block 2 slot 7 then block 3 slot 0
+    assert float(new_k[2, 7, 0, 0]) == 1.0
+    assert float(new_k[3, 0, 0, 0]) == 1.0
+    # pads went to the trash block, nowhere else
+    assert float(jnp.sum(new_k[1:])) == 2 * 4  # two real tokens x head_dim
+    assert float(jnp.sum(new_k[TRASH_BLOCK])) > 0
+
+
+@pytest.mark.parametrize("window,cap,group", [
+    (None, None, 2), (5, None, 2), (None, 4.0, 1), (5, 4.0, 4),
+])
+def test_paged_kernel_matches_gather_fallback(window, cap, group):
+    """The interpreted Pallas kernel and the XLA gather path must agree on
+    ragged single-token decode — GQA groups, sliding windows, soft cap."""
+    from llm_training_tpu.ops.paged_attention import paged_cached_attention
+
+    batch, kv_heads, head_dim, page, pages = 3, 2, 8, 8, 3
+    keys = jax.random.split(jax.random.key(0), 4)
+    pool_shape = (1 + batch * pages, page, kv_heads, head_dim)
+    pool_k = jax.random.normal(keys[0], pool_shape)
+    pool_v = jax.random.normal(keys[1], pool_shape)
+    q = jax.random.normal(keys[2], (batch, 1, kv_heads * group, head_dim))
+    k = jax.random.normal(keys[3], (batch, 1, kv_heads, head_dim))
+    v = jax.random.normal(keys[3], (batch, 1, kv_heads, head_dim)) + 1.0
+    tables = jnp.arange(1, 1 + batch * pages, dtype=jnp.int32).reshape(batch, pages)
+    lengths = jnp.asarray([0, 7, 20], jnp.int32)  # ragged: page starts/middles
+    outs = {}
+    for impl in ("pallas", "xla"):
+        outs[impl], _ = paged_cached_attention(
+            q, k, v, (pool_k, pool_v), lengths, tables,
+            sliding_window=window, logits_soft_cap=cap, impl=impl,
+        )
+    np.testing.assert_allclose(
+        np.asarray(outs["pallas"]), np.asarray(outs["xla"]), rtol=2e-5, atol=2e-5
+    )
+
+
+# -------------------------------------------- paged == dense greedy parity
+
+
+@pytest.mark.parametrize("scan_layers", [True, False], ids=["scan", "looped"])
+def test_paged_greedy_matches_dense_and_oracle(scan_layers):
+    """Continuous-batching greedy decode through the paged pool must be
+    token-identical to BOTH the dense `DecodeState` engine and the full-
+    forward oracle, with ragged prompts spanning page boundaries."""
+    model = Llama(LlamaConfig(**TINY, scan_layers=scan_layers))
+    variables = _init(model)
+    prompts = [[3, 17, 42, 7, 11], [5, 9], [1, 2, 3]]
+    n = 8
+    done, _ = _serve_all(model, variables, prompts, n)
+    dense = InferenceEngine(model, variables).generate(
+        prompts, GenerateConfig(max_new_tokens=n, eos_token_id=None)
+    )
+    for row, prompt in enumerate(prompts):
+        expected = _full_forward_greedy(model, variables, prompt, n)
+        assert done[str(row)]["tokens"] == expected, f"row {row} vs oracle"
+        assert dense["tokens"][row] == expected, f"row {row} dense vs oracle"
+
+
+def test_paged_greedy_moe_and_sliding_window():
+    model = Llama(LlamaConfig(
+        **TINY, num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        sliding_window=4,
+    ))
+    variables = _init(model)
+    prompts = [[3, 17, 42, 7, 11, 2], [9, 4, 6]]
+    done, _ = _serve_all(model, variables, prompts, 6)
+    for row, prompt in enumerate(prompts):
+        assert done[str(row)]["tokens"] == _full_forward_greedy(
+            model, variables, prompt, 6
+        ), f"row {row}"
+
+
+def test_paged_greedy_gemma():
+    model = Gemma(GemmaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, attention_impl="xla",
+        compute_dtype="float32",
+    ))
+    variables = _init(model)
+    prompts = [[3, 17, 42], [5, 9, 11, 13]]
+    done, _ = _serve_all(model, variables, prompts, 5)
+    for row, prompt in enumerate(prompts):
+        assert done[str(row)]["tokens"] == _full_forward_greedy(
+            model, variables, prompt, 5
+        ), f"row {row}"
+
+
+def test_eos_recycles_slot_and_reports_stop_reason():
+    """A row hitting eos frees its slot/blocks immediately; the engine
+    reports 'eos' and the dense engine satellite reports the same per-row
+    lengths/stop_reasons split."""
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    prompt = [3, 17, 42, 7]
+    oracle = _full_forward_greedy(model, variables, prompt, 6)
+    eos = oracle[2]  # force an early deterministic stop
+    config = ServeConfig(max_batch=1, max_model_len=32, block_size=8,
+                         prefill_chunk=4, eos_token_id=eos)
+    engine = ServingEngine(model, variables, config)
+    events = engine.run([{"id": "r", "prompt": prompt, "max_new_tokens": 6}])
+    done = [e for e in events if e["type"] == "done"]
+    assert done[0]["stop_reason"] == "eos"
+    assert done[0]["tokens"] == oracle[:3]  # up to and including eos
+    assert engine.allocator.blocks_in_use == 0
+
+    dense_engine = InferenceEngine(model, variables)  # one compile set
+    dense = dense_engine.generate(
+        [prompt], GenerateConfig(max_new_tokens=6, eos_token_id=eos)
+    )
+    assert dense["stop_reasons"] == ["eos"] and dense["lengths"] == [3]
+    full = dense_engine.generate(
+        [prompt], GenerateConfig(max_new_tokens=6, eos_token_id=None)
+    )
+    assert full["stop_reasons"] == ["max_tokens"] and full["lengths"] == [6]
+
+
+# ------------------------------------------------- continuous batching
+
+
+def test_mid_stream_admission_is_token_identical():
+    """A request submitted while another is mid-decode joins the SAME
+    batch (continuous batching) and both finish token-identical to the
+    oracle — the dense engine's closed-batch limitation, lifted."""
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    first, second = [3, 17, 42, 7], [5, 9, 11]
+    n = 8
+    config = ServeConfig(max_batch=2, max_model_len=48, block_size=8,
+                         prefill_chunk=4, eos_token_id=None)
+    engine = ServingEngine(model, variables, config)
+    events = list(engine.submit("first", first, max_new_tokens=n))
+    while sum(e["type"] == "token" for e in events) < 2:
+        events.extend(engine.step())  # 'first' is now mid-decode
+    events.extend(engine.submit("second", second, max_new_tokens=n))
+    events.extend(engine.step())
+    assert len(engine.scheduler.running) == 2, "second not admitted mid-flight"
+    while not engine.scheduler.idle:
+        events.extend(engine.step())
+    done = {e["id"]: e for e in events if e["type"] == "done"}
+    assert done["first"]["tokens"] == _full_forward_greedy(model, variables, first, n)
+    assert done["second"]["tokens"] == _full_forward_greedy(model, variables, second, n)
+    assert engine.peak_running == 2
+    assert engine.allocator.blocks_in_use == 0
+
+
+def test_eviction_then_resume_is_token_identical():
+    """Under pool pressure the lowest-priority request is evicted, its
+    blocks freed, and after re-admission its greedy continuation matches
+    the uninterrupted oracle exactly (progress re-prefilled, already-
+    streamed tokens never re-emitted)."""
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    prompts = [[3, 17, 42, 7], [5, 9, 11]]
+    n = 12
+    # 3 usable blocks of 8 for two requests reaching 15-16 tokens: growth
+    # past each page boundary forces an eviction instead of a clean alloc
+    done, engine = _serve_all(
+        model, variables, prompts, n,
+        max_batch=2, max_model_len=32, num_blocks=3, prefill_chunk=4,
+    )
+    assert engine.scheduler.evictions >= 1, "pool pressure never evicted"
+    assert sum(d["evictions"] for d in done.values()) >= 1
+    for row, prompt in enumerate(prompts):
+        assert done[str(row)]["tokens"] == _full_forward_greedy(
+            model, variables, prompt, n
+        ), f"row {row} diverged across eviction"
+    # token chunks stream exactly once per generated token despite the
+    # evict/resume round trip
+    assert engine.allocator.blocks_in_use == 0
+
+
+def test_cross_survivor_eviction_mid_decode_step():
+    """A LATER decode row's block growth can evict an EARLIER row that
+    already passed its own ensure_decode_blocks this step (lower priority,
+    mid-page). The evicted row must be dropped from the step's batch — its
+    blocks may already belong to the evictor — and still finish
+    token-identically after re-admission."""
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    # pool of 2: A (priority 0, prompt 4) and B (priority 1, prompt 6)
+    # admit with one block each. B hits its page boundary (cache 8) while
+    # A sits mid-page — B's growth needs a block, the pool is dry, and the
+    # victim is A, processed EARLIER in the same decode step.
+    config = ServeConfig(max_batch=2, max_model_len=16, block_size=8,
+                         num_blocks=2, prefill_chunk=8, eos_token_id=None)
+    engine = ServingEngine(model, variables, config)
+    events = engine.run([
+        {"id": "a", "prompt": [3, 17, 42, 7], "max_new_tokens": 8, "priority": 0},
+        {"id": "b", "prompt": [5, 9, 11, 13, 2, 6], "max_new_tokens": 8, "priority": 1},
+    ])
+    done = {e["id"]: e for e in events if e["type"] == "done"}
+    assert done["a"]["evictions"] >= 1, "priority eviction never fired"
+    assert done["b"]["evictions"] == 0
+    assert done["a"]["tokens"] == _full_forward_greedy(model, variables, [3, 17, 42, 7], 8)
+    assert done["b"]["tokens"] == _full_forward_greedy(
+        model, variables, [5, 9, 11, 13, 2, 6], 8
+    )
+    assert engine.allocator.blocks_in_use == 0
+
+
+def test_capacity_failure_emits_done_event():
+    """A request that fits max_model_len but can NEVER fit the pool ends
+    with stop_reason='capacity' — and the protocol owes the client that
+    done chunk (an interactive client would otherwise block forever)."""
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    engine = ServingEngine(model, variables, ServeConfig(
+        max_batch=2, max_model_len=32, block_size=8, num_blocks=1,
+        prefill_chunk=4, eos_token_id=None,
+    ))
+    events = engine.run([
+        # needs ceil(13/8) = 2 blocks against a 1-block pool
+        {"id": "big", "prompt": [1] * 12, "max_new_tokens": 4},
+        {"id": "ok", "prompt": [3, 5], "max_new_tokens": 2},
+    ])
+    done = {e["id"]: e for e in events if e["type"] == "done"}
+    assert done["big"]["stop_reason"] == "capacity"
+    assert done["ok"]["stop_reason"] == "max_tokens"
+    assert engine.allocator.blocks_in_use == 0
+
+
+def test_submit_rejects_non_int_prompt():
+    """A syntactically valid request with a junk prompt must fail AT
+    SUBMIT (where the CLI's error contract lives), never inside a later
+    engine.step() taking the whole batch down."""
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    engine = ServingEngine(model, variables, ServeConfig(
+        max_batch=1, max_model_len=32, block_size=8, eos_token_id=None,
+    ))
+    with pytest.raises((TypeError, ValueError)):
+        engine.submit("junk", "abc", max_new_tokens=4)
+    # numeric strings coerce; the queue stays serviceable
+    events = engine.run([{"id": "ok", "prompt": ["3", 17], "max_new_tokens": 2}])
+    assert [e["id"] for e in events if e["type"] == "done"] == ["ok"]
+
+
+def test_serve_config_validators():
+    with pytest.raises(ValueError):
+        ServeConfig(max_batch=0)
+    with pytest.raises(ValueError):
+        ServeConfig(max_model_len=1)
+    with pytest.raises(ValueError):
+        ServeConfig(prefill_chunk=0)
+    with pytest.raises(ValueError):
+        ServeConfig(block_size=0)
+    with pytest.raises(ValueError):
+        ServeConfig(unknown_knob=1)
+
+
+def test_engine_stats_and_pool_gauges():
+    model = Llama(LlamaConfig(**TINY))
+    variables = _init(model)
+    done, engine = _serve_all(model, variables, [[3, 5, 7]], 4, max_batch=1)
+    stats = engine.stats()
+    assert stats["serve/requests_completed"] == 1
+    assert stats["serve/tokens_generated"] == 4
+    assert stats["serve/tokens_per_sec"] > 0
+    assert stats["decode/cache_blocks_in_use"] == 0
+    assert stats["decode/cache_peak_blocks_in_use"] >= 1
+    assert stats["serve/ttft_p50_ms"] > 0 and stats["serve/tpot_p50_ms"] >= 0
+    registry = get_registry()
+    assert registry.gauge("serve/tokens_per_sec").value == stats["serve/tokens_per_sec"]
+    # pool construction published its footprint (the cache_bytes satellite)
+    assert registry.gauge("decode/cache_bytes").value is not None
+
+
+def test_init_decode_state_publishes_cache_bytes():
+    """Satellite: EVERY dense cache construction lands decode/cache_bytes
+    in the registry — not just engine.generate's."""
+    from llm_training_tpu.infer import cache_bytes, init_decode_state
+
+    state = init_decode_state(LlamaConfig(**TINY), batch_size=2, max_length=16)
+    assert get_registry().gauge("decode/cache_bytes").value == cache_bytes(state)
+
+
+# ----------------------------------------------------------- reporting
+
+
+def test_report_serving_section():
+    from llm_training_tpu.telemetry.report import _serving_section
+
+    lines = _serving_section({
+        "serve/requests_completed": 3, "serve/requests_evicted": 1,
+        "serve/peak_running": 2, "serve/tokens_per_sec": 123.4,
+        "serve/tokens_per_sec_per_chip": 30.85, "serve/tokens_generated": 96,
+        "serve/ttft_p50_ms": 12.5, "serve/ttft_p99_ms": 80.0,
+        "serve/tpot_p50_ms": 3.1, "decode/cache_blocks_total": 16,
+        "decode/cache_peak_blocks_in_use": 9, "decode/cache_blocks_in_use": 0,
+    })
+    text = "\n".join(lines)
+    assert "== Serving ==" in text
+    assert "3 completed" in text and "1 evictions" in text
+    assert "123.4 tokens/s" in text and "(30.9/chip)" in text
+    assert "ttft: p50 12.5 ms  p99 80.0 ms" in text
+    assert "16 blocks, peak 9 in use (56%)" in text
+    assert "leak" not in text
+    leaky = "\n".join(_serving_section({
+        "serve/requests_completed": 1, "decode/cache_blocks_total": 8,
+        "decode/cache_blocks_in_use": 2,
+    }))
+    assert "2 still held at exit (leak?)" in leaky
+    assert _serving_section({"goodput/total_s": 1.0}) == []
