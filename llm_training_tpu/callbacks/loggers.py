@@ -23,6 +23,7 @@ logger = logging.getLogger(__name__)
 TELEMETRY_PREFIXES = (
     "goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/",
     "health/", "nan_guard/", "resilience/", "decode/", "eval/", "serve/",
+    "elastic/",
 )
 TELEMETRY_KEYS = ("compile_time_s",)
 
@@ -46,6 +47,9 @@ def _primary_host() -> bool:
 class JsonlLoggerConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
+    # save_dir/project defaults are mirrored by cli.main's
+    # _jsonl_run_dir_jaxfree (the supervisor path cannot import this
+    # package — its __init__ pulls jax); keep them in sync
     save_dir: str = "runs"
     project: str = "llm-training-tpu"
     name: str | None = None  # default: timestamp

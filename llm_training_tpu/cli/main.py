@@ -113,6 +113,27 @@ def _jsonl_run_dir(config: dict):
     return None
 
 
+def _jsonl_run_dir_jaxfree(config: dict):
+    """`_jsonl_run_dir` for the SUPERVISOR path: importing
+    `callbacks.loggers` executes the callbacks package __init__, which
+    module-level imports jax (profiler/time_estimator) — and the
+    supervisor must never load jax or it holds the TPU its child needs.
+    The two default strings mirror JsonlLoggerConfig (save_dir="runs",
+    project="llm-training-tpu"); keep them in sync."""
+    from pathlib import Path
+
+    for node in config.get("trainer", {}).get("loggers", []) or []:
+        if str(node.get("class_path", "")).endswith("JsonlLogger"):
+            init = node.get("init_args", {}) or {}
+            if init.get("name"):
+                return (
+                    Path(init.get("save_dir", "runs"))
+                    / str(init.get("project", "llm-training-tpu"))
+                    / str(init["name"])
+                )
+    return None
+
+
 def _publish_run_telemetry(config: dict, gauges: dict) -> None:
     """Merge `decode/*` / `eval/*` gauges into the run dir's newest
     telemetry.jsonl record (same step, keys overlaid), so `report` renders
@@ -369,11 +390,32 @@ def _run_supervise(args) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
         stream=sys.stdout,
     )
+    log_path = args.log
+    if log_path is None:
+        # no explicit --log: land the churn log in the run directory (when
+        # the config names one) so `report <run_dir>` finds it without
+        # --supervisor-log — otherwise supervise would write to cwd and
+        # report look in the run dir, and they'd never meet. load_config
+        # and _jsonl_run_dir_jaxfree are yaml/stdlib-only, preserving the
+        # no-jax-in-supervisor invariant
+        log_path = "supervisor.jsonl"
+        try:
+            run_dir = _jsonl_run_dir_jaxfree(
+                load_config(args.config, args.overrides)
+            )
+            if run_dir is not None:
+                log_path = str(run_dir / "supervisor.jsonl")
+        except Exception:
+            pass  # unparseable config: the child will report it properly
+    log_path = log_path or None  # '' disables
     config = SupervisorConfig(
         max_restarts=args.max_restarts,
         backoff_base_s=args.backoff_base_s,
         backoff_max_s=args.backoff_max_s,
-        log_path=args.log or None,
+        log_path=log_path,
+        min_devices=args.min_devices,
+        probe_backoff_s=args.probe_backoff_s,
+        probe_max_wait_s=args.probe_max_wait_s,
     )
     supervisor = Supervisor(
         build_fit_argv(args.config, args.overrides, ckpt_path=args.ckpt_path),
@@ -485,6 +527,11 @@ def main(argv: list[str] | None = None) -> int:
         help="dir searched first for the newest BENCH_r*.json / bench*.json "
         "record (== Perf == section); falls back to run_dir, then cwd",
     )
+    report.add_argument(
+        "--supervisor-log", default=None,
+        help="supervisor.jsonl with per-segment topology events "
+        "(== Elastic == section); default: <run_dir>/supervisor.jsonl",
+    )
     supervise = sub.add_parser(
         "supervise",
         help="run fit as a supervised child process; restart it on "
@@ -500,8 +547,26 @@ def main(argv: list[str] | None = None) -> int:
     supervise.add_argument("--backoff-base-s", type=float, default=1.0)
     supervise.add_argument("--backoff-max-s", type=float, default=300.0)
     supervise.add_argument(
-        "--log", default="supervisor.jsonl",
-        help="supervisor event log path ('' disables)",
+        "--min-devices", type=int, default=None,
+        help="elastic capacity gate: before each relaunch, probe the "
+        "visible device count (in a subprocess) and wait while it is below "
+        "this minimum (docs/resilience.md#elastic); default: relaunch blind",
+    )
+    supervise.add_argument(
+        "--probe-backoff-s", type=float, default=5.0,
+        help="sleep between capacity probes while below --min-devices",
+    )
+    supervise.add_argument(
+        "--probe-max-wait-s", type=float, default=300.0,
+        help="give up (propagating the child's exit code) after waiting "
+        "this long for --min-devices",
+    )
+    supervise.add_argument(
+        "--log", default=None,
+        help="supervisor event log path ('' disables). Default: "
+        "supervisor.jsonl in the config's run directory when it names one "
+        "(where `report` looks), else the cwd; an explicit path — "
+        "including './supervisor.jsonl' — is used as given",
     )
     supervise.add_argument("overrides", nargs="*")
     args = parser.parse_args(argv)
@@ -509,7 +574,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "report":
         from llm_training_tpu.telemetry.report import report_main
 
-        return report_main(args.run_dir, bench_dir=args.bench_dir)
+        return report_main(
+            args.run_dir,
+            bench_dir=args.bench_dir,
+            supervisor_log=args.supervisor_log,
+        )
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import

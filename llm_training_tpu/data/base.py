@@ -92,6 +92,40 @@ class BaseDataModule:
                 step += 1
             epoch += 1
 
+    def replica_batches(
+        self,
+        dp_rank: int,
+        dp_size: int,
+        start_step: int = 0,
+        skip_list: Any | None = None,
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Replica `dp_rank`'s share of the GLOBAL stream: rows
+        [rank*stride, (rank+1)*stride) of every `train_batches` batch,
+        stride = batch_size // dp_size.
+
+        This is the elastic-resume data contract (docs/resilience.md#elastic):
+        the (seed, step) → sample mapping lives entirely in the global
+        stream, and a replica's view is a pure slice of it — so
+        concatenating the dp_size replica streams row-wise reconstructs the
+        global stream EXACTLY, for any dp_size dividing batch_size. Scaling
+        data-parallel replicas up or down between segments changes only the
+        stride, never which samples step k serves; skip windows and the
+        start cursor compose unchanged because they are applied to the
+        global stream before the slice."""
+        if dp_size < 1:
+            raise ValueError(f"dp_size must be >= 1, got {dp_size}")
+        if not 0 <= dp_rank < dp_size:
+            raise ValueError(f"dp_rank {dp_rank} outside [0, {dp_size})")
+        if self.config.batch_size % dp_size != 0:
+            raise ValueError(
+                f"global batch size {self.config.batch_size} is not divisible "
+                f"by dp_size {dp_size}; the per-replica stride must be exact"
+            )
+        stride = self.config.batch_size // dp_size
+        lo, hi = dp_rank * stride, (dp_rank + 1) * stride
+        for batch in self.train_batches(start_step=start_step, skip_list=skip_list):
+            yield {key: value[lo:hi] for key, value in batch.items()}
+
     def val_batches(self) -> Iterator[dict[str, np.ndarray]]:
         if self.val_dataset is None:
             return

@@ -68,6 +68,20 @@ class MeshConfig(BaseModel):
             SEQUENCE_AXIS: self.sequence_parallel_size,
         }
 
+    @classmethod
+    def from_axis_sizes(cls, sizes: dict[str, int]) -> "MeshConfig":
+        """Inverse of `axis_sizes()` — how the elastic topology planner's
+        fully-resolved degrees (resilience/elastic.py) become a mesh config.
+        Missing axes default to 1."""
+        return cls(
+            data_parallel_size=int(sizes.get(DATA_AXIS, 1)),
+            pipeline_parallel_size=int(sizes.get(PIPELINE_AXIS, 1)),
+            fsdp_size=int(sizes.get(FSDP_AXIS, 1)),
+            expert_parallel_size=int(sizes.get(EXPERT_AXIS, 1)),
+            tensor_parallel_size=int(sizes.get(TENSOR_AXIS, 1)),
+            sequence_parallel_size=int(sizes.get(SEQUENCE_AXIS, 1)),
+        )
+
 
 def resolve_axis_sizes(config: MeshConfig, num_devices: int) -> dict[str, int]:
     sizes = config.axis_sizes()

@@ -1,6 +1,6 @@
 """Resilience subsystem: survive the failures TPU pods actually have.
 
-Six layers (docs/resilience.md):
+Seven layers (docs/resilience.md):
 
 - **Preemption handling** (`shutdown.py`): SIGTERM/SIGINT → emergency
   checkpoint at the next step boundary → `PreemptionInterrupt` →
@@ -21,6 +21,12 @@ Six layers (docs/resilience.md):
   relaunch `fit` on exit 75 and on hard deaths (SIGKILL/OOM, segfault,
   watchdog SIGABRT) with a restart budget, exponential backoff, and a
   `supervisor.jsonl` event log — the failures in-process code cannot see.
+- **Elastic resume** (`elastic.py`): relaunch onto a *different* device
+  pool — a topology planner keeps the model axes fixed and scales the
+  `data` axis to the live device count, the global-batch-keyed data
+  stream replays identically across a DP resize, each segment logs its
+  topology to `supervisor.jsonl`, and the goodput ledger's chip-count/
+  price tags aggregate into `report`'s goodput-per-dollar.
 - **Fault injection** (`chaos.py`): config/env-driven failures at every
   recovery site — including NaN/spike divergence and SIGKILL — so tests
   and `scripts/crash_resume_smoke.py` prove the paths above end to end.
@@ -37,6 +43,18 @@ from llm_training_tpu.resilience.chaos import (
     get_chaos,
     install_chaos,
     uninstall_chaos,
+)
+from llm_training_tpu.resilience.elastic import (
+    ElasticConfig,
+    ElasticTopologyError,
+    TopologyPlan,
+    chaos_device_limit,
+    check_data_continuity,
+    log_segment_topology,
+    plan_topology,
+    resolve_chip_price,
+    segment_attempt,
+    visible_device_count,
 )
 from llm_training_tpu.resilience.recovery import (
     LOSS_SPIKE_EXIT_CODE,
@@ -96,6 +114,12 @@ class ResilienceConfig(BaseModel):
     # (docs/resilience.md#recovery); None (default) = fail-fast as before,
     # with the data stream byte-identical to a recovery-less build
     recovery: RecoveryConfig | None = None
+    # elastic resume (docs/resilience.md#elastic): with this block set, fit
+    # plans its mesh against the LIVE device count — model axes pinned to
+    # the checkpoint's degrees, the data axis scaled up/down to absorb the
+    # capacity change. None (default) = the mesh is exactly what
+    # trainer.mesh says, as before
+    elastic: ElasticConfig | None = None
     # fault injection (off unless a trigger is set); LLMT_CHAOS_* env vars
     # overlay this at fit start
     chaos: ChaosConfig = ChaosConfig()
@@ -111,6 +135,8 @@ __all__ = [
     "ChaosConfig",
     "ChaosError",
     "DataSkipList",
+    "ElasticConfig",
+    "ElasticTopologyError",
     "GracefulShutdown",
     "HangWatchdog",
     "PreemptionInterrupt",
@@ -121,13 +147,21 @@ __all__ = [
     "RetryPolicy",
     "Supervisor",
     "SupervisorConfig",
+    "TopologyPlan",
     "build_fit_argv",
+    "chaos_device_limit",
     "chaos_point",
+    "check_data_continuity",
     "config_from_env",
     "cooldown_schedule",
     "get_chaos",
     "install_chaos",
     "is_transient",
+    "log_segment_topology",
+    "plan_topology",
+    "resolve_chip_price",
     "retry_call",
+    "segment_attempt",
     "uninstall_chaos",
+    "visible_device_count",
 ]

@@ -14,6 +14,11 @@ start (`install_chaos`) and removed in its fit finally; call sites poll
 zero overhead and zero behavior change for normal runs. Environment
 variables (`LLMT_CHAOS_*`, see `config_from_env`) override the config so a
 supervisor or CI job can inject faults without editing YAML.
+
+One chaos knob lives elsewhere: `LLMT_CHAOS_DEVICES` (the visible-device
+shrink for elastic kill→shrink→resume CI) is read by
+`resilience/elastic.py` — it must apply before the mesh is built, which
+is before this harness installs.
 """
 
 from __future__ import annotations

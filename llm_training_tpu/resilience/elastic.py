@@ -1,0 +1,414 @@
+"""Elastic training: resume onto a *different* topology as a first-class
+path.
+
+On preemptible TPU capacity the pool that comes back after an eviction is
+routinely smaller or larger than the one that was lost. The rest of the
+resilience stack (preemption-safe checkpoints, the crash-restart
+supervisor, the deterministic data-skip list) already survives the death;
+this module makes the *relaunch* survive the reconfiguration:
+
+- **Topology planner** (`plan_topology`): given the live device count, the
+  checkpoint's recorded mesh degrees, and the config's mesh constraints,
+  pick a valid new mesh — the `data` axis scales up/down to absorb the
+  capacity change, the model axes (pipe/fsdp/expert/tensor/sequence) stay
+  fixed at the degrees the checkpoint was written with (orbax reshards
+  parameters onto the new mesh at restore; changing the *data* degree only
+  changes replication). When the model axes cannot fit the new pool the
+  planner refuses with a clear error instead of producing a mesh that
+  silently corrupts the run.
+- **Data continuity** (`check_data_continuity` + `BaseDataModule.
+  replica_batches`): the (seed, global_step) → sample mapping is keyed to
+  the GLOBAL batch, never to the replica count — a DP resize replays the
+  identical global stream. The global batch size and sample cursor ride
+  checkpoint metadata so a resume that *would* change the stream is
+  refused loudly.
+- **Segment topology logging** (`log_segment_topology`): every supervised
+  fit segment appends its world (device count, mesh degrees, planner
+  decision, chip price) to the supervisor's `supervisor.jsonl`, keyed by
+  the supervisor attempt, so a pod's churn — and what each relaunch ran
+  on — is auditable after the fact.
+- **Chaos device shrink** (`chaos_device_limit` / `visible_device_count`):
+  `LLMT_CHAOS_DEVICES=<n>` clamps the visible device set so CI can run
+  kill → shrink → resume end to end on a CPU host; a comma-separated
+  schedule (`"8,4"`) is indexed by the supervisor attempt, so one
+  `supervise` invocation sees 8 devices die and 4 come back.
+- **Goodput-per-dollar** (with `telemetry/goodput.py`): each segment's
+  ledger is tagged with its chip count and $/chip-hour
+  (`LLMT_CHIP_PRICE_PER_HOUR` env > `trainer.resilience.elastic.
+  price_per_chip_hour`), and `report` aggregates cost and productive
+  chip-hours across segments into an `== Elastic ==` section.
+
+This module must stay importable without jax (the supervisor and the
+`report` CLI read it); jax is imported lazily inside the few helpers that
+need a live backend.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from pydantic import BaseModel, ConfigDict, Field
+
+logger = logging.getLogger(__name__)
+
+# exported to every supervised child: 1-based launch attempt and the
+# supervisor.jsonl path segments append their topology events to
+ATTEMPT_ENV = "LLMT_SUPERVISOR_ATTEMPT"
+SUPERVISOR_LOG_ENV = "LLMT_SUPERVISOR_LOG"
+# chaos: clamp the visible device set (int, or a comma schedule indexed by
+# the supervisor attempt). Read directly from the environment — the clamp
+# must apply before the mesh is built, which is before the chaos harness
+# installs
+CHAOS_DEVICES_ENV = "LLMT_CHAOS_DEVICES"
+# $/chip-hour for goodput-per-dollar accounting (overrides the config)
+CHIP_PRICE_ENV = "LLMT_CHIP_PRICE_PER_HOUR"
+
+# the axes elastic resume holds FIXED: they shard the model (changing them
+# means resharding parameters/optimizer state in ways that change the
+# program), while `data` only changes replication
+MODEL_AXES = ("pipe", "fsdp", "expert", "tensor", "sequence")
+DATA_AXIS = "data"
+
+
+class ElasticTopologyError(RuntimeError):
+    """The live device pool cannot host the checkpoint's model axes (or the
+    config conflicts with them) — a human or a config change is needed."""
+
+
+class ElasticConfig(BaseModel):
+    """`trainer.resilience.elastic.*` — presence of the block enables
+    topology planning at fit start; unset (the default) keeps the mesh
+    exactly what the config says, as before. The supervisor-side capacity
+    knobs (`--min-devices`, `--probe-backoff-s`, `--probe-max-wait-s`) live
+    on the `supervise` CLI (docs/resilience.md#elastic)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # $/chip-hour for the goodput-per-dollar accounting;
+    # LLMT_CHIP_PRICE_PER_HOUR overrides at fit start
+    price_per_chip_hour: float | None = Field(None, gt=0)
+
+
+@dataclass
+class TopologyPlan:
+    """What one fit segment runs on (returned by `plan_topology`)."""
+
+    device_count: int                 # devices the mesh will actually use
+    spare_devices: int                # visible but unused (non-divisible pool)
+    axis_sizes: dict[str, int] = field(default_factory=dict)  # fully resolved
+    decision: str = ""                # human-readable planner decision
+    source: str = "config"            # "checkpoint" | "config"
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.axis_sizes.get(DATA_AXIS, 1)
+
+
+def _prod(values) -> int:
+    return math.prod(int(v) for v in values)
+
+
+def plan_topology(
+    available_devices: int,
+    config_sizes: dict[str, int],
+    checkpoint_mesh: dict[str, int] | None = None,
+    global_batch_size: int | None = None,
+) -> TopologyPlan:
+    """Pick the mesh for a segment: model axes fixed, `data` elastic.
+
+    `config_sizes` is `MeshConfig.axis_sizes()` (-1 = auto on at most one
+    axis); `checkpoint_mesh` is the `topology.mesh` rider of the checkpoint
+    being resumed (None on fresh starts / pre-elastic checkpoints);
+    `global_batch_size` (the `data_state` rider) lets the planner avoid
+    data degrees the batch cannot shard over.
+
+    Rules:
+    - with a checkpoint: model axes come from the checkpoint; a config
+      value that is explicit (not -1) and *different* is an error — elastic
+      resume never reshards model axes behind the user's back;
+    - `data` = available // model_ways (>= 1 or error), regardless of the
+      config's data value — that IS the elastic scaling; when the global
+      batch is known, data is clamped down to the largest degree it can
+      shard over (batch % (data*fsdp) == 0), so a non-divisor pool (e.g.
+      6 chips for a batch of 8) still resumes instead of dying in fit's
+      divisibility check every relaunch;
+    - without a checkpoint: resolve like `resolve_axis_sizes`, except an
+      over/undersubscribed explicit mesh scales `data` down/up to fit and
+      a non-divisible remainder becomes `spare_devices` instead of an
+      error.
+    """
+    if available_devices < 1:
+        raise ElasticTopologyError("no visible devices to build a mesh on")
+    checkpoint_mesh = checkpoint_mesh or {}
+    if int(config_sizes.get(DATA_AXIS, 1)) == -1 and any(
+        int(config_sizes.get(axis, 1)) == -1 for axis in MODEL_AXES
+    ):
+        # the classic resolver rejects two auto axes; enabling elastic must
+        # not widen the set of accepted-but-misinterpreted configs
+        raise ElasticTopologyError(
+            "at most one mesh axis may be -1 (auto); got data plus "
+            + str([a for a in MODEL_AXES if int(config_sizes.get(a, 1)) == -1])
+        )
+
+    model: dict[str, int] = {}
+    auto_axis: str | None = None
+    for axis in MODEL_AXES:
+        conf = int(config_sizes.get(axis, 1))
+        ckpt = checkpoint_mesh.get(axis)
+        if ckpt is not None:
+            ckpt = int(ckpt)
+            if conf not in (-1, ckpt):
+                raise ElasticTopologyError(
+                    f"config mesh {axis}={conf} conflicts with the "
+                    f"checkpoint's {axis}={ckpt}: elastic resume keeps the "
+                    "model axes fixed (only `data` scales). Set the config "
+                    "to match the checkpoint, or disable "
+                    "trainer.resilience.elastic to reshard explicitly."
+                )
+            model[axis] = ckpt
+        elif conf == -1:
+            auto_axis = axis
+            model[axis] = 0  # filled below (fresh start only)
+        else:
+            model[axis] = conf
+
+    config_data = int(config_sizes.get(DATA_AXIS, 1))
+    source = "checkpoint" if checkpoint_mesh else "config"
+
+    if auto_axis is not None:
+        # a MODEL axis is the config's auto axis and no checkpoint pinned
+        # it (fresh start): fill it the classic way with data at its config
+        # value — the run starts static; later resumes pin these degrees
+        fixed = _prod(v for a, v in model.items() if a != auto_axis)
+        data = max(config_data, 1)
+        denom = fixed * data
+        filled = available_devices // denom
+        if filled < 1:
+            raise ElasticTopologyError(
+                f"cannot fill auto axis {auto_axis!r}: fixed axes use "
+                f"{denom} of {available_devices} visible devices"
+            )
+        model[auto_axis] = filled
+        used = denom * filled
+        return TopologyPlan(
+            device_count=used,
+            spare_devices=available_devices - used,
+            axis_sizes={DATA_AXIS: data, **model},
+            decision=f"fresh start: filled {auto_axis}={filled}, data={data}",
+            source=source,
+        )
+
+    model_ways = _prod(model.values())
+    if model_ways > available_devices:
+        raise ElasticTopologyError(
+            f"model axes {model} need {model_ways} devices but only "
+            f"{available_devices} are visible: elastic resume scales only "
+            "the data axis — this pool cannot host the model sharding. "
+            "Wait for capacity (supervise --min-devices) or retrain with "
+            "smaller model axes."
+        )
+    data = available_devices // model_ways
+    batch_note = ""
+    if global_batch_size:
+        # the batch shards over data*fsdp rows (the trainer's divisibility
+        # check): clamp data to the largest degree the batch supports. If
+        # even data=1 cannot divide it, leave data alone — fit's own check
+        # then reports the real problem (a batch/fsdp mismatch no data
+        # degree can fix)
+        fsdp = model.get("fsdp", 1)
+        fitted = data
+        while fitted > 1 and int(global_batch_size) % (fitted * fsdp) != 0:
+            fitted -= 1
+        if fitted != data and int(global_batch_size) % (fitted * fsdp) == 0:
+            batch_note = (
+                f", data clamped {data}->{fitted} to divide the global "
+                f"batch ({global_batch_size})"
+            )
+            data = fitted
+    used = data * model_ways
+    spare = available_devices - used
+
+    old_data = checkpoint_mesh.get(DATA_AXIS)
+    if old_data is not None and int(old_data) != data:
+        decision = f"scaled data {int(old_data)}->{data}"
+    elif checkpoint_mesh:
+        decision = f"unchanged (data={data})"
+    elif config_data == -1 or config_data == data:
+        decision = f"fresh start: data={data}"
+    else:
+        decision = f"fresh start: scaled data {config_data}->{data} to fit"
+    decision += batch_note
+    if spare:
+        decision += f", {spare} spare device(s) unused"
+    return TopologyPlan(
+        device_count=used,
+        spare_devices=spare,
+        axis_sizes={DATA_AXIS: data, **model},
+        decision=decision,
+        source=source,
+    )
+
+
+# ------------------------------------------------------------ environment
+
+
+def segment_attempt() -> int:
+    """The supervisor launch attempt this process is (1 outside a
+    supervisor)."""
+    try:
+        return max(1, int(os.environ.get(ATTEMPT_ENV, "1") or 1))
+    except ValueError:
+        return 1
+
+
+def chaos_device_limit(attempt: int | None = None) -> int | None:
+    """The LLMT_CHAOS_DEVICES clamp for this launch, or None.
+
+    A single int clamps every launch; a comma schedule ("8,4") is indexed
+    by the (1-based) supervisor attempt, clamping to the last entry past
+    the end — so kill-on-8 / resume-on-4 runs inside one `supervise`
+    invocation. Malformed values are ignored with a warning (chaos must
+    never take down a production run by typo)."""
+    raw = os.environ.get(CHAOS_DEVICES_ENV)
+    if not raw:
+        return None
+    try:
+        schedule = [int(part) for part in raw.split(",") if part.strip()]
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", CHAOS_DEVICES_ENV, raw)
+        return None
+    if not schedule:
+        return None
+    if attempt is None:
+        attempt = segment_attempt()
+    limit = schedule[min(max(attempt, 1), len(schedule)) - 1]
+    return limit if limit > 0 else None
+
+
+def visible_device_count() -> int:
+    """Live backend device count after the chaos clamp (imports jax — the
+    supervisor calls this in a probe subprocess, never in-process)."""
+    import jax
+
+    count = len(jax.devices())
+    limit = chaos_device_limit()
+    return min(count, limit) if limit is not None else count
+
+
+def resolve_chip_price(config: ElasticConfig | None) -> float | None:
+    """$/chip-hour: LLMT_CHIP_PRICE_PER_HOUR env > config. None = unknown
+    (report degrades to an honest line instead of inventing a cost)."""
+    raw = os.environ.get(CHIP_PRICE_ENV)
+    if raw:
+        try:
+            price = float(raw)
+            if price > 0:
+                return price
+            logger.warning("ignoring non-positive %s=%r", CHIP_PRICE_ENV, raw)
+        except ValueError:
+            logger.warning("ignoring malformed %s=%r", CHIP_PRICE_ENV, raw)
+    return config.price_per_chip_hour if config is not None else None
+
+
+# ------------------------------------------------------------ audit trail
+
+
+def log_segment_topology(
+    mesh_sizes: dict[str, int],
+    device_count: int,
+    decision: str | None = None,
+    price_per_chip_hour: float | None = None,
+    path: str | Path | None = None,
+    attempt: int | None = None,
+) -> dict | None:
+    """Append this segment's world to the supervisor's event log.
+
+    `path` defaults to $LLMT_SUPERVISOR_LOG (set by the Supervisor for its
+    children); with neither, this is a no-op — an unsupervised fit has no
+    churn log to feed. Returns the record written, or None. Never raises:
+    a full disk must not kill the training segment it is auditing."""
+    path = path or os.environ.get(SUPERVISOR_LOG_ENV)
+    if not path:
+        return None
+    record = {
+        "ts": time.time(),
+        "event": "segment_topology",
+        "attempt": attempt if attempt is not None else segment_attempt(),
+        "device_count": int(device_count),
+        "mesh": {str(k): int(v) for k, v in mesh_sizes.items()},
+    }
+    if decision:
+        record["decision"] = decision
+    if price_per_chip_hour is not None:
+        record["price_per_chip_hour"] = float(price_per_chip_hour)
+    try:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError:
+        logger.warning("could not append segment_topology to %s", path)
+    return record
+
+
+def verify_restored_topology(plan: TopologyPlan, topology: dict | None) -> None:
+    """Cross-check the restored checkpoint's recorded model-axis degrees
+    against the mesh the planner actually built.
+
+    Guards the degraded planning path: if the metadata-only `read_meta`
+    failed transiently (the planner fell back to the config) but the full
+    restore then SUCCEEDED, orbax would silently reshard model axes onto
+    the planned mesh — exactly what elastic promises never to do behind
+    the user's back. Pre-elastic checkpoints (no topology rider) and data-
+    axis differences (the legitimate elastic change) pass untouched."""
+    mesh = (topology or {}).get("mesh") or {}
+    mismatched = {
+        axis: (int(mesh[axis]), plan.axis_sizes.get(axis, 1))
+        for axis in MODEL_AXES
+        if axis in mesh and int(mesh[axis]) != int(plan.axis_sizes.get(axis, 1))
+    }
+    if mismatched:
+        raise ElasticTopologyError(
+            f"the restored checkpoint's model axes differ from the planned "
+            f"mesh: {{axis: (checkpoint, planned)}} = {mismatched} — the "
+            "checkpoint metadata was unreadable at planning time (or an "
+            "older step with a different topology was restored), and "
+            "continuing would reshard model axes silently. Retry the "
+            "relaunch, or set the config's model axes to the checkpoint's "
+            "degrees."
+        )
+
+
+# ------------------------------------------------------------ data stream
+
+
+def check_data_continuity(
+    data_state: dict | None, global_batch_size: int, elastic: bool
+) -> None:
+    """Refuse (elastic) or warn (legacy) when a resume changes the GLOBAL
+    batch size: the deterministic (seed, step) sample stream is keyed to
+    it, so the restored cursor would address *different* samples — the
+    exact silent corruption elastic resume exists to prevent. A DP resize
+    with the global batch held fixed passes untouched."""
+    if not data_state:
+        return
+    saved = int(data_state.get("global_batch_size", 0) or 0)
+    if not saved or saved == int(global_batch_size):
+        return
+    message = (
+        f"resume changes the GLOBAL batch size {saved} -> "
+        f"{int(global_batch_size)}: the (seed, step) sample stream is keyed "
+        "to the global batch, so the checkpoint's sample cursor "
+        f"({data_state.get('sample_cursor', '?')} samples) no longer "
+        "addresses the same data. Scale data_parallel_size (the per-replica "
+        "share), never the global batch, across an elastic resume."
+    )
+    if elastic:
+        raise ValueError(message)
+    logger.warning(message)

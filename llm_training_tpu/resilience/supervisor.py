@@ -23,6 +23,17 @@ overrides), and every lifecycle event appends to a `supervisor.jsonl` log
 (launch/exit/restart/giveup/complete with timestamps, runtimes, and
 decoded signal names) so a pod's churn is auditable after the fact.
 
+Elastic renegotiation (docs/resilience.md#elastic): with `min_devices`
+set, each relaunch first probes the visible device count — in a
+subprocess, preserving the no-jax invariant — and waits with backoff
+while the pool is below the minimum (`probe` / `capacity_wait` events),
+giving up after `probe_max_wait_s`. Every child is launched with
+`LLMT_SUPERVISOR_ATTEMPT` (1-based) and `LLMT_SUPERVISOR_LOG` exported,
+so each fit segment appends its own `segment_topology` event (device
+count, mesh degrees, planner decision — `resilience/elastic.py`) to the
+same log: the supervisor records the churn, the children record the
+worlds they actually ran in.
+
 The supervisor itself never imports jax — it must not touch the TPU the
 child needs.
 """
@@ -41,6 +52,7 @@ from typing import Any, Callable, Sequence
 
 from pydantic import BaseModel, ConfigDict, Field
 
+from llm_training_tpu.resilience.elastic import ATTEMPT_ENV, SUPERVISOR_LOG_ENV
 from llm_training_tpu.resilience.shutdown import RESUMABLE_EXIT_CODE
 
 logger = logging.getLogger(__name__)
@@ -66,6 +78,14 @@ class SupervisorConfig(BaseModel):
     # supervisor.jsonl event log (None = no log file; events still go to
     # the logger)
     log_path: str | None = None
+    # elastic capacity gating (docs/resilience.md#elastic): before each
+    # RELAUNCH, probe the visible device count and wait (with backoff)
+    # while it is below min_devices; give up after probe_max_wait_s of
+    # waiting. None disables probing — relaunch blind, as before. The
+    # probe runs in a SUBPROCESS (this process must never import jax).
+    min_devices: int | None = Field(None, ge=1)
+    probe_backoff_s: float = Field(5.0, ge=0)
+    probe_max_wait_s: float = Field(300.0, ge=0)
 
 
 def _signal_name(returncode: int) -> str | None:
@@ -100,6 +120,7 @@ class Supervisor:
         run_child: Callable[[list[str]], int] | None = None,
         clock: Callable[[], float] = time.monotonic,
         relaunch_argv: Sequence[str] | None = None,
+        probe: Callable[[], int | None] | None = None,
     ):
         self.argv = list(argv)
         # relaunches may need a different command than the first launch
@@ -108,9 +129,26 @@ class Supervisor:
         self.relaunch_argv = list(relaunch_argv) if relaunch_argv else self.argv
         self.config = config or SupervisorConfig()
         self.env = {**os.environ, **(env or {})}
+        # children learn where the churn log lives so each fit segment can
+        # append its own segment_topology event (resilience/elastic.py) —
+        # the supervisor cannot know the mesh its child planned. Assigned
+        # unconditionally: children belong to THIS supervisor, so a stale
+        # value inherited from the parent environment must not win
+        if self.config.log_path:
+            self.env[SUPERVISOR_LOG_ENV] = str(
+                Path(self.config.log_path).absolute()
+            )
+        else:
+            # log disabled: children must not append their events into
+            # some OTHER run's log via an inherited value
+            self.env.pop(SUPERVISOR_LOG_ENV, None)
         self._sleep = sleep
         self._clock = clock
         self._run_child = run_child or self._spawn
+        # device-count probe for elastic capacity gating; injectable for
+        # tests. The default spawns a throwaway interpreter so jax never
+        # loads in THIS process (it would hold the TPU the child needs)
+        self._probe = probe or self._probe_devices
         self.restarts = 0
         self.events: list[dict] = []  # in-memory mirror of supervisor.jsonl
 
@@ -118,6 +156,63 @@ class Supervisor:
 
     def _spawn(self, argv: list[str]) -> int:
         return subprocess.call(argv, env=self.env)
+
+    def _probe_devices(self) -> int | None:
+        """Visible device count as the NEXT child would see it (the probe
+        subprocess inherits the child env, so the chaos device schedule and
+        platform pins apply). None = unknowable (broken probe), which the
+        capacity gate treats as 'proceed' — a flaky probe must not park a
+        relaunch forever."""
+        code = (
+            "from llm_training_tpu.resilience.elastic import "
+            "visible_device_count; print(visible_device_count())"
+        )
+        # a hung probe (wedged backend init) must not stall the relaunch
+        # past the configured capacity-wait deadline: couple the subprocess
+        # fuse to probe_max_wait_s (floor 5s for interpreter+jax startup)
+        timeout_s = min(300.0, max(5.0, self.config.probe_max_wait_s))
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                env=self.env, capture_output=True, text=True,
+                timeout=timeout_s,
+            )
+            if out.returncode != 0:
+                logger.warning(
+                    "device probe failed (rc %d): %s",
+                    out.returncode, out.stderr.strip()[-500:],
+                )
+                return None
+            return int(out.stdout.strip().splitlines()[-1])
+        except (OSError, subprocess.TimeoutExpired, ValueError, IndexError) as e:
+            logger.warning("device probe failed: %s", e)
+            return None
+
+    def _await_capacity(self, next_attempt: int) -> tuple[bool, int | None]:
+        """Block (with backoff) until the visible device count reaches
+        min_devices, the probe proves unknowable, or probe_max_wait_s runs
+        out. Returns (proceed, last_count)."""
+        cfg = self.config
+        # the probe must see the world the NEXT child will (the chaos
+        # device schedule is indexed by attempt)
+        self.env[ATTEMPT_ENV] = str(next_attempt)
+        deadline = self._clock() + cfg.probe_max_wait_s
+        while True:
+            count = self._probe()
+            self._log(
+                "probe", attempt=next_attempt, devices=count,
+                min_devices=cfg.min_devices,
+            )
+            if count is None or count >= cfg.min_devices:
+                return True, count
+            if self._clock() >= deadline:
+                return False, count
+            self._log(
+                "capacity_wait", devices=count, min_devices=cfg.min_devices,
+                backoff_s=cfg.probe_backoff_s,
+            )
+            if cfg.probe_backoff_s > 0:
+                self._sleep(cfg.probe_backoff_s)
 
     def _log(self, event: str, **fields: Any) -> None:
         record = {"ts": time.time(), "event": event, **fields}
@@ -151,6 +246,9 @@ class Supervisor:
         while True:
             attempt += 1
             argv = self.argv if attempt == 1 else self.relaunch_argv
+            # children (and probes) read the attempt to index the chaos
+            # device schedule and to key their segment_topology events
+            self.env[ATTEMPT_ENV] = str(attempt)
             self._log("launch", attempt=attempt, argv=argv)
             t0 = self._clock()
             rc = self._run_child(argv)
@@ -193,6 +291,23 @@ class Supervisor:
             )
             if delay > 0:
                 self._sleep(delay)
+            if cfg.min_devices:
+                # elastic renegotiation: the pool that comes back after a
+                # death is routinely a different size — wait for at least
+                # min_devices before relaunching (the child's own topology
+                # planner then fits the mesh to whatever is actually there)
+                proceed, count = self._await_capacity(attempt + 1)
+                if not proceed:
+                    self._log(
+                        "giveup",
+                        rc=rc,
+                        reason=(
+                            f"insufficient devices ({count} < min_devices "
+                            f"{cfg.min_devices}) after "
+                            f"{cfg.probe_max_wait_s}s of waiting"
+                        ),
+                    )
+                    return _exit_code(rc)
 
 
 def build_fit_argv(

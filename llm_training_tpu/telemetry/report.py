@@ -333,6 +333,157 @@ def _perf_lines(record: dict) -> list[str]:
     return lines
 
 
+def _read_supervisor_events(path: Path) -> list[dict] | None:
+    """Events from a supervisor.jsonl, or None when the file is absent OR
+    empty (a zero-byte log left by a killed supervisor says nothing and
+    must not force the elastic section into a run's report). A log with
+    content but no parseable events returns [] so the section can say so
+    honestly instead of crashing."""
+    if not path.is_file():
+        return None
+    try:
+        if path.stat().st_size == 0:
+            return None
+    except OSError:
+        return []
+    try:
+        records = _read_jsonl(path)
+    except OSError:
+        return []
+    return [
+        record for record in records
+        if isinstance(record, dict) and "event" in record
+    ]
+
+
+def _elastic_section(
+    telemetry_records: list[dict], supervisor_events: list[dict] | None
+) -> list[str]:
+    """Per-segment topology + aggregated goodput-per-dollar
+    (docs/resilience.md#elastic).
+
+    Two independent sources, each degrading on its own: `segment_topology`
+    / `exit` events from supervisor.jsonl (the per-segment worlds), and the
+    `elastic/segment`-tagged telemetry records (each segment's cumulative
+    goodput/cost gauges — the LAST record per segment is its total).
+    Omitted entirely for runs with nothing elastic to say: a single
+    unsupervised segment with no chip-price metadata renders no section."""
+    # last telemetry record per segment (cumulative gauges -> totals)
+    segments: dict[int, dict] = {}
+    for record in telemetry_records:
+        seg = record.get("elastic/segment")
+        if seg is None:
+            continue
+        try:
+            segments[int(float(seg))] = record
+        except (TypeError, ValueError):
+            continue
+    topology: dict[int, dict] = {}
+    exits: dict[int, dict] = {}
+    malformed_log = supervisor_events == []
+    for event in supervisor_events or ():
+        try:
+            attempt = int(event.get("attempt", 0))
+        except (TypeError, ValueError):
+            continue
+        if event.get("event") == "segment_topology":
+            topology[attempt] = event
+        elif event.get("event") == "exit":
+            exits[attempt] = event
+
+    has_cost = any("goodput/cost_dollars" in r for r in segments.values())
+    if not topology and not malformed_log and not (
+        has_cost or len(segments) > 1
+    ):
+        return []
+
+    lines = ["", "== Elastic =="]
+    if malformed_log:
+        lines.append(
+            "supervisor log present but unreadable — per-segment topology "
+            "unavailable"
+        )
+    attempts = sorted(set(topology) | set(segments))
+    for attempt in attempts:
+        parts = [f"segment #{attempt}:"]
+        event = topology.get(attempt)
+        record = segments.get(attempt, {})
+        chips = (
+            event.get("device_count") if event is not None
+            else record.get("goodput/chip_count")
+        )
+        # every field below may come from a foreign/corrupted-but-parseable
+        # log: degrade per field, never crash the report
+        try:
+            parts.append(f"{int(float(chips))} device(s)")
+        except (TypeError, ValueError):
+            pass
+        mesh = (event or {}).get("mesh")
+        if isinstance(mesh, dict) and mesh:
+            shown = [f"data={mesh.get('data', '?')}"]
+            for axis, size in sorted(mesh.items()):
+                try:
+                    if axis != "data" and int(size) != 1:
+                        shown.append(f"{axis}={size}")
+                except (TypeError, ValueError):
+                    continue
+            parts.append("mesh " + " ".join(shown))
+        if (event or {}).get("decision"):
+            parts.append(f"[{event['decision']}]")
+        runtime = exits.get(attempt, {}).get("runtime_s")
+        if runtime is None and "goodput/total_s" in record:
+            runtime = record["goodput/total_s"]
+        if runtime is not None:
+            try:
+                parts.append(f"runtime {float(runtime):,.1f}s")
+            except (TypeError, ValueError):
+                pass
+        if "goodput/cost_dollars" in record:
+            try:
+                parts.append(f"cost ${float(record['goodput/cost_dollars']):,.4f}")
+            except (TypeError, ValueError):
+                pass
+        exit_event = exits.get(attempt)
+        if exit_event is not None:
+            parts.append(
+                f"exit {exit_event.get('signal') or exit_event.get('rc')}"
+            )
+        lines.append("  ".join(parts))
+
+    def total(key: str) -> float | None:
+        values = []
+        for record in segments.values():
+            if key in record:
+                try:
+                    values.append(float(record[key]))
+                except (TypeError, ValueError):
+                    pass
+        return sum(values) if values else None
+
+    chip_hours = total("goodput/chip_hours")
+    if chip_hours is not None:
+        lines.append(
+            f"chip-time: {chip_hours:.4f} chip-hours across "
+            f"{len(segments)} segment(s)"
+        )
+    cost = total("goodput/cost_dollars")
+    productive = total("goodput/productive_chip_hours")
+    if cost is not None:
+        line = f"cost: ${cost:,.4f}"
+        if productive is not None and cost > 0:
+            line += (
+                f"  goodput-per-dollar: {productive / cost:.3f} "
+                "productive chip-hours / $"
+            )
+        lines.append(line)
+    elif segments or topology:
+        lines.append(
+            "cost: unavailable (no $/chip-hour — set LLMT_CHIP_PRICE_PER_HOUR "
+            "or trainer.resilience.elastic.price_per_chip_hour)"
+        )
+    return lines
+
+
 def _counter_section(title: str, rows: list[tuple[str, str]], telemetry: dict) -> list[str]:
     """An event-counter section: one `label: count` line per nonzero
     counter, the whole section omitted when nothing fired — a clean run's
@@ -376,7 +527,11 @@ def _resilience_section(telemetry: dict) -> list[str]:
     ], telemetry)
 
 
-def render_report(run_dir: str | Path, bench_dir: str | Path | None = None) -> str:
+def render_report(
+    run_dir: str | Path,
+    bench_dir: str | Path | None = None,
+    supervisor_log: str | Path | None = None,
+) -> str:
     run_dir = Path(run_dir)
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
     if not metrics:
@@ -472,15 +627,28 @@ def render_report(run_dir: str | Path, bench_dir: str | Path | None = None) -> s
     ])))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
+    lines.extend(_elastic_section(
+        telemetry_records,
+        _read_supervisor_events(
+            Path(supervisor_log) if supervisor_log
+            else run_dir / "supervisor.jsonl"
+        ),
+    ))
     lines.extend(_recovery_section(telemetry))
     lines.extend(_resilience_section(telemetry))
     return "\n".join(lines)
 
 
-def report_main(run_dir: str, bench_dir: str | None = None) -> int:
+def report_main(
+    run_dir: str,
+    bench_dir: str | None = None,
+    supervisor_log: str | None = None,
+) -> int:
     """`llm-training-tpu report <run_dir>` entry point."""
     try:
-        print(render_report(run_dir, bench_dir=bench_dir))
+        print(render_report(
+            run_dir, bench_dir=bench_dir, supervisor_log=supervisor_log
+        ))
     except FileNotFoundError as e:
         print(f"report: {e}", file=sys.stderr)
         return 2

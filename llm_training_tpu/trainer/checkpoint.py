@@ -277,6 +277,29 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self.manager.latest_step()
 
+    def read_meta(self, step: int | None = None) -> dict | None:
+        """The JSON metadata of `step` (newest when None) WITHOUT restoring
+        the array state — the elastic topology planner reads the recorded
+        mesh degrees before the mesh (and therefore the shardings the full
+        restore needs) exists. Read-only and failure-tolerant: any error
+        returns None (the planner then falls back to the config alone and
+        the real restore reports the problem with full context)."""
+        if step is None:
+            step = self.manager.latest_step()
+        if step is None:
+            return None
+        try:
+            restored = self.manager.restore(
+                step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+            )
+            return restored["meta"]
+        except Exception as e:
+            logger.warning(
+                "could not read checkpoint metadata for step %s in %s (%s)",
+                step, self.directory, e,
+            )
+            return None
+
     def wait(self) -> None:
         from llm_training_tpu.telemetry import get_registry
 

@@ -42,6 +42,7 @@ from llm_training_tpu.resilience import (
     PreemptionInterrupt,
     RecoveryManager,
     ResilienceConfig,
+    check_data_continuity,
     config_from_env,
     get_chaos,
     install_chaos,
@@ -203,6 +204,12 @@ class Trainer:
         # metadata of the checkpoint this fit restored from (callback state
         # + recovery riders come out of it); None on fresh starts
         self._restored_meta: dict | None = None
+        # elastic topology (resilience/elastic.py): the plan this fit's mesh
+        # came from (None with resilience.elastic unset) and the global
+        # batch size the data stream is keyed to (the checkpoint data_state
+        # rider — a resume must never change it)
+        self.topology_plan = None
+        self._global_batch_size: int | None = None
         # optimizer step of the newest in-loop interval save this fit (the
         # final-save epilogue skips re-saving an identical step)
         self._last_interval_save: int | None = None
@@ -487,6 +494,100 @@ class Trainer:
 
         return eval_step
 
+    # ------------------------------------------------------------ topology
+
+    def _mesh_axis_sizes(self) -> dict[str, int]:
+        """The live mesh's per-axis degrees — the ONE source both the
+        segment_topology audit event and the checkpoint `topology` rider
+        record (the planner pins model axes to the latter, so the two must
+        never drift)."""
+        return {
+            str(name): int(size)
+            for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
+        }
+
+    def _resolve_topology(self, resume_step: int | None = None):
+        """The elastic front door of fit: (devices, mesh_config, plan).
+
+        With `resilience.elastic` unset this only applies the chaos device
+        clamp (LLMT_CHAOS_DEVICES, a no-op unless the env var is set) and
+        returns the config mesh untouched. With it set, the planner fits
+        the mesh to the LIVE device pool: model axes pinned to the degrees
+        recorded in the checkpoint being resumed, the data axis scaled to
+        absorb the capacity change (resilience/elastic.py)."""
+        from llm_training_tpu.resilience.elastic import (
+            chaos_device_limit,
+            plan_topology,
+        )
+
+        cfg = self.config
+        devices = self.devices
+        if devices is None:
+            # the chaos shrink applies only to the default all-devices
+            # path: tests that pin an explicit subset stay authoritative
+            limit = chaos_device_limit()
+            if limit is not None:
+                devices = list(jax.devices())
+                if limit < len(devices):
+                    logger.warning(
+                        "chaos: shrinking visible devices %d -> %d "
+                        "(LLMT_CHAOS_DEVICES)", len(devices), limit,
+                    )
+                    devices = devices[:limit]
+        if cfg.resilience.elastic is None:
+            return devices, cfg.mesh, None
+        if devices is None:
+            devices = list(jax.devices())
+        checkpoint_mesh = None
+        checkpoint_batch = None
+        if self.checkpointer is not None:
+            meta = self.checkpointer.read_meta(resume_step)
+            checkpoint_mesh = ((meta or {}).get("topology") or {}).get("mesh")
+            checkpoint_batch = ((meta or {}).get("data_state") or {}).get(
+                "global_batch_size"
+            )
+        plan = plan_topology(
+            len(devices),
+            cfg.mesh.axis_sizes(),
+            checkpoint_mesh=checkpoint_mesh,
+            global_batch_size=checkpoint_batch,
+        )
+        logger.info(
+            "elastic topology: %s over %d device(s) [%s, from %s]",
+            plan.axis_sizes, plan.device_count, plan.decision, plan.source,
+        )
+        return (
+            devices[: plan.device_count],
+            MeshConfig.from_axis_sizes(plan.axis_sizes),
+            plan,
+        )
+
+    def _publish_topology(self, plan) -> None:
+        """Tag this segment with its world: goodput cost basis (chip count
+        + $/chip-hour -> goodput-per-dollar gauges), elastic/* telemetry,
+        and — under a supervisor — a segment_topology event in
+        supervisor.jsonl keyed by the launch attempt."""
+        from llm_training_tpu.resilience.elastic import (
+            log_segment_topology,
+            resolve_chip_price,
+            segment_attempt,
+        )
+
+        chips = int(self.mesh.devices.size)
+        price = resolve_chip_price(self.config.resilience.elastic)
+        self.ledger.set_cost_basis(chips, price)
+        self.telemetry.gauge("elastic/segment").set(segment_attempt())
+        self.telemetry.gauge("elastic/device_count").set(chips)
+        self.telemetry.gauge("elastic/data_parallel_size").set(
+            int(self.mesh.shape["data"])
+        )
+        log_segment_topology(
+            self._mesh_axis_sizes(),
+            chips,
+            decision=plan.decision if plan is not None else "static mesh",
+            price_per_chip_hour=price,
+        )
+
     # ------------------------------------------------------------ fit
 
     def fit(
@@ -497,13 +598,16 @@ class Trainer:
         state: TrainState | None = None,
     ) -> TrainState:
         cfg = self.config
-        self.mesh = build_mesh(cfg.mesh, self.devices)
+        devices, mesh_config, plan = self._resolve_topology(resume_step)
+        self.mesh = build_mesh(mesh_config, devices)
+        self.topology_plan = plan
         datamodule.setup()
 
         # fresh telemetry per fit, installed as the process-current registry
         # so components constructed elsewhere (the checkpointer) find it
         self.telemetry = TelemetryRegistry()
         self.ledger.start()
+        self._publish_topology(plan)
         previous_registry = set_registry(self.telemetry)
         resil = cfg.resilience
         self._preempted = False
@@ -553,6 +657,7 @@ class Trainer:
 
         dp_ways = self.mesh.shape["data"] * self.mesh.shape["fsdp"]
         batch_size = next(iter(sample_batch.values())).shape[0]
+        self._global_batch_size = batch_size
         if batch_size % dp_ways != 0:
             # the reference's world-size divisibility assert (fsdp2_strategy.py:185-191)
             raise ValueError(
@@ -633,6 +738,25 @@ class Trainer:
                 state, meta = restored
                 self.counters.update(meta.get("counters", {}))
                 self._restored_meta = meta
+                # elastic data contract (docs/resilience.md#elastic): a
+                # resume may change the replica count, never the global
+                # batch the (seed, step) sample stream is keyed to — raise
+                # under elastic, warn on the legacy path
+                check_data_continuity(
+                    meta.get("data_state"), batch_size,
+                    elastic=cfg.resilience.elastic is not None,
+                )
+                if self.topology_plan is not None:
+                    # the planner may have fallen back to the config (meta
+                    # read failed, or restore fell back to an older step):
+                    # never let orbax reshard model axes silently
+                    from llm_training_tpu.resilience.elastic import (
+                        verify_restored_topology,
+                    )
+
+                    verify_restored_topology(
+                        self.topology_plan, meta.get("topology")
+                    )
                 # callback state riders (NanGuard EMA/z-score trackers):
                 # without this every resume restarts the spike detector's
                 # warmup blind — right when spikes are most likely
@@ -1279,11 +1403,33 @@ class Trainer:
     def _save_extra(self) -> dict:
         """JSON-serializable checkpoint-metadata riders: the recovery
         skip-list/cooldown windows (a resumed run must replay the same
-        skips) and every callback's `state_dict` (NanGuard's EMA/z-score
-        trackers and counters)."""
+        skips), the live topology + data-stream cursor (what an elastic
+        relaunch plans its new mesh against — docs/resilience.md#elastic),
+        and every callback's `state_dict` (NanGuard's EMA/z-score trackers
+        and counters)."""
         extra: dict = {}
         if self._recovery is not None:
             extra["recovery"] = self._recovery.metadata()
+        if self.mesh is not None:
+            extra["topology"] = {
+                "device_count": int(self.mesh.devices.size),
+                "mesh": self._mesh_axis_sizes(),
+            }
+            if self._global_batch_size:
+                micro = (self.last_step or 0) * self.config.accumulate_grad_batches
+                dp_ways = int(self.mesh.shape["data"]) * int(self.mesh.shape["fsdp"])
+                extra["data_state"] = {
+                    # the stream key an elastic resume must hold fixed
+                    "global_batch_size": int(self._global_batch_size),
+                    # samples drawn from the global stream so far: the
+                    # cursor is step-derived, NOT replica-derived, which is
+                    # exactly why a DP resize replays the same stream
+                    "sample_cursor": micro * int(self._global_batch_size),
+                    # rows each data-parallel shard served under THIS
+                    # topology (informational: the next segment derives its
+                    # own stride from the same global batch)
+                    "replica_stride": int(self._global_batch_size) // dp_ways,
+                }
         cb_state: dict = {}
         for cb in self.callbacks:
             fn = getattr(cb, "state_dict", None)

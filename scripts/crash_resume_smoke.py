@@ -22,6 +22,14 @@ config; every leg asserts on EXACT exit codes (docs/resilience.md#exit-codes):
    death) must be relaunched by the `supervise` subcommand, resume past
    its checkpoint, and complete with exit 0 and a restart event in
    `supervisor.jsonl`.
+8. **Elastic** (docs/resilience.md#elastic) — kill on 8 simulated devices,
+   resume on 4 (`LLMT_CHAOS_DEVICES=8,4`, indexed by supervisor attempt):
+   the run must complete under `supervise` (with the capacity probe
+   passing), both segments must log their topology to `supervisor.jsonl`
+   (data=8 then data=4 with a "scaled data" planner decision), the
+   post-resume losses must match a clean same-seed run on the shrunken
+   4-device topology, and `report` must render `== Elastic ==` with both
+   segments and an aggregated goodput-per-dollar figure.
 
 Plus a watchdog leg: a forced stall must produce a `hang-dump-*.txt` with
 every thread's stack.
@@ -263,6 +271,117 @@ def main(scratch_arg: str) -> int:
             )
     print("OK leg 7: child SIGKILLed at step 3, supervisor restarted it, "
           "resumed run completed with baseline-identical losses")
+
+    # -------- leg 8: elastic kill -> shrink -> resume -------------------
+    # segment 1 runs on 8 simulated devices (XLA host-platform override in
+    # the child env) and is SIGKILLed at step 3 after its step-2 checkpoint;
+    # the supervisor probes capacity, relaunches, and the chaos device
+    # schedule hands the relaunch only 4 devices — the topology planner
+    # must scale data 8->4 and the resumed stream must match a clean
+    # same-seed run on the shrunken topology (docs/resilience.md#elastic)
+    import contextlib
+    import io
+    import subprocess
+
+    elastic_env = {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "LLMT_CHAOS_DEVICES": "8,4",   # attempt 1 -> 8 devices, attempt 2+ -> 4
+        "LLMT_CHIP_PRICE_PER_HOUR": "3.0",
+    }
+    saved_env = {k: os.environ.get(k) for k in elastic_env}
+    os.environ.update(elastic_env)
+    try:
+        elastic_config = _config(
+            scratch, "elastic", async_save=False, checkpoint_every_n_steps=2,
+            mesh={"data_parallel_size": -1, "fsdp_size": 1},
+            resilience={"chaos": {"sigkill_step": 3}, "elastic": {}},
+        )
+        elastic_log = scratch / "elastic" / "supervisor.jsonl"
+        rc = cli_main([
+            "supervise", "--config", str(elastic_config),
+            "--max-restarts", "2", "--backoff-base-s", "0",
+            "--min-devices", "2", "--probe-backoff-s", "0.5",
+            "--probe-max-wait-s", "60",
+            "--log", str(elastic_log),
+        ])
+        if rc != 0:
+            return _fail(f"elastic supervise exited {rc}")
+        events = [json.loads(line)
+                  for line in elastic_log.read_text().splitlines()]
+        topos = {e["attempt"]: e for e in events
+                 if e["event"] == "segment_topology"}
+        probes = [e for e in events if e["event"] == "probe"]
+        if sorted(topos) != [1, 2] or not probes:
+            return _fail(f"supervisor.jsonl lacks segment topology/probe "
+                         f"events: {events}")
+        if (topos[1]["device_count"], topos[2]["device_count"]) != (8, 4):
+            return _fail(f"segment device counts not 8->4: {topos}")
+        if (topos[1]["mesh"]["data"], topos[2]["mesh"]["data"]) != (8, 4):
+            return _fail(f"segment data degrees not 8->4: {topos}")
+        if "scaled data 8->4" not in topos[2].get("decision", ""):
+            return _fail(f"relaunch planner decision missing: {topos[2]}")
+        elastic_losses = _losses(scratch, "elastic")
+        if sorted(elastic_losses) != list(range(1, MAX_STEPS + 1)):
+            return _fail(f"elastic run logged steps {sorted(elastic_losses)}")
+
+        # clean same-seed run on the shrunken 4-device topology (a real
+        # subprocess: THIS process's jax backend is already pinned to its
+        # own device count)
+        clean_config = _config(
+            scratch, "elastic-clean", async_save=False,
+            mesh={"data_parallel_size": -1, "fsdp_size": 1},
+            resilience={"elastic": {}},
+        )
+        clean = subprocess.run(
+            [sys.executable, "-m", "llm_training_tpu", "fit",
+             "--config", str(clean_config)],
+            env={**os.environ, "LLMT_CHAOS_DEVICES": "4"},
+            capture_output=True, text=True, timeout=600,
+        )
+        if clean.returncode != 0:
+            return _fail(f"clean shrunken-topology fit exited "
+                         f"{clean.returncode}: {clean.stderr[-500:]}")
+        clean_losses = _losses(scratch, "elastic-clean")
+        # the SIGKILL hit at step 3 after the step-2 checkpoint: steps 3..6
+        # are the post-resume (4-device) segment. rtol mirrors
+        # test_cross_topology_resume: the two runs' steps 1-2 executed on
+        # DIFFERENT meshes (data=8 vs data=4), so fp32 reduction-order
+        # noise compounds into the resumed state — 5e-5 is ~50x that floor
+        # yet far below any real restore/planner bug
+        for step in range(3, MAX_STEPS + 1):
+            if abs(elastic_losses[step] - clean_losses[step]) > 5e-5 * abs(
+                clean_losses[step]
+            ):
+                return _fail(
+                    f"elastic resume diverged from the clean 4-device run "
+                    f"at step {step}: {elastic_losses[step]} vs "
+                    f"{clean_losses[step]}"
+                )
+
+        # report must render the churn: both segments' topologies plus the
+        # aggregated goodput-per-dollar figure
+        buffer = io.StringIO()
+        with contextlib.redirect_stdout(buffer):
+            rc = cli_main([
+                "report", str(scratch / "smoke" / "elastic"),
+                "--supervisor-log", str(elastic_log),
+            ])
+        rendered = buffer.getvalue()
+        if rc != 0:
+            return _fail(f"report over the elastic run exited {rc}")
+        for needle in ("== Elastic ==", "segment #1:", "segment #2:",
+                       "8 device(s)", "4 device(s)", "goodput-per-dollar"):
+            if needle not in rendered:
+                return _fail(f"elastic report missing {needle!r}:\n{rendered}")
+    finally:
+        for key, value in saved_env.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+    print("OK leg 8: kill on 8 devices -> supervise probe -> resume on 4 "
+          "(data 8->4), losses match the clean shrunken-topology run, "
+          "report renders == Elastic == with goodput-per-dollar")
 
     # -------- watchdog: forced stall produces a stack dump -------------
     import queue

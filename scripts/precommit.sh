@@ -87,8 +87,11 @@ JAX_PLATFORMS=cpu python scripts/force_nan_smoke.py "${SMOKE_ROOT}/nan-smoke"
 # checkpoint I/O error retried; corrupt latest checkpoint falls back on
 # restore; injected loss spike exits with exactly 77 (the documented
 # divergence code); a child SIGKILLed mid-fit is relaunched by `supervise`
-# and completes; a forced stall produces the watchdog's thread-stack dump
-echo "== precommit: kill-and-resume + supervise smoke =="
+# and completes; ELASTIC: a child killed on 8 simulated devices resumes on
+# 4 (LLMT_CHAOS_DEVICES=8,4), the planner scales data 8->4, losses match a
+# clean shrunken-topology run, and report renders == Elastic == with
+# goodput-per-dollar; a forced stall produces the watchdog's stack dump
+echo "== precommit: kill-and-resume + supervise + elastic smoke =="
 JAX_PLATFORMS=cpu python scripts/crash_resume_smoke.py "${SMOKE_ROOT}/resilience"
 
 # bench harness gate (docs/performance.md): the full stage/subprocess/

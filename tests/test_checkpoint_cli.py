@@ -93,7 +93,16 @@ def test_cross_topology_resume(devices, tmp_path):
     """VERDICT r3 #8 (reference DCP restore, fsdp2_strategy.py:395-409):
     a checkpoint written on a {fsdp:4, tensor:2} mesh must restore onto a
     pure {fsdp:8} mesh — orbax reshards against the new target shardings —
-    and continue EXACTLY like a same-topology resume."""
+    and continue EXACTLY like a same-topology resume.
+
+    This is the EXPLICIT model-axis reshard path (the user changed the
+    mesh config on purpose). The elastic planner (resilience/elastic.py,
+    `trainer.resilience.elastic`) deliberately refuses to do this
+    implicitly — it pins model axes to the checkpoint's degrees and scales
+    only `data`; tests/test_elastic.py covers that path. De-flake history:
+    the original rtol=1e-6 straddled the cross-mesh fp32 reduction-order
+    noise floor (missed by ~1.1e-6); PR 4 widened it to the justified
+    5e-5 bound below."""
     from llm_training_tpu.parallel import MeshConfig
 
     ckpt_dir = str(tmp_path / "xtopo")

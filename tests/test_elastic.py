@@ -1,0 +1,657 @@
+"""Elastic training: topology planner, DP-resize data continuity, chaos
+device shrink, supervisor capacity renegotiation, goodput-per-dollar, and
+the == Elastic == report section (docs/resilience.md#elastic).
+
+Everything here is host-side and fast (tier-1); the end-to-end
+kill→shrink→resume proof is `scripts/crash_resume_smoke.py` leg 8 and the
+slow fit test at the bottom.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from llm_training_tpu.resilience.elastic import (
+    ATTEMPT_ENV,
+    CHAOS_DEVICES_ENV,
+    CHIP_PRICE_ENV,
+    SUPERVISOR_LOG_ENV,
+    ElasticConfig,
+    ElasticTopologyError,
+    chaos_device_limit,
+    check_data_continuity,
+    log_segment_topology,
+    plan_topology,
+    resolve_chip_price,
+    segment_attempt,
+)
+
+SIZES_1 = {"pipe": 1, "fsdp": 1, "expert": 1, "tensor": 1, "sequence": 1}
+
+
+# ---------------------------------------------------------------- planner
+
+
+def test_planner_scales_data_down_on_shrink():
+    plan = plan_topology(
+        4, {"data": -1, **SIZES_1}, checkpoint_mesh={"data": 8, **SIZES_1}
+    )
+    assert plan.axis_sizes["data"] == 4
+    assert plan.device_count == 4 and plan.spare_devices == 0
+    assert "scaled data 8->4" in plan.decision
+    assert plan.source == "checkpoint"
+
+
+def test_planner_scales_data_up_on_growth():
+    plan = plan_topology(
+        8, {"data": 2, **SIZES_1}, checkpoint_mesh={"data": 2, **SIZES_1}
+    )
+    assert plan.axis_sizes["data"] == 8
+    assert "scaled data 2->8" in plan.decision
+
+
+def test_planner_keeps_model_axes_fixed():
+    ckpt = {"data": 4, "pipe": 1, "fsdp": 2, "expert": 1, "tensor": 2, "sequence": 1}
+    plan = plan_topology(8, {"data": -1, **{k: v for k, v in ckpt.items() if k != "data"}},
+                         checkpoint_mesh=ckpt)
+    assert plan.axis_sizes["fsdp"] == 2 and plan.axis_sizes["tensor"] == 2
+    assert plan.axis_sizes["data"] == 2  # 8 // (2*2)
+
+
+def test_planner_refuses_when_model_axes_cannot_fit():
+    with pytest.raises(ElasticTopologyError, match="scales only"):
+        plan_topology(
+            4, {"data": 1, "fsdp": -1}, checkpoint_mesh={"data": 1, "fsdp": 8}
+        )
+
+
+def test_planner_refuses_config_model_axis_conflict():
+    # the user explicitly changed a model axis mid-run: elastic never
+    # reshards model axes behind their back
+    with pytest.raises(ElasticTopologyError, match="keeps the model axes"):
+        plan_topology(8, {"data": 1, "fsdp": 4}, checkpoint_mesh={"fsdp": 8})
+
+
+def test_planner_spare_devices_are_dropped_not_fatal():
+    plan = plan_topology(
+        7, {"data": -1, "fsdp": 2}, checkpoint_mesh={"data": 4, "fsdp": 2}
+    )
+    assert plan.axis_sizes == {"data": 3, "pipe": 1, "fsdp": 2, "expert": 1,
+                               "tensor": 1, "sequence": 1}
+    assert plan.device_count == 6 and plan.spare_devices == 1
+    assert "spare" in plan.decision
+
+
+def test_planner_fresh_start_fills_auto_model_axis():
+    # the default MeshConfig posture (fsdp=-1) on a fresh start resolves
+    # classically; later resumes pin the filled degree via the checkpoint
+    plan = plan_topology(8, {"data": 1, "fsdp": -1})
+    assert plan.axis_sizes["fsdp"] == 8 and plan.axis_sizes["data"] == 1
+    assert plan.source == "config"
+
+
+def test_planner_fresh_start_scales_explicit_data_to_fit():
+    plan = plan_topology(8, {"data": 2, **SIZES_1})
+    assert plan.axis_sizes["data"] == 8
+    assert "scaled data 2->8" in plan.decision
+
+
+def test_planner_no_devices_refuses():
+    with pytest.raises(ElasticTopologyError):
+        plan_topology(0, {"data": -1, **SIZES_1})
+
+
+def test_planner_refuses_two_auto_axes():
+    # the classic resolver rejects this config; elastic must not widen the
+    # set of accepted-but-misinterpreted meshes
+    with pytest.raises(ElasticTopologyError, match="at most one"):
+        plan_topology(8, {"data": -1, "fsdp": -1})
+
+
+def test_planner_clamps_data_to_divide_the_global_batch():
+    # 6 chips come back for a batch of 8: data=6 would die in fit's
+    # divisibility check every relaunch — plan data=4 (spare 2) instead
+    plan = plan_topology(
+        6, {"data": -1, **SIZES_1},
+        checkpoint_mesh={"data": 8, **SIZES_1}, global_batch_size=8,
+    )
+    assert plan.axis_sizes["data"] == 4
+    assert plan.device_count == 4 and plan.spare_devices == 2
+    assert "divide the global batch" in plan.decision
+
+
+def test_planner_leaves_data_alone_when_no_degree_divides():
+    # batch % fsdp != 0: no data degree can fix it — fit's own check must
+    # report the real problem, so the planner doesn't mask it
+    plan = plan_topology(
+        4, {"data": -1, "fsdp": 3},
+        checkpoint_mesh={"data": 1, "fsdp": 3}, global_batch_size=8,
+    )
+    assert plan.axis_sizes["data"] == 1  # 4 // 3, unclamped
+
+
+def test_verify_restored_topology_guards_model_axes():
+    from llm_training_tpu.resilience.elastic import verify_restored_topology
+
+    plan = plan_topology(
+        4, {"data": -1, **SIZES_1}, checkpoint_mesh={"data": 8, **SIZES_1}
+    )
+    # data-axis change is THE elastic change; pre-elastic meta passes
+    verify_restored_topology(plan, {"mesh": {"data": 8, **SIZES_1}})
+    verify_restored_topology(plan, None)
+    verify_restored_topology(plan, {})
+    # a model-axis difference (planner fell back to config, restore then
+    # succeeded) must refuse instead of resharding silently
+    with pytest.raises(ElasticTopologyError, match="model axes differ"):
+        verify_restored_topology(
+            plan, {"mesh": {"data": 8, **{**SIZES_1, "fsdp": 2}}}
+        )
+
+
+# ------------------------------------------------------------ chaos shrink
+
+
+def test_chaos_device_limit_single_value(monkeypatch):
+    monkeypatch.setenv(CHAOS_DEVICES_ENV, "5")
+    assert chaos_device_limit(1) == 5
+    assert chaos_device_limit(7) == 5  # single value clamps every launch
+
+
+def test_chaos_device_limit_schedule_indexed_by_attempt(monkeypatch):
+    monkeypatch.setenv(CHAOS_DEVICES_ENV, "8,4")
+    assert chaos_device_limit(1) == 8
+    assert chaos_device_limit(2) == 4
+    assert chaos_device_limit(9) == 4  # clamps to the last entry
+    monkeypatch.setenv(ATTEMPT_ENV, "2")
+    assert chaos_device_limit() == 4  # attempt defaults to the env
+
+
+def test_chaos_device_limit_absent_and_malformed(monkeypatch):
+    monkeypatch.delenv(CHAOS_DEVICES_ENV, raising=False)
+    assert chaos_device_limit() is None
+    monkeypatch.setenv(CHAOS_DEVICES_ENV, "lots")
+    assert chaos_device_limit() is None  # typo must not kill a run
+    monkeypatch.setenv(CHAOS_DEVICES_ENV, "0")
+    assert chaos_device_limit() is None
+
+
+def test_segment_attempt_defaults_and_parses(monkeypatch):
+    monkeypatch.delenv(ATTEMPT_ENV, raising=False)
+    assert segment_attempt() == 1
+    monkeypatch.setenv(ATTEMPT_ENV, "3")
+    assert segment_attempt() == 3
+    monkeypatch.setenv(ATTEMPT_ENV, "junk")
+    assert segment_attempt() == 1
+
+
+# ------------------------------------------------------------ chip price
+
+
+def test_chip_price_env_overrides_config(monkeypatch):
+    monkeypatch.setenv(CHIP_PRICE_ENV, "4.2")
+    assert resolve_chip_price(ElasticConfig(price_per_chip_hour=1.0)) == 4.2
+    monkeypatch.delenv(CHIP_PRICE_ENV)
+    assert resolve_chip_price(ElasticConfig(price_per_chip_hour=1.0)) == 1.0
+    assert resolve_chip_price(ElasticConfig()) is None
+    assert resolve_chip_price(None) is None
+    monkeypatch.setenv(CHIP_PRICE_ENV, "not-a-price")
+    assert resolve_chip_price(None) is None
+
+
+# ------------------------------------------------------ data continuity
+
+
+def test_check_data_continuity_accepts_dp_resize():
+    # same global batch, different replica stride: the stream is identical
+    check_data_continuity(
+        {"global_batch_size": 8, "replica_stride": 1}, 8, elastic=True
+    )
+    check_data_continuity(None, 8, elastic=True)
+    check_data_continuity({}, 8, elastic=True)
+
+
+def test_check_data_continuity_refuses_global_batch_change():
+    with pytest.raises(ValueError, match="GLOBAL batch size 16 -> 8"):
+        check_data_continuity({"global_batch_size": 16}, 8, elastic=True)
+    # legacy (elastic off): warn, don't raise — historical behavior
+    check_data_continuity({"global_batch_size": 16}, 8, elastic=False)
+
+
+def _datamodule(batch_size=8):
+    from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+
+    dm = DummyDataModule(DummyDataModuleConfig(
+        batch_size=batch_size, max_length=8, num_samples=48, vocab_size=64,
+    ))
+    dm.setup()
+    return dm
+
+
+@pytest.mark.parametrize("dp_size", [1, 2, 4])
+def test_replica_streams_concatenate_to_the_global_stream(dp_size):
+    """The elastic data contract (ISSUE 8 satellite): the concatenated
+    global sample stream is IDENTICAL for dp=1/2/4 given the same seed,
+    cursor, and skip windows — the (seed, step) → sample mapping never
+    depends on the replica count."""
+    from llm_training_tpu.resilience import DataSkipList
+
+    steps, start = 10, 3  # cursor: resume mid-epoch
+    windows = DataSkipList(windows=[(4, 2)], reserve=2)
+
+    def take(stream, n):
+        return [next(stream) for _ in range(n)]
+
+    reference = take(_datamodule().train_batches(
+        start_step=start, skip_list=DataSkipList(windows=[(4, 2)], reserve=2)
+    ), steps)
+    replicas = [
+        take(_datamodule().replica_batches(
+            rank, dp_size, start_step=start,
+            skip_list=DataSkipList(windows=[(4, 2)], reserve=2),
+        ), steps)
+        for rank in range(dp_size)
+    ]
+    for step in range(steps):
+        for key in reference[step]:
+            rebuilt = np.concatenate(
+                [replicas[rank][step][key] for rank in range(dp_size)], axis=0
+            )
+            np.testing.assert_array_equal(
+                rebuilt, reference[step][key],
+                err_msg=f"step {step} key {key} dp={dp_size}",
+            )
+
+
+def test_replica_batches_validates_rank_and_divisibility():
+    dm = _datamodule(batch_size=8)
+    with pytest.raises(ValueError, match="not divisible"):
+        next(dm.replica_batches(0, 3))
+    with pytest.raises(ValueError, match="outside"):
+        next(dm.replica_batches(4, 4))
+    with pytest.raises(ValueError, match="dp_size"):
+        next(dm.replica_batches(0, 0))
+
+
+# ---------------------------------------------------------- ledger cost
+
+
+def test_ledger_cost_basis_gauges():
+    from llm_training_tpu.telemetry import GoodputLedger
+
+    t = [0.0]
+    ledger = GoodputLedger(clock=lambda: t[0])
+    ledger.start()
+    with ledger.measure("step_compute"):
+        t[0] += 30.0
+    t[0] += 30.0  # other
+    base = ledger.summary()
+    assert "goodput/chip_count" not in base  # schema unchanged w/o basis
+
+    ledger.set_cost_basis(4, price_per_chip_hour=3.0)
+    summary = ledger.summary()
+    # 60s total on 4 chips at $3/chip-hour
+    assert summary["goodput/chip_count"] == 4.0
+    assert summary["goodput/chip_hours"] == pytest.approx(60 * 4 / 3600)
+    assert summary["goodput/productive_chip_hours"] == pytest.approx(30 * 4 / 3600)
+    assert summary["goodput/cost_dollars"] == pytest.approx(0.2)
+    # productive chip-hours per dollar = goodput_pct/100/price = 0.5/3
+    assert summary["goodput/goodput_per_dollar"] == pytest.approx(0.5 / 3.0)
+
+    ledger.set_cost_basis(4, price_per_chip_hour=None)
+    summary = ledger.summary()
+    assert "goodput/chip_hours" in summary
+    assert "goodput/cost_dollars" not in summary  # no invented prices
+
+
+# -------------------------------------------------------- audit trail
+
+
+def test_log_segment_topology_appends_to_env_path(tmp_path, monkeypatch):
+    log = tmp_path / "supervisor.jsonl"
+    monkeypatch.setenv(SUPERVISOR_LOG_ENV, str(log))
+    monkeypatch.setenv(ATTEMPT_ENV, "2")
+    record = log_segment_topology(
+        {"data": 4, "fsdp": 1}, 4, decision="scaled data 8->4",
+        price_per_chip_hour=3.0,
+    )
+    assert record["attempt"] == 2 and record["device_count"] == 4
+    events = [json.loads(line) for line in log.read_text().splitlines()]
+    assert events[-1]["event"] == "segment_topology"
+    assert events[-1]["mesh"] == {"data": 4, "fsdp": 1}
+    assert events[-1]["decision"] == "scaled data 8->4"
+
+
+def test_log_segment_topology_noop_without_target(monkeypatch):
+    monkeypatch.delenv(SUPERVISOR_LOG_ENV, raising=False)
+    assert log_segment_topology({"data": 1}, 1) is None
+
+
+# ---------------------------------------------------------- supervisor
+
+
+def _supervisor(probe_values, min_devices=2, max_wait=100.0, rcs=(75, 0),
+                log=None):
+    from llm_training_tpu.resilience import Supervisor, SupervisorConfig
+
+    codes = list(rcs)
+    probes = list(probe_values)
+    launches = []
+    clock = [0.0]
+
+    def run_child(argv):
+        launches.append(dict(sup.env))
+        return codes.pop(0)
+
+    sup = Supervisor(
+        ["fit"],
+        SupervisorConfig(
+            max_restarts=5, backoff_base_s=0.0, min_devices=min_devices,
+            probe_backoff_s=1.0, probe_max_wait_s=max_wait,
+            log_path=str(log) if log else None,
+        ),
+        run_child=run_child,
+        probe=lambda: probes.pop(0) if probes else None,
+        sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+        clock=lambda: clock[0],
+    )
+    return sup, launches
+
+
+def test_supervisor_waits_for_capacity_then_relaunches(tmp_path):
+    log = tmp_path / "sup.jsonl"
+    sup, launches = _supervisor([1, 1, 4], log=log)
+    assert sup.run() == 0
+    assert len(launches) == 2
+    events = [json.loads(line) for line in log.read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert kinds.count("probe") == 3
+    assert kinds.count("capacity_wait") == 2
+    # children (and probes) see the attempt they are
+    assert launches[0][ATTEMPT_ENV] == "1"
+    assert launches[1][ATTEMPT_ENV] == "2"
+    # children learn where the churn log lives
+    assert launches[0][SUPERVISOR_LOG_ENV].endswith("sup.jsonl")
+
+
+def test_supervisor_log_env_overrides_stale_parent_value(tmp_path, monkeypatch):
+    # children belong to THIS supervisor: an inherited LLMT_SUPERVISOR_LOG
+    # from an outer wrapper or previous run must not win over --log
+    monkeypatch.setenv(SUPERVISOR_LOG_ENV, "/stale/other-run.jsonl")
+    log = tmp_path / "mine.jsonl"
+    sup, launches = _supervisor([4], log=log)
+    assert sup.run() == 0
+    assert launches[0][SUPERVISOR_LOG_ENV] == str(log.absolute())
+
+
+def test_supervisor_gives_up_below_min_devices():
+    sup, launches = _supervisor([1, 1, 1, 1], max_wait=2.5, rcs=(75, 0))
+    assert sup.run() == 75  # the child's code propagates
+    assert len(launches) == 1
+    giveups = [e for e in sup.events if e["event"] == "giveup"]
+    assert giveups and "insufficient devices" in giveups[0]["reason"]
+
+
+def test_supervisor_unknowable_probe_proceeds():
+    # a broken probe must not park the relaunch forever
+    sup, launches = _supervisor([None], rcs=(75, 0))
+    assert sup.run() == 0
+    assert len(launches) == 2
+
+
+def test_supervisor_no_min_devices_skips_probing():
+    probes = []
+    sup, launches = _supervisor(probes, min_devices=None, rcs=(75, 0))
+    assert sup.run() == 0
+    assert len(launches) == 2  # never consumed a probe
+
+
+# -------------------------------------------------------------- report
+
+
+def _write_run(tmp_path, telemetry_records, supervisor_events=None,
+               supervisor_text=None):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir(exist_ok=True)
+    (run_dir / "metrics.jsonl").write_text(
+        "\n".join(json.dumps({"step": i + 1, "loss": 1.0,
+                              "steps_per_sec": 1.0})
+                  for i in range(len(telemetry_records))) + "\n"
+    )
+    (run_dir / "telemetry.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in telemetry_records) + "\n"
+    )
+    if supervisor_text is not None:
+        (run_dir / "supervisor.jsonl").write_text(supervisor_text)
+    elif supervisor_events is not None:
+        (run_dir / "supervisor.jsonl").write_text(
+            "\n".join(json.dumps(e) for e in supervisor_events) + "\n"
+        )
+    return run_dir
+
+
+def _segment_record(step, segment, chips, cost=None, productive=None):
+    record = {
+        "step": step,
+        "elastic/segment": segment,
+        "goodput/total_s": 10.0 * step,
+        "goodput/chip_count": float(chips),
+        "goodput/chip_hours": 10.0 * step * chips / 3600,
+    }
+    if cost is not None:
+        record["goodput/cost_dollars"] = cost
+        record["goodput/productive_chip_hours"] = productive
+    return record
+
+
+def test_report_elastic_section_renders_segments_and_gpd(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    events = [
+        {"event": "launch", "attempt": 1},
+        {"event": "segment_topology", "attempt": 1, "device_count": 8,
+         "mesh": {"data": 8, "fsdp": 1}, "decision": "fresh start: data=8"},
+        {"event": "exit", "attempt": 1, "rc": -9, "signal": "SIGKILL",
+         "runtime_s": 12.0},
+        {"event": "segment_topology", "attempt": 2, "device_count": 4,
+         "mesh": {"data": 4, "fsdp": 1}, "decision": "scaled data 8->4"},
+        {"event": "exit", "attempt": 2, "rc": 0, "runtime_s": 20.0},
+    ]
+    records = [
+        _segment_record(2, 1, 8, cost=0.1, productive=0.02),
+        _segment_record(6, 2, 4, cost=0.2, productive=0.08),
+    ]
+    out = render_report(_write_run(tmp_path, records, events))
+    assert "== Elastic ==" in out
+    assert "segment #1:" in out and "8 device(s)" in out
+    assert "segment #2:" in out and "scaled data 8->4" in out
+    assert "exit SIGKILL" in out
+    assert "cost: $0.3" in out
+    # (0.02 + 0.08) / (0.1 + 0.2)
+    assert "goodput-per-dollar: 0.333" in out
+
+
+def test_report_elastic_degrades_without_price(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    events = [
+        {"event": "segment_topology", "attempt": 1, "device_count": 8,
+         "mesh": {"data": 8}},
+        {"event": "segment_topology", "attempt": 2, "device_count": 4,
+         "mesh": {"data": 4}},
+    ]
+    records = [_segment_record(2, 1, 8), _segment_record(6, 2, 4)]
+    out = render_report(_write_run(tmp_path, records, events))
+    assert "== Elastic ==" in out
+    assert "cost: unavailable" in out and "LLMT_CHIP_PRICE_PER_HOUR" in out
+
+
+def test_report_elastic_degrades_on_malformed_supervisor_log(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    records = [_segment_record(2, 1, 8), _segment_record(6, 2, 4)]
+    out = render_report(_write_run(
+        tmp_path, records, supervisor_text="{torn json\nnot a record\n"
+    ))
+    assert "== Elastic ==" in out
+    assert "unreadable" in out  # one honest line, no crash
+
+
+def test_report_elastic_omitted_for_plain_runs(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    # one segment, no price, no supervisor log: nothing elastic to say
+    records = [_segment_record(4, 1, 8)]
+    out = render_report(_write_run(tmp_path, records))
+    assert "== Elastic ==" not in out
+
+
+def test_report_elastic_aggregates_without_supervisor_log(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    records = [
+        _segment_record(2, 1, 8, cost=0.1, productive=0.02),
+        _segment_record(6, 2, 4, cost=0.2, productive=0.08),
+    ]
+    out = render_report(_write_run(tmp_path, records))
+    assert "== Elastic ==" in out
+    assert "goodput-per-dollar" in out
+
+
+def test_report_elastic_ignores_empty_supervisor_log(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    # a zero-byte log (supervisor killed before its first event) says
+    # nothing: a plain run must stay section-free, not claim corruption
+    records = [_segment_record(4, 1, 8)]
+    out = render_report(_write_run(tmp_path, records, supervisor_text=""))
+    assert "== Elastic ==" not in out
+
+
+def test_report_elastic_survives_non_numeric_event_fields(tmp_path):
+    from llm_training_tpu.telemetry.report import render_report
+
+    # valid-JSON but foreign/corrupt fields must degrade per field, not
+    # crash the whole report
+    events = [
+        {"event": "segment_topology", "attempt": 1, "device_count": "junk",
+         "mesh": {"data": "x", "fsdp": None}},
+        {"event": "segment_topology", "attempt": 2, "device_count": 4,
+         "mesh": {"data": 4, "fsdp": 1}},
+    ]
+    records = [_segment_record(2, 1, 8), _segment_record(6, 2, 4)]
+    out = render_report(_write_run(tmp_path, records, events))
+    assert "== Elastic ==" in out
+    assert "segment #2:" in out and "4 device(s)" in out
+
+
+# ------------------------------------------------------------- config
+
+
+def test_elastic_config_parses_in_trainer_config():
+    from llm_training_tpu.trainer import TrainerConfig
+
+    config = TrainerConfig(
+        resilience={"elastic": {"price_per_chip_hour": 4.2}}
+    )
+    assert config.resilience.elastic.price_per_chip_hour == 4.2
+    assert TrainerConfig().resilience.elastic is None
+
+
+def test_mesh_config_axis_sizes_roundtrip():
+    from llm_training_tpu.parallel import MeshConfig
+
+    sizes = {"data": 4, "pipe": 1, "fsdp": 2, "expert": 1, "tensor": 1,
+             "sequence": 1}
+    assert MeshConfig.from_axis_sizes(sizes).axis_sizes() == sizes
+
+
+# ----------------------------------------------------------- slow e2e
+
+
+@pytest.mark.slow
+def test_elastic_resume_onto_fewer_devices(devices, tmp_path):
+    """A fit checkpointed on 8 devices resumes under elastic onto 4: the
+    planner scales data 8->4, the restored stream continues, and the
+    post-resume losses match a clean same-seed run on the 4-device
+    topology (rtol mirrors test_cross_topology_resume: steps 1-3 ran on
+    different meshes, so fp32 reduction-order noise compounds into the
+    resumed state — 5e-5 is ~50x that floor, far below any planner bug)."""
+    from llm_training_tpu.data import DummyDataModule, DummyDataModuleConfig
+    from llm_training_tpu.lms import CLM, CLMConfig, ModelProvider
+    from llm_training_tpu.optim import OptimConfig
+    from llm_training_tpu.parallel import MeshConfig
+    from llm_training_tpu.trainer import Trainer, TrainerConfig
+    from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+
+    def objective():
+        return CLM(CLMConfig(
+            model=ModelProvider(
+                model_class="llm_training_tpu.models.Llama",
+                model_kwargs=dict(
+                    vocab_size=128, hidden_size=32, intermediate_size=64,
+                    num_hidden_layers=1, num_attention_heads=2,
+                    num_key_value_heads=2, max_position_embeddings=64,
+                    compute_dtype="float32",
+                ),
+            ),
+            optim=OptimConfig(learning_rate=1e-3, warmup_steps=2,
+                              lr_scheduler="constant"),
+        ))
+
+    def data():
+        return DummyDataModule(DummyDataModuleConfig(
+            batch_size=8, max_length=32, num_samples=64, vocab_size=128,
+        ))
+
+    class Rec:
+        def __init__(self):
+            self.losses = {}
+
+        def on_step_end(self, trainer, step, metrics):
+            self.losses[step] = float(metrics["loss"])
+
+    mesh = MeshConfig(data_parallel_size=-1, fsdp_size=1)
+    resilience = {"elastic": {"price_per_chip_hour": 3.0}}
+    ckpt = str(tmp_path / "ck")
+
+    t1 = Trainer(
+        TrainerConfig(max_steps=3, log_every_n_steps=1,
+                      checkpoint_every_n_steps=3, mesh=mesh,
+                      resilience=resilience),
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=ckpt, async_save=False)),
+    )
+    t1.fit(objective(), data())
+    assert t1.topology_plan.axis_sizes["data"] == 8
+
+    rec_resumed = Rec()
+    t2 = Trainer(
+        TrainerConfig(max_steps=6, log_every_n_steps=1, mesh=mesh,
+                      resilience=resilience),
+        callbacks=[rec_resumed], devices=devices[:4],
+        checkpointer=Checkpointer(
+            CheckpointConfig(dirpath=ckpt, async_save=False)),
+    )
+    import jax
+
+    state = t2.fit(objective(), data())
+    assert t2.topology_plan.axis_sizes["data"] == 4
+    assert "scaled data 8->4" in t2.topology_plan.decision
+    assert jax.tree.leaves(state.params)[0].sharding.mesh.shape["data"] == 4
+    # cost accounting rode the segment's telemetry
+    assert t2.ledger.summary()["goodput/chip_count"] == 4.0
+    assert t2.ledger.summary()["goodput/cost_dollars"] > 0
+
+    rec_clean = Rec()
+    t3 = Trainer(
+        TrainerConfig(max_steps=6, log_every_n_steps=1, mesh=mesh,
+                      resilience=resilience),
+        callbacks=[rec_clean], devices=devices[:4],
+    )
+    t3.fit(objective(), data())
+    for step in range(4, 7):
+        np.testing.assert_allclose(
+            rec_resumed.losses[step], rec_clean.losses[step], rtol=5e-5,
+            err_msg=f"step {step}",
+        )
