@@ -1,0 +1,37 @@
+"""graftlint: repo-native static analysis (docs/static-analysis.md).
+
+Pure-Python AST checks for the invariants the rest of the codebase runs on
+but nothing else enforces — the ones whose violations historically cost
+chip-hours before surfacing:
+
+- **pallas-kernel-arity**: every `pl.pallas_call` site's implied ref count
+  (scalar prefetch + in_specs + outputs + scratch) matches the kernel's
+  positional signature. BENCH_r04 died on a TPU with `_dq_kernel() missing
+  2 required positional arguments`; this rule makes that a lint failure.
+- **jax-free-import**: declared jax-free modules (supervisor, elastic, the
+  serve package surface, bench.py, serve_loadgen) stay jax-free through
+  their *transitive module-level* import graph; lazy function-body imports
+  are the sanctioned escape hatch.
+- **host-sync**: `.item()` / `jax.device_get` / `np.asarray` / `print` /
+  `float(jnp...)` coercions inside functions reachable from the jitted
+  step/decode entry points — tracer leaks and per-step device round trips.
+- **telemetry-prefix**: every metric name published through the telemetry
+  registry matches `callbacks.loggers.TELEMETRY_PREFIXES`/`TELEMETRY_KEYS`,
+  so a new subsystem's gauges can never silently miss telemetry.jsonl.
+- **env-doc-drift**: every `LLMT_*`/`FLASH_*`/`BENCH_*`/`PAGED_*` env var
+  the code reads appears in the docs env tables.
+
+This package NEVER imports jax (enforced by its own jax-free contract):
+`python -m llm_training_tpu.analysis` is the first precommit gate and must
+fail in milliseconds, before any backend exists.
+"""
+
+from llm_training_tpu.analysis.engine import (
+    Finding,
+    RepoContext,
+    all_rules,
+    main,
+    run_analysis,
+)
+
+__all__ = ["Finding", "RepoContext", "all_rules", "main", "run_analysis"]

@@ -1,0 +1,8 @@
+"""`python -m llm_training_tpu.analysis` — the precommit lint gate."""
+
+import sys
+
+from llm_training_tpu.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Shared AST helpers: dotted-name extraction and lexical scope resolution.
+
+The rules never execute repo code — everything here is structural. Scope
+resolution is deliberately simple Python-shaped lexical lookup: a name used
+in a function resolves to a `def` in the nearest enclosing scope that
+defines it. That covers every pattern the rules care about (module-level
+kernels, closures handed to `jax.jit`, spec lists built in the calling
+function) without pretending to be an interpreter.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """`pltpu.PrefetchScalarGridSpec` -> that string; None for non-chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> str | None:
+    """The last identifier of a Name/Attribute chain (`a.b.c` -> `c`)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def root_name(node: ast.AST) -> str | None:
+    """The first identifier of a Name/Attribute chain (`a.b.c` -> `a`)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+_SCOPE_NODES = (ast.Module, ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+@dataclass
+class Scope:
+    node: ast.AST
+    parent: "Scope | None"
+    functions: dict[str, ast.AST] = field(default_factory=dict)  # defs directly in this scope
+    assignments: dict[str, list[ast.Assign]] = field(default_factory=dict)
+
+    def resolve_function(self, name: str) -> ast.AST | None:
+        scope: Scope | None = self
+        first = True
+        while scope is not None:
+            # Python scoping: class-body names are NOT visible from nested
+            # function scopes — a method resolving a bare name skips its
+            # class's siblings and lands on the enclosing function/module
+            if first or not isinstance(scope.node, ast.ClassDef):
+                if name in scope.functions:
+                    return scope.functions[name]
+                # a name rebound by assignment shadows any def further out;
+                # don't resolve through it (we'd be guessing)
+                if name in scope.assignments:
+                    return None
+            first = False
+            scope = scope.parent
+        return None
+
+    def resolve_assignments(self, name: str) -> list[ast.Assign]:
+        scope = self.resolve_assignment_scope(name)
+        return scope.assignments[name] if scope is not None else []
+
+    def resolve_assignment_scope(self, name: str) -> "Scope | None":
+        """The scope OWNING `name`'s assignments (callers that scan for
+        mutations must walk the owning scope's subtree, not the use site's)."""
+        scope: Scope | None = self
+        first = True
+        while scope is not None:
+            if first or not isinstance(scope.node, ast.ClassDef):
+                if name in scope.assignments:
+                    return scope
+                if name in scope.functions:
+                    return None
+            first = False
+            scope = scope.parent
+        return None
+
+
+class ScopeIndex:
+    """Per-module map from any AST node to its enclosing lexical scope."""
+
+    def __init__(self, tree: ast.Module):
+        self.module_scope = Scope(tree, None)
+        self._enclosing: dict[int, Scope] = {}
+        self._build(tree, self.module_scope)
+
+    def _build(self, node: ast.AST, scope: Scope) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._enclosing[id(child)] = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope.functions[child.name] = child
+                self._build(child, Scope(child, scope))
+            elif isinstance(child, (ast.ClassDef, ast.Lambda)):
+                self._build(child, Scope(child, scope))
+            else:
+                if isinstance(child, ast.Assign):
+                    for target in child.targets:
+                        if isinstance(target, ast.Name):
+                            scope.assignments.setdefault(target.id, []).append(child)
+                self._build(child, scope)
+
+    def scope_of(self, node: ast.AST) -> Scope:
+        return self._enclosing.get(id(node), self.module_scope)
+
+
+def iter_calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def unwrap_partial(node: ast.AST) -> tuple[ast.AST, int, list[str], bool]:
+    """Peel `functools.partial(f, *args, **kws)` layers.
+
+    Returns (innermost callable expr, bound positional count, bound keyword
+    names, saw_double_star) — double-star kwargs make keyword binding
+    unknowable, which callers must treat conservatively.
+    """
+    bound_pos = 0
+    bound_kw: list[str] = []
+    double_star = False
+    while (
+        isinstance(node, ast.Call)
+        and terminal_name(node.func) == "partial"
+        and node.args
+    ):
+        bound_pos += len(node.args) - 1
+        for kw in node.keywords:
+            if kw.arg is None:
+                double_star = True
+            else:
+                bound_kw.append(kw.arg)
+        node = node.args[0]
+    return node, bound_pos, bound_kw, double_star

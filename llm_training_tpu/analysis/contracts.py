@@ -1,0 +1,109 @@
+"""Repo-specific contract tables the rules check against.
+
+This is deliberately data-in-code (not a config file): a contract change is
+a reviewed diff next to the code that carries it, and each entry records WHY
+the invariant exists so a violation message can say more than "don't".
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------- rule 2
+# Modules whose MODULE-LEVEL import graph must never reach jax (transitively
+# through repo-internal module-level imports; function-body imports are the
+# sanctioned lazy escape hatch). Keys are repo-relative paths; values are
+# the reason the contract exists — quoted in the violation message.
+JAX_FREE_CONTRACTS: dict[str, str] = {
+    "llm_training_tpu/resilience/supervisor.py": (
+        "the supervisor relaunches dead fits; it must never own a TPU "
+        "backend or it dies with the child it is supposed to restart"
+    ),
+    "llm_training_tpu/resilience/elastic.py": (
+        "topology planning runs in the supervisor's pre-backend path "
+        "(device probes happen in a subprocess)"
+    ),
+    "llm_training_tpu/serve/__init__.py": (
+        "the serve package surface is host-only (scheduler/allocator); "
+        "the engine is the designated lazy import"
+    ),
+    "llm_training_tpu/serve/paged_cache.py": (
+        "the block allocator is pure host policy; the pool constructors "
+        "import jax lazily at call time"
+    ),
+    "llm_training_tpu/serve/scheduler.py": (
+        "admission/eviction/chunked-prefill policy is pure host code by "
+        "design — testable without a backend"
+    ),
+    "bench.py": (
+        "the bench parent orchestrates child stages; a wedged backend must "
+        "cost a stage timeout, not hang the whole bench (the r05 failure)"
+    ),
+    "scripts/serve_loadgen.py": (
+        "the loadgen drives the serve CLI as a subprocess and must keep "
+        "feeding/timing requests while the child owns the backend"
+    ),
+    # the lint gate itself: precommit runs it before any backend exists and
+    # it must stay millisecond-cheap
+    "llm_training_tpu/analysis/__init__.py": (
+        "the lint gate is the first precommit stage and must never pay a "
+        "backend import"
+    ),
+}
+
+# import roots that violate a jax-free contract when reached module-level
+BANNED_IMPORT_ROOTS = ("jax", "jaxlib")
+
+# ---------------------------------------------------------------- rule 4
+# where the telemetry routing registry lives; the rule parses the literal
+# TELEMETRY_PREFIXES / TELEMETRY_KEYS tuples out of this file's AST so the
+# lint can never drift from what the logger actually routes
+TELEMETRY_REGISTRY_FILE = "llm_training_tpu/callbacks/loggers.py"
+
+# attribute-call receivers that publish metrics: any `<recv>.gauge(name)` /
+# `.counter(name)` / `.timer(name)` where the receiver's terminal identifier
+# contains one of these substrings (registry, self.telemetry, get_registry())
+TELEMETRY_RECEIVER_HINTS = ("registry", "telemetry")
+TELEMETRY_PUBLISH_METHODS = ("gauge", "counter", "timer")
+
+# ---------------------------------------------------------------- rule 5
+# env-var namespaces this repo owns; every read of one must be documented
+ENV_VAR_PATTERN = r"^(LLMT|FLASH|BENCH|PAGED)_[A-Z0-9]+(?:_[A-Z0-9]+)*$"
+
+# the docs corpus an env var must appear in (any of these files)
+ENV_DOC_FILES = (
+    "README.md",
+    "docs/performance.md",
+    "docs/resilience.md",
+    "docs/serving.md",
+    "docs/observability.md",
+    "docs/inference.md",
+    "docs/config.md",
+    "docs/parallelism.md",
+    "docs/static-analysis.md",
+)
+
+# ---------------------------------------------------------------- rule 3
+# jit wrappers whose first function argument starts a traced region
+JIT_WRAPPERS = ("jit", "pjit")
+# higher-order jax/functools combinators that forward their function-valued
+# arguments into the traced region
+HIGHER_ORDER = (
+    "grad",
+    "value_and_grad",
+    "vmap",
+    "pmap",
+    "remat",
+    "checkpoint",
+    "custom_vjp",
+    "custom_jvp",
+    "scan",
+    "cond",
+    "switch",
+    "while_loop",
+    "fori_loop",
+    "map",
+    "associative_scan",
+    "shard_map",
+    "partial",
+    "defvjp",
+    "defjvp",
+)

@@ -1,0 +1,427 @@
+"""graftlint core: file discovery, suppressions, baseline, CLI.
+
+Rules are pure functions over parsed ASTs (`RuleSpec.run(ctx)`); this module
+owns everything around them — which files to scan, `# lint: allow(...)`
+suppression comments, the committed baseline for grandfathered findings,
+human/JSON output, and exit codes. No jax anywhere in this package: the
+whole point is a correctness signal that costs milliseconds, before any
+backend exists (docs/static-analysis.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import re
+import sys
+import time
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterable
+
+# the default scan set, relative to the repo root: library + entry scripts.
+# tests/ are deliberately excluded (they import jax freely and construct
+# intentionally-broken fixtures); point the CLI at extra paths to widen.
+DEFAULT_SCAN = ("llm_training_tpu", "scripts", "bench.py")
+DEFAULT_BASELINE = "config/lint_baseline.json"
+# meta-findings that must never be grandfathered: a baselined reasonless
+# suppression would permanently void the mandatory-reason rule, and a
+# baselined parse error hides every finding in the broken file
+NON_BASELINABLE_RULES = ("suppression-reason", "parse-error")
+_EXCLUDED_DIRS = {"__pycache__", ".git"}
+
+# `# lint: allow(rule)` or `# lint: allow(rule-a, rule-b): why it is fine`
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*allow\(([\w*,\s-]+)\)(?::\s*(\S.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        # line numbers drift with unrelated edits; baseline entries key on
+        # the stable (rule, file, message) triple instead
+        return f"{self.rule}::{self.path}::{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass(frozen=True)
+class RuleSpec:
+    name: str
+    description: str
+    run: Callable[["RepoContext"], list[Finding]]
+
+
+@dataclass
+class ParsedFile:
+    path: str  # repo-relative posix
+    abs_path: Path
+    source: str
+    tree: ast.Module
+    # line -> (rule names allowed, reason or None); reasons are REQUIRED —
+    # a reasonless allow is itself a finding
+    suppressions: dict[int, tuple[set[str], str | None]]
+
+
+def _parse_suppressions(source: str) -> dict[int, tuple[set[str], str | None]]:
+    # only real COMMENT tokens register suppressions — the syntax quoted in
+    # a docstring or string literal must never silently suppress findings
+    out: dict[int, tuple[set[str], str | None]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _SUPPRESS_RE.search(tok.string)
+            if match:
+                rules = {
+                    part.strip() for part in match.group(1).split(",") if part.strip()
+                }
+                out[tok.start[0]] = (rules, match.group(2))
+    except tokenize.TokenError:
+        pass  # unparseable tail: the ast parse error is the real finding
+    return out
+
+
+class RepoContext:
+    """Parsed view of the scan set plus an on-demand parse cache (the import
+    graph walks files outside the selected paths)."""
+
+    def __init__(self, root: Path, paths: Iterable[str] | None = None):
+        self.root = Path(root).resolve()
+        self.parse_errors: list[Finding] = []
+        self._cache: dict[Path, ParsedFile | None] = {}
+        self.files: list[ParsedFile] = []
+        for file_path in self._discover(paths or DEFAULT_SCAN):
+            parsed = self.parsed(file_path)
+            if parsed is not None:
+                self.files.append(parsed)
+
+    def _discover(self, paths: Iterable[str]) -> list[Path]:
+        found: list[Path] = []
+        for entry in paths:
+            target = (self.root / entry).resolve()
+            if target.is_file() and target.suffix == ".py":
+                found.append(target)
+            elif target.is_dir():
+                found.extend(
+                    p
+                    for p in sorted(target.rglob("*.py"))
+                    if not (_EXCLUDED_DIRS & set(p.relative_to(self.root).parts))
+                )
+        return found
+
+    def rel(self, abs_path: Path) -> str:
+        try:
+            return abs_path.relative_to(self.root).as_posix()
+        except ValueError:
+            return abs_path.as_posix()
+
+    def parsed(self, abs_path: Path) -> ParsedFile | None:
+        abs_path = abs_path.resolve()
+        if abs_path in self._cache:
+            return self._cache[abs_path]
+        parsed: ParsedFile | None = None
+        try:
+            source = abs_path.read_text()
+            tree = ast.parse(source, filename=str(abs_path))
+            parsed = ParsedFile(
+                path=self.rel(abs_path),
+                abs_path=abs_path,
+                source=source,
+                tree=tree,
+                suppressions=_parse_suppressions(source),
+            )
+        except (OSError, SyntaxError, ValueError) as exc:
+            self.parse_errors.append(
+                Finding(
+                    rule="parse-error",
+                    path=self.rel(abs_path),
+                    line=getattr(exc, "lineno", None) or 1,
+                    message=f"could not parse: {exc.__class__.__name__}: {exc}",
+                )
+            )
+            self._cache[abs_path] = None
+            return None
+        self._cache[abs_path] = parsed
+        return parsed
+
+    def file(self, rel_path: str) -> ParsedFile | None:
+        return self.parsed(self.root / rel_path)
+
+    def file_for_module(self, module: str) -> Path | None:
+        """Repo file implementing dotted `module`, or None for third-party."""
+        parts = module.split(".")
+        as_module = self.root.joinpath(*parts).with_suffix(".py")
+        if as_module.is_file():
+            return as_module
+        as_package = self.root.joinpath(*parts, "__init__.py")
+        if as_package.is_file():
+            return as_package
+        return None
+
+
+def all_rules() -> list[RuleSpec]:
+    from llm_training_tpu.analysis import (
+        env_docs,
+        host_sync,
+        import_contracts,
+        pallas_arity,
+        telemetry_prefixes,
+    )
+
+    return [
+        pallas_arity.RULE,
+        import_contracts.RULE,
+        host_sync.RULE,
+        telemetry_prefixes.RULE,
+        env_docs.RULE,
+    ]
+
+
+@dataclass
+class AnalysisResult:
+    findings: list[Finding]  # active: fail the gate
+    suppressed: list[Finding]
+    baselined: list[Finding]
+    elapsed_s: float
+
+
+def run_analysis(
+    root: Path,
+    paths: Iterable[str] | None = None,
+    rules: Iterable[str] | None = None,
+    baseline_keys: set[str] | None = None,
+) -> AnalysisResult:
+    t0 = time.monotonic()
+    ctx = RepoContext(root, paths)
+    selected = all_rules()
+    if rules is not None:
+        wanted = set(rules)
+        known = {rule.name for rule in selected}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown rule(s) {sorted(unknown)}; known: {sorted(known)}"
+            )
+        selected = [rule for rule in selected if rule.name in wanted]
+
+    raw: list[Finding] = []
+    for rule in selected:
+        raw.extend(rule.run(ctx))
+    # AFTER the rules: on-demand parses (the import-graph walk reaches files
+    # outside the selected paths) append parse errors during rule execution
+    raw.extend(ctx.parse_errors)
+
+    active: list[Finding] = []
+    suppressed: list[Finding] = []
+    baselined: list[Finding] = []
+    suppression_files = {pf.path: pf.suppressions for pf in ctx.files}
+    for finding in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        if finding.path not in suppression_files:
+            # findings can land on files outside the selected scan paths
+            # (the import-graph walk); their inline suppressions still count
+            parsed = ctx.file(finding.path)
+            suppression_files[finding.path] = (
+                parsed.suppressions if parsed is not None else {}
+            )
+        table = suppression_files.get(finding.path, {})
+        hit = None
+        for line in (finding.line, finding.line - 1):
+            entry = table.get(line)
+            if entry and (finding.rule in entry[0] or "*" in entry[0]):
+                hit = (line, entry)
+                break
+        if hit is not None:
+            line, (_, reason) = hit
+            if reason is None:
+                active.append(
+                    Finding(
+                        rule="suppression-reason",
+                        path=finding.path,
+                        line=line,
+                        message=(
+                            f"suppression of [{finding.rule}] has no reason; write "
+                            "`# lint: allow(" + finding.rule + "): <why this is fine>`"
+                        ),
+                    )
+                )
+            else:
+                suppressed.append(finding)
+        elif (
+            finding.rule not in NON_BASELINABLE_RULES
+            and baseline_keys
+            and finding.key in baseline_keys
+        ):
+            baselined.append(finding)
+        else:
+            active.append(finding)
+    return AnalysisResult(active, suppressed, baselined, time.monotonic() - t0)
+
+
+def load_baseline(path: Path) -> set[str]:
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return set()
+    if not isinstance(data, dict):
+        return set()
+    return {key for key in data.get("findings", []) if isinstance(key, str)}
+
+
+def write_baseline(path: Path, findings: Iterable[Finding | str]) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "version": 1,
+        "comment": (
+            "grandfathered graftlint findings (docs/static-analysis.md); "
+            "the goal is for this list to stay empty — fix or suppress "
+            "inline with a reason instead of adding entries"
+        ),
+        "findings": sorted(
+            {f if isinstance(f, str) else f.key for f in findings}
+        ),
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def _default_root() -> Path:
+    cwd = Path.cwd()
+    if (cwd / "llm_training_tpu").is_dir():
+        return cwd
+    # fall back to the checkout this package was imported from
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m llm_training_tpu.analysis",
+        description=(
+            "graftlint: repo-native static analysis (never imports jax). "
+            "Exit 0 = clean, 1 = findings, 2 = usage error."
+        ),
+        epilog=(
+            "Suppress a finding with `# lint: allow(<rule>): <reason>` on the "
+            "flagged line or the line above (the reason is mandatory). "
+            "Grandfather existing debt with --update-baseline. "
+            "Full rule docs: docs/static-analysis.md"
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to scan, relative to --root (default: {', '.join(DEFAULT_SCAN)})",
+    )
+    parser.add_argument(
+        "--rules",
+        help="comma-separated rule subset (see --list-rules); default: all",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule table and exit")
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    parser.add_argument(
+        "--root", type=Path, default=None, help="repo root (default: cwd if it holds llm_training_tpu/)"
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help=f"baseline file (default: <root>/{DEFAULT_BASELINE})",
+    )
+    parser.add_argument("--no-baseline", action="store_true", help="ignore the baseline file")
+    parser.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="write the current findings to the baseline and exit 0",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.name:24s} {rule.description}")
+        return 0
+
+    root = (args.root or _default_root()).resolve()
+    if not (root / "llm_training_tpu").is_dir():
+        print(f"graftlint: {root} does not look like the repo root", file=sys.stderr)
+        return 2
+    baseline_path = args.baseline or (root / DEFAULT_BASELINE)
+    baseline_keys = set() if args.no_baseline else load_baseline(baseline_path)
+
+    try:
+        result = run_analysis(
+            root,
+            paths=args.paths or None,
+            rules=args.rules.split(",") if args.rules else None,
+            baseline_keys=baseline_keys,
+        )
+    except ValueError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        # still-firing grandfathered findings stay in the baseline — updating
+        # must never un-grandfather debt the update didn't fix
+        keep_keys = {
+            f.key
+            for f in result.findings + result.baselined
+            if f.rule not in NON_BASELINABLE_RULES
+        }
+        if args.paths or args.rules:
+            # a narrowed run (subset of paths OR rules) can't see findings
+            # elsewhere; their grandfathered entries must survive untouched
+            keep_keys |= baseline_keys
+        write_baseline(baseline_path, keep_keys)
+        print(
+            f"graftlint: baseline updated with {len(keep_keys)} finding(s) "
+            f"({len(result.baselined)} still firing, carried over) at {baseline_path}"
+        )
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "version": 1,
+                    "findings": [
+                        {
+                            "rule": f.rule,
+                            "path": f.path,
+                            "line": f.line,
+                            "message": f.message,
+                            "key": f.key,
+                        }
+                        for f in result.findings
+                    ],
+                    "suppressed": len(result.suppressed),
+                    "baselined": len(result.baselined),
+                    "elapsed_s": round(result.elapsed_s, 3),
+                }
+            )
+        )
+        return 1 if result.findings else 0
+
+    for finding in result.findings:
+        print(finding.render())
+    status = "FAIL" if result.findings else "OK"
+    print(
+        f"graftlint: {status} — {len(result.findings)} finding(s) "
+        f"({len(result.suppressed)} suppressed, {len(result.baselined)} baselined) "
+        f"in {result.elapsed_s:.2f}s"
+    )
+    if result.findings:
+        print(
+            "hint: fix the invariant, or suppress with "
+            "`# lint: allow(<rule>): <reason>` on the flagged line (or the line "
+            "above); docs/static-analysis.md documents every rule and the "
+            "baseline workflow."
+        )
+    return 1 if result.findings else 0

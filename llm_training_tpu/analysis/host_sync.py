@@ -1,0 +1,267 @@
+"""Rule `host-sync`: no device↔host round trips inside jit-reachable code.
+
+`.item()`, `float()/int()` coercions of jax expressions, `np.asarray`,
+`jax.device_get`, and `print` inside a traced region either crash at trace
+time (ConcretizationTypeError) or — worse — silently sync the device every
+step when they sit on a rarely-traced path (a health-cadence step, a decode
+branch). The expensive ones are exactly the ones tier-1 never traces.
+
+Mechanics: every function handed to `jax.jit`/`pjit` (as a call argument or
+a decorator, through `functools.partial`) is an entry point. From there a
+conservative call graph is walked: direct calls resolved lexically, calls
+through `from x import f` imports, `self.method(...)` within the defining
+class, and function-valued arguments of the jax higher-order combinators
+(`grad`, `scan`, `cond`, `custom_vjp.defvjp`, ...). Unresolvable calls are
+skipped — this rule under-approximates reachability, so every hit is worth
+reading. Suppress deliberate syncs with `# lint: allow(host-sync): <why>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from llm_training_tpu.analysis import contracts
+from llm_training_tpu.analysis.astutils import (
+    ScopeIndex,
+    dotted_name,
+    root_name,
+    terminal_name,
+    unwrap_partial,
+)
+from llm_training_tpu.analysis.engine import Finding, ParsedFile, RepoContext, RuleSpec
+
+_JAX_ROOTS = {"jnp", "jax", "lax"}
+_NUMPY_ROOTS = {"np", "numpy"}
+
+
+@dataclass
+class _Module:
+    parsed: ParsedFile
+    scopes: ScopeIndex
+    # imported name -> ("module", dotted) or ("symbol", module, name)
+    imports: dict[str, tuple]
+
+
+def _import_map(tree: ast.Module) -> dict[str, tuple]:
+    imports: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname else alias.name.split(".")[0]
+                imports[local] = ("module", target)
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                imports[alias.asname or alias.name] = ("symbol", node.module, alias.name)
+    return imports
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk a function body without descending into nested `def`s (those are
+    only reachable if the call graph reaches them); lambdas run inline in
+    the traced region, so their bodies stay in."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _enclosing_class_method(mod: _Module, fn: ast.AST, method: str) -> ast.AST | None:
+    scope = mod.scopes.scope_of(fn)
+    while scope is not None:
+        if isinstance(scope.node, ast.ClassDef):
+            for stmt in scope.node.body:
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name == method
+                ):
+                    return stmt
+            return None
+        scope = scope.parent
+    return None
+
+
+class _Graph:
+    """Cross-module call resolution over the scan set."""
+
+    def __init__(self, ctx: RepoContext):
+        self.ctx = ctx
+        self.modules: dict[str, _Module] = {}
+        for parsed in ctx.files:
+            self.modules[parsed.path] = _Module(
+                parsed=parsed,
+                scopes=ScopeIndex(parsed.tree),
+                imports=_import_map(parsed.tree),
+            )
+
+    def module_for(self, dotted: str) -> _Module | None:
+        file = self.ctx.file_for_module(dotted)
+        if file is None:
+            return None
+        return self.modules.get(self.ctx.rel(file))
+
+    def resolve_callables(
+        self, mod: _Module, expr: ast.AST, site: ast.AST, depth: int = 0
+    ) -> list[tuple[_Module, ast.AST]]:
+        """A function-valued expression -> [(module, FunctionDef/Lambda)].
+
+        Handles one level of factory indirection: `jax.jit(self._build_step(
+        objective, tx))` resolves `_build_step` and treats every function it
+        returns as the jitted callable (the trainer's step builders)."""
+        expr, _, _, _ = unwrap_partial(expr)
+        if isinstance(expr, ast.Lambda):
+            return [(mod, expr)]
+        if isinstance(expr, ast.Name):
+            local = mod.scopes.scope_of(site).resolve_function(expr.id)
+            if local is not None:
+                return [(mod, local)]
+            target = mod.imports.get(expr.id)
+            if target and target[0] == "symbol":
+                other = self.module_for(target[1])
+                if other is not None:
+                    fn = other.scopes.module_scope.functions.get(target[2])
+                    if fn is not None:
+                        return [(other, fn)]
+            return []
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                fn = _enclosing_class_method(mod, site, expr.attr)
+                if fn is not None:
+                    return [(mod, fn)]
+            elif isinstance(base, ast.Name):
+                target = mod.imports.get(base.id)
+                if target and target[0] == "module":
+                    other = self.module_for(target[1])
+                    if other is not None:
+                        fn = other.scopes.module_scope.functions.get(expr.attr)
+                        if fn is not None:
+                            return [(other, fn)]
+            return []
+        if isinstance(expr, ast.Call) and depth < 2:
+            resolved: list[tuple[_Module, ast.AST]] = []
+            for fmod, factory in self.resolve_callables(mod, expr.func, site, depth + 1):
+                for node in _own_nodes(factory):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        resolved.extend(
+                            self.resolve_callables(fmod, node.value, node.value, depth + 1)
+                        )
+            return resolved
+        return []
+
+
+def _entry_points(graph: _Graph) -> list[tuple[_Module, ast.AST]]:
+    entries: list[tuple[_Module, ast.AST]] = []
+    for mod in graph.modules.values():
+        for node in ast.walk(mod.parsed.tree):
+            if isinstance(node, ast.Call):
+                if terminal_name(node.func) in contracts.JIT_WRAPPERS and node.args:
+                    entries.extend(graph.resolve_callables(mod, node.args[0], node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    target = deco.func if isinstance(deco, ast.Call) else deco
+                    name = terminal_name(target)
+                    if name in contracts.JIT_WRAPPERS:
+                        entries.append((mod, node))
+                    elif (
+                        name == "partial"
+                        and isinstance(deco, ast.Call)
+                        and deco.args
+                        and terminal_name(deco.args[0]) in contracts.JIT_WRAPPERS
+                    ):
+                        entries.append((mod, node))
+    return entries
+
+
+def _callees(graph: _Graph, mod: _Module, fn: ast.AST):
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        if name in contracts.HIGHER_ORDER or name in contracts.JIT_WRAPPERS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                yield from graph.resolve_callables(mod, arg, node)
+        # NB: a bare Call func (not Name/Attribute) would recurse into the
+        # factory path; direct calls only here
+        if isinstance(node.func, (ast.Name, ast.Attribute)):
+            yield from graph.resolve_callables(mod, node.func, node)
+
+
+def _violations(mod: _Module, fn: ast.AST) -> list[tuple[int, str]]:
+    fn_name = getattr(fn, "name", "<lambda>")
+    hits: list[tuple[int, str]] = []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        what: str | None = None
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+        ):
+            what = ".item()"
+        elif dotted_name(node.func) == "jax.device_get":
+            what = "jax.device_get"
+        elif (
+            root_name(node.func) in _NUMPY_ROOTS
+            and terminal_name(node.func) in ("asarray", "array")
+        ):
+            what = f"{dotted_name(node.func)}(...)"
+        elif isinstance(node.func, ast.Name) and node.func.id == "print":
+            what = "print(...)"
+        elif (
+            isinstance(node.func, ast.Name)
+            and node.func.id in ("float", "int", "bool")
+            and len(node.args) == 1
+            and isinstance(node.args[0], ast.Call)
+            and root_name(node.args[0].func) in _JAX_ROOTS
+        ):
+            what = f"{node.func.id}(<jax expression>)"
+        if what is not None:
+            hits.append(
+                (
+                    node.lineno,
+                    f"host-sync `{what}` inside jit-reachable function "
+                    f"`{fn_name}` — forces a device<->host transfer or leaks "
+                    "a tracer into host code; hoist it out of the traced "
+                    "region (or jax.debug.print / jax.debug.callback)",
+                )
+            )
+    return hits
+
+
+def _run(ctx: RepoContext) -> list[Finding]:
+    graph = _Graph(ctx)
+    worklist = _entry_points(graph)
+    seen: set[tuple[str, int]] = set()
+    findings: dict[tuple[str, int, str], Finding] = {}
+    while worklist:
+        mod, fn = worklist.pop()
+        key = (mod.parsed.path, id(fn))
+        if key in seen:
+            continue
+        seen.add(key)
+        for line, message in _violations(mod, fn):
+            fkey = (mod.parsed.path, line, message)
+            if fkey not in findings:
+                findings[fkey] = Finding(
+                    rule=RULE.name, path=mod.parsed.path, line=line, message=message
+                )
+        worklist.extend(_callees(graph, mod, fn))
+    return list(findings.values())
+
+
+RULE = RuleSpec(
+    name="host-sync",
+    description=(
+        ".item()/float()/np.asarray/jax.device_get/print inside functions "
+        "reachable from jitted step/decode entry points (tracer leaks, "
+        "per-step device syncs)"
+    ),
+    run=_run,
+)

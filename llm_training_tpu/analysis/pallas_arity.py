@@ -1,0 +1,216 @@
+"""Rule `pallas-kernel-arity`: pallas_call specs must match kernel arity.
+
+BENCH_r04 died on-chip with `_dq_kernel() missing 2 required positional
+arguments: 'dq_ref' and 'dq_scr'` — the pallas_call's spec lists implied 10
+refs while the kernel's signature bound 12. The ref count a call implies is
+fully static:
+
+    num_scalar_prefetch  +  len(in_specs)  +  len(out_specs or out_shape)
+    +  len(scratch_shapes)
+
+and the kernel's positional capacity is its signature minus whatever a
+`functools.partial` wrapper binds. This rule recomputes both sides for
+every `pl.pallas_call` site and flags any disagreement — turning a
+TPU-only runtime crash into a millisecond lint failure.
+
+Spec lists built as local variables (`in_specs = [...]` plus conditional
+`.append(...)`) resolve to a [min, max] range; the rule only reports when
+the ranges PROVABLY disagree, so dynamic sites degrade to silence, never
+to false alarms.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_training_tpu.analysis.astutils import (
+    ScopeIndex,
+    dotted_name,
+    iter_calls,
+    terminal_name,
+    unwrap_partial,
+)
+from llm_training_tpu.analysis.engine import Finding, RepoContext, RuleSpec
+
+# pallas_call / grid-spec keywords that carry refs
+_SPEC_KEYS = ("num_scalar_prefetch", "in_specs", "out_specs", "scratch_shapes", "out_shape")
+
+
+def _count_exprs(expr: ast.AST | None, scope_index: ScopeIndex) -> tuple[int, int] | None:
+    """[min, max] element count of a spec-list expression, or None when it
+    cannot be determined statically."""
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.List, ast.Tuple)):
+        if any(isinstance(el, ast.Starred) for el in expr.elts):
+            return None
+        return len(expr.elts), len(expr.elts)
+    if isinstance(expr, ast.Call):
+        # a single BlockSpec / ShapeDtypeStruct counts as one ref
+        return 1, 1
+    if isinstance(expr, ast.Name):
+        owning = scope_index.scope_of(expr).resolve_assignment_scope(expr.id)
+        if owning is None:
+            return None
+        assigns = owning.assignments[expr.id]
+        base = _count_exprs(assigns[-1].value, scope_index)
+        if base is None or len(assigns) > 1:
+            return None
+        # mutations are scanned in the scope that OWNS the assignment (a
+        # module-level list appended at module level, used in a function)
+        owner = owning.node
+        # any mutation besides single-element .append makes the count
+        # unknowable — degrade to silence, never a false alarm
+        for node in ast.walk(owner):
+            if (
+                isinstance(node, ast.AugAssign)
+                and isinstance(node.target, ast.Name)
+                and node.target.id == expr.id
+            ):
+                return None
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == expr.id
+                and node.func.attr in ("extend", "insert", "remove", "pop", "clear", "__iadd__")
+            ):
+                return None
+        # conditional `specs.append(...)` calls widen the upper bound
+        appends = sum(
+            1
+            for call in iter_calls(owner)
+            if isinstance(call.func, ast.Attribute)
+            and call.func.attr == "append"
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == expr.id
+        )
+        return base[0], base[1] + appends
+    return None
+
+
+def _int_value(expr: ast.AST | None) -> int | None:
+    if expr is None:
+        return 0
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return expr.value
+    return None
+
+
+def _merged_spec_kwargs(call: ast.Call) -> dict[str, ast.AST]:
+    """pallas_call keywords, with any grid_spec=...GridSpec(...) keywords
+    folded in (the grid-spec object is where PrefetchScalarGridSpec sites
+    put in_specs/out_specs/scratch_shapes)."""
+    merged: dict[str, ast.AST] = {}
+    for kw in call.keywords:
+        if kw.arg in _SPEC_KEYS:
+            merged[kw.arg] = kw.value
+    grid_spec = next((kw.value for kw in call.keywords if kw.arg == "grid_spec"), None)
+    if isinstance(grid_spec, ast.Call) and (terminal_name(grid_spec.func) or "").endswith(
+        "GridSpec"
+    ):
+        for kw in grid_spec.keywords:
+            if kw.arg in _SPEC_KEYS:
+                merged[kw.arg] = kw.value
+    return merged
+
+
+def _analyze_site(
+    call: ast.Call, scope_index: ScopeIndex, path: str
+) -> Finding | None:
+    if not call.args:
+        return None
+    kernel_expr, bound_pos, bound_kw, double_star = unwrap_partial(call.args[0])
+    if not isinstance(kernel_expr, ast.Name):
+        return None
+    kernel = scope_index.scope_of(call).resolve_function(kernel_expr.id)
+    if not isinstance(kernel, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+
+    kwargs = _merged_spec_kwargs(call)
+    prefetch = _int_value(kwargs.get("num_scalar_prefetch"))
+    in_count = _count_exprs(kwargs.get("in_specs"), scope_index)
+    out_count = _count_exprs(
+        kwargs.get("out_specs", kwargs.get("out_shape")), scope_index
+    )
+    scratch = (
+        _count_exprs(kwargs.get("scratch_shapes"), scope_index)
+        if "scratch_shapes" in kwargs
+        else (0, 0)
+    )
+    if prefetch is None or in_count is None or out_count is None or scratch is None:
+        return None
+    if "in_specs" not in kwargs:
+        return None  # implicit full-array specs: operand count is not spec-derived
+    expected_min = prefetch + in_count[0] + out_count[0] + scratch[0]
+    expected_max = prefetch + in_count[1] + out_count[1] + scratch[1]
+
+    pos_names = [a.arg for a in kernel.args.posonlyargs + kernel.args.args]
+    consumed = bound_pos + sum(1 for name in bound_kw if name in pos_names)
+    if double_star and not kernel.args.kwonlyargs:
+        # `partial(f, **unknown)` could bind anything when the kernel has no
+        # keyword-only section; refuse to guess
+        return None
+    capacity = len(pos_names) - consumed
+    required = len(pos_names) - len(kernel.args.defaults) - consumed
+    has_vararg = kernel.args.vararg is not None
+
+    breakdown = (
+        f"{prefetch} scalar-prefetch + {_fmt(in_count)} in_specs + "
+        f"{_fmt(out_count)} output(s) + {_fmt(scratch)} scratch"
+    )
+    if expected_max < required:
+        return Finding(
+            rule=RULE.name,
+            path=path,
+            line=call.lineno,
+            message=(
+                f"kernel '{kernel.name}' requires {required} positional ref(s) "
+                f"but this pallas_call provides at most {expected_max} "
+                f"({breakdown}): {required - expected_max} ref(s) missing — "
+                "the BENCH_r04 crash class"
+            ),
+        )
+    if not has_vararg and expected_min > capacity:
+        return Finding(
+            rule=RULE.name,
+            path=path,
+            line=call.lineno,
+            message=(
+                f"kernel '{kernel.name}' accepts at most {capacity} positional "
+                f"ref(s) but this pallas_call provides at least {expected_min} "
+                f"({breakdown}): {expected_min - capacity} extra ref(s)"
+            ),
+        )
+    return None
+
+
+def _run(ctx: RepoContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for parsed in ctx.files:
+        if "pallas_call" not in parsed.source:
+            continue
+        scope_index = ScopeIndex(parsed.tree)
+        for call in iter_calls(parsed.tree):
+            name = dotted_name(call.func)
+            if name is None or terminal_name(call.func) != "pallas_call":
+                continue
+            finding = _analyze_site(call, scope_index, parsed.path)
+            if finding is not None:
+                findings.append(finding)
+    return findings
+
+
+def _fmt(count: tuple[int, int]) -> str:
+    lo, hi = count
+    return str(lo) if lo == hi else f"{lo}..{hi}"
+
+
+RULE = RuleSpec(
+    name="pallas-kernel-arity",
+    description=(
+        "pl.pallas_call ref counts (prefetch + in_specs + outputs + scratch) "
+        "must match the kernel's positional signature (the BENCH_r04 crash)"
+    ),
+    run=_run,
+)

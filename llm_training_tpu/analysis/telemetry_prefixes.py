@@ -1,0 +1,145 @@
+"""Rule `telemetry-prefix`: published metric names must be routed.
+
+`callbacks.loggers` forwards a registry metric into `telemetry.jsonl` (the
+record `report` reads) only when its name matches `TELEMETRY_PREFIXES` /
+`TELEMETRY_KEYS`. A subsystem that publishes gauges under a new prefix and
+forgets the registration ships metrics that look alive in unit tests
+(registry `snapshot()` sees them) but silently vanish from every run
+artifact — exactly what happened to the `flash/*` block-tuning gauges
+between PR 6 and this rule's introduction.
+
+The rule parses the literal tuples out of the loggers file (so it can never
+drift from what the logger actually routes) and checks every
+`<registry>.gauge("...")` / `.counter(...)` / `.timer(...)` publish site,
+including the static head of f-string names (`f"flash/{kind}/block_q"`
+checks `flash/`). Dynamic names with no static head are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_training_tpu.analysis import contracts
+from llm_training_tpu.analysis.astutils import terminal_name
+from llm_training_tpu.analysis.engine import Finding, RepoContext, RuleSpec
+
+
+def _registered(ctx: RepoContext) -> tuple[tuple[str, ...], tuple[str, ...]] | None:
+    parsed = ctx.file(contracts.TELEMETRY_REGISTRY_FILE)
+    if parsed is None:
+        return None
+    found: dict[str, tuple[str, ...]] = {}
+    for node in parsed.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if (
+                isinstance(target, ast.Name)
+                and target.id in ("TELEMETRY_PREFIXES", "TELEMETRY_KEYS")
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                values = tuple(
+                    el.value
+                    for el in node.value.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                )
+                found[target.id] = values
+    if "TELEMETRY_PREFIXES" not in found:
+        return None
+    return found["TELEMETRY_PREFIXES"], found.get("TELEMETRY_KEYS", ())
+
+
+def _is_publish_receiver(receiver: ast.AST) -> bool:
+    if isinstance(receiver, ast.Call):
+        return terminal_name(receiver.func) == "get_registry"
+    name = terminal_name(receiver)
+    if name is None:
+        return False
+    lowered = name.lower()
+    return any(hint in lowered for hint in contracts.TELEMETRY_RECEIVER_HINTS)
+
+
+def _static_name(arg: ast.AST) -> tuple[str, bool] | None:
+    """(text, is_complete) for a literal or f-string metric name."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, True
+    if isinstance(arg, ast.JoinedStr):
+        head = []
+        for part in arg.values:
+            if isinstance(part, ast.Constant) and isinstance(part.value, str):
+                head.append(part.value)
+            else:
+                return "".join(head), False
+        return "".join(head), True
+    return None
+
+
+def _run(ctx: RepoContext) -> list[Finding]:
+    registered = _registered(ctx)
+    if registered is None:
+        return [
+            Finding(
+                rule=RULE.name,
+                path=contracts.TELEMETRY_REGISTRY_FILE,
+                line=1,
+                message=(
+                    "could not parse the literal TELEMETRY_PREFIXES tuple out "
+                    "of the loggers file; the telemetry routing contract is "
+                    "unverifiable"
+                ),
+            )
+        ]
+    prefixes, keys = registered
+    findings: list[Finding] = []
+    for parsed in ctx.files:
+        if parsed.path == contracts.TELEMETRY_REGISTRY_FILE:
+            continue
+        for node in ast.walk(parsed.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in contracts.TELEMETRY_PUBLISH_METHODS
+                and node.args
+                and _is_publish_receiver(node.func.value)
+            ):
+                continue
+            static = _static_name(node.args[0])
+            if static is None:
+                continue
+            text, complete = static
+            if not text:
+                continue
+            if complete and (text in keys or text.startswith(prefixes)):
+                continue
+            # incomplete (f-string head): fine if the head already commits to
+            # a registered prefix, or could still grow into one
+            if not complete and (
+                text.startswith(prefixes) or any(p.startswith(text) for p in prefixes)
+            ):
+                continue
+            display = text if complete else f"{text}..."
+            findings.append(
+                Finding(
+                    rule=RULE.name,
+                    path=parsed.path,
+                    line=node.lineno,
+                    message=(
+                        f"metric `{display}` does not match "
+                        "loggers.TELEMETRY_PREFIXES/TELEMETRY_KEYS — it will "
+                        "be dropped from telemetry.jsonl and invisible to "
+                        "`report`; register its prefix in "
+                        f"{contracts.TELEMETRY_REGISTRY_FILE} or rename it"
+                    ),
+                )
+            )
+    return findings
+
+
+RULE = RuleSpec(
+    name="telemetry-prefix",
+    description=(
+        "every metric name published through the telemetry registry must "
+        "match loggers.TELEMETRY_PREFIXES/TELEMETRY_KEYS (else it never "
+        "reaches telemetry.jsonl)"
+    ),
+    run=_run,
+)

@@ -36,8 +36,6 @@ logger = logging.getLogger(__name__)
 # injection sites: data-source pull / checkpoint save I/O
 SITES = ("data", "checkpoint_save")
 
-ENV_PREFIX = "LLMT_CHAOS_"
-
 
 class ChaosError(OSError):
     """An injected transient fault (OSError so retry policies treat it as
@@ -96,19 +94,22 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
     LLMT_CHAOS_SIGTERM_STEP / LLMT_CHAOS_SIGKILL_STEP / LLMT_CHAOS_NAN_STEP
     / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SEED (ints)."""
     update: dict = {}
-    for field, cast in (
-        ("data_error_steps", _int_tuple),
-        ("checkpoint_error_steps", _int_tuple),
-        ("data_error_prob", float),
-        ("checkpoint_error_prob", float),
-        ("sigterm_step", int),
-        ("sigkill_step", int),
-        ("nan_step", int),
-        ("spike_step", int),
-        ("spike_scale", float),
-        ("seed", int),
+    # env names are spelled out as literals (not derived from the field
+    # names) so the env-doc-drift lint rule can statically match each one
+    # against the docs/resilience.md chaos table
+    for field, env_name, cast in (
+        ("data_error_steps", "LLMT_CHAOS_DATA_ERROR_STEPS", _int_tuple),
+        ("checkpoint_error_steps", "LLMT_CHAOS_CHECKPOINT_ERROR_STEPS", _int_tuple),
+        ("data_error_prob", "LLMT_CHAOS_DATA_ERROR_PROB", float),
+        ("checkpoint_error_prob", "LLMT_CHAOS_CHECKPOINT_ERROR_PROB", float),
+        ("sigterm_step", "LLMT_CHAOS_SIGTERM_STEP", int),
+        ("sigkill_step", "LLMT_CHAOS_SIGKILL_STEP", int),
+        ("nan_step", "LLMT_CHAOS_NAN_STEP", int),
+        ("spike_step", "LLMT_CHAOS_SPIKE_STEP", int),
+        ("spike_scale", "LLMT_CHAOS_SPIKE_SCALE", float),
+        ("seed", "LLMT_CHAOS_SEED", int),
     ):
-        raw = os.environ.get(ENV_PREFIX + field.upper())
+        raw = os.environ.get(env_name)
         if raw is not None and raw != "":
             update[field] = cast(raw)
     base = base or ChaosConfig()

@@ -7,6 +7,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# graftlint FIRST: pure-AST, never imports jax, fails in seconds — the
+# pallas-arity / jax-free-import / host-sync / telemetry-prefix /
+# env-doc-drift invariants (docs/static-analysis.md). A violation message
+# names the rule; `python -m llm_training_tpu.analysis --list-rules` lists
+# them, and `# lint: allow(<rule>): <reason>` suppresses a deliberate one.
+echo "== precommit: graftlint (static analysis, pre-jax) =="
+python -m llm_training_tpu.analysis
+
 echo "== precommit: not-slow test tier =="
 python -m pytest tests/ -x -q -m "not slow" "$@"
 
