@@ -20,10 +20,22 @@ chip-hours before surfacing:
   so a new subsystem's gauges can never silently miss telemetry.jsonl.
 - **env-doc-drift**: every `LLMT_*`/`FLASH_*`/`BENCH_*`/`PAGED_*` env var
   the code reads appears in the docs env tables.
+- **logical-axis-literal**: every string literal used as logical-axis
+  param metadata under models/ appears in the `KNOWN_LOGICAL_AXES`
+  registry (`parallel/sharding.py`) — a typo'd axis name used to become a
+  silently fully-replicated weight.
 
-This package NEVER imports jax (enforced by its own jax-free contract):
-`python -m llm_training_tpu.analysis` is the first precommit gate and must
-fail in milliseconds, before any backend exists.
+The package also ships **shardcheck** (`--audit`, `shard_audit.py` +
+`hbm_budget.py`): an abstract-interpretation audit that `jax.eval_shape`s
+every registered model family's init and resolves the param/opt-state/
+KV-cache trees against a mesh-configuration matrix — unknown axes,
+duplicate-axis drops, indivisible dims, large replicated tensors, and a
+per-chip HBM-fit estimate (docs/static-analysis.md#audit).
+
+The AST lint gate NEVER imports jax (enforced by its own jax-free
+contract): `python -m llm_training_tpu.analysis` is the first precommit
+gate and must fail in milliseconds, before any backend exists. Only the
+`--audit` mode imports jax (lazily, CPU-only, zero FLOPs).
 """
 
 from llm_training_tpu.analysis.engine import (

@@ -81,6 +81,22 @@ ENV_DOC_FILES = (
     "docs/static-analysis.md",
 )
 
+# ---------------------------------------------------------------- rule 6
+# where the known-logical-axes registry lives; the `logical-axis-literal`
+# rule parses the literal KNOWN_LOGICAL_AXES tuple out of this file's AST
+# (same never-drifts trick as rule 4) so axis-name typos in models/ fail
+# at lint time, before the shardcheck audit ever eval_shapes anything
+SHARDING_REGISTRY_FILE = "llm_training_tpu/parallel/sharding.py"
+KNOWN_AXES_NAME = "KNOWN_LOGICAL_AXES"
+# calls whose tuple arguments carry logical-axis names
+LOGICAL_AXIS_CALLS = ("with_logical_partitioning", "with_logical_constraint")
+# helper functions threading axes through (llama/gemma `_dense`) declare
+# the parameter under this name; literal tuples at their call sites count
+LOGICAL_AXIS_PARAM = "logical_axes"
+# the directory whose files the rule scans (model param metadata only;
+# tests construct intentionally-broken fixtures)
+MODELS_DIR = "llm_training_tpu/models/"
+
 # ---------------------------------------------------------------- rule 3
 # jit wrappers whose first function argument starts a traced region
 JIT_WRAPPERS = ("jit", "pjit")

@@ -175,6 +175,7 @@ def all_rules() -> list[RuleSpec]:
         env_docs,
         host_sync,
         import_contracts,
+        logical_axes,
         pallas_arity,
         telemetry_prefixes,
     )
@@ -185,6 +186,7 @@ def all_rules() -> list[RuleSpec]:
         host_sync.RULE,
         telemetry_prefixes.RULE,
         env_docs.RULE,
+        logical_axes.RULE,
     ]
 
 
@@ -306,7 +308,9 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m llm_training_tpu.analysis",
         description=(
-            "graftlint: repo-native static analysis (never imports jax). "
+            "graftlint: repo-native static analysis (the AST rules never "
+            "import jax; `--audit` runs the shardcheck abstract-eval audit, "
+            "which does — CPU-only, zero FLOPs). "
             "Exit 0 = clean, 1 = findings, 2 = usage error."
         ),
         epilog=(
@@ -327,6 +331,35 @@ def main(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--list-rules", action="store_true", help="print the rule table and exit")
     parser.add_argument("--json", action="store_true", help="machine-readable output")
+    audit = parser.add_argument_group(
+        "shardcheck audit",
+        "`--audit` switches from AST lint to the abstract-eval sharding/"
+        "layout/HBM audit (shard_audit.py): jax.eval_shape over every "
+        "registered family's init, resolved against the mesh matrix. This "
+        "mode DOES import jax (CPU, zero FLOPs) and uses its own baseline "
+        "(config/audit_baseline.json).",
+    )
+    audit.add_argument(
+        "--audit", action="store_true",
+        help="run the family x mesh sharding/layout/HBM audit instead of the AST rules",
+    )
+    audit.add_argument(
+        "--families", default=None,
+        help="comma-separated family subset (default: all registered)",
+    )
+    audit.add_argument(
+        "--meshes", default=None,
+        help="comma-separated mesh-matrix subset (default: the full matrix)",
+    )
+    audit.add_argument(
+        "--hbm-budget-gib", type=float, default=None,
+        help="per-chip HBM budget the estimate is checked against (default 32)",
+    )
+    audit.add_argument(
+        "--replicated-threshold-mib", type=float, default=None,
+        help="tensors above this size may not resolve fully-replicated on "
+        "param-capable meshes (default 4)",
+    )
     parser.add_argument(
         "--root", type=Path, default=None, help="repo root (default: cwd if it holds llm_training_tpu/)"
     )
@@ -352,6 +385,36 @@ def main(argv: list[str] | None = None) -> int:
     root = (args.root or _default_root()).resolve()
     if not (root / "llm_training_tpu").is_dir():
         print(f"graftlint: {root} does not look like the repo root", file=sys.stderr)
+        return 2
+    audit_only_flags = (
+        args.families is not None
+        or args.meshes is not None
+        or args.hbm_budget_gib is not None
+        or args.replicated_threshold_mib is not None
+    )
+    if args.audit:
+        if args.paths or args.rules:
+            # lint-only scoping must not be silently ignored: a user who
+            # typed `--audit --rules ... path/` believes the run was scoped
+            print(
+                "graftlint: --audit takes --families/--meshes, not lint "
+                "paths or --rules",
+                file=sys.stderr,
+            )
+            return 2
+        # the shardcheck audit imports jax (lazily, here only) — the plain
+        # lint gate below stays jax-free
+        from llm_training_tpu.analysis.shard_audit import audit_main
+
+        return audit_main(args, root)
+    if audit_only_flags:
+        # the mirror mistake: audit scoping without --audit would silently
+        # run the full AST lint and look like a passing scoped audit
+        print(
+            "graftlint: --families/--meshes/--hbm-budget-gib/"
+            "--replicated-threshold-mib require --audit",
+            file=sys.stderr,
+        )
         return 2
     baseline_path = args.baseline or (root / DEFAULT_BASELINE)
     baseline_keys = set() if args.no_baseline else load_baseline(baseline_path)

@@ -1,0 +1,129 @@
+"""Per-chip HBM accounting for the shardcheck audit (`shard_audit.py`).
+
+Pure shape arithmetic — no jax anywhere: the audit hands this module plain
+`(shape, itemsize, spec)` tuples it extracted from `jax.eval_shape` trees,
+and mesh configurations are just `{axis: ways}` dicts, so the byte math is
+unit-testable without a backend and never drifts with jax APIs.
+
+The estimate mirrors what the trainer actually materializes per chip
+(docs/static-analysis.md#audit):
+
+  params      — every `nn.Partitioned` param leaf under its resolved spec
+  opt state   — the abstract `optax` state (Adam mu/nu shard like params;
+                scalars replicate)
+  kv cache    — the decode cache buffers under `infer/cache`'s layout
+  activations — a rough residual-stream proxy (see `activation_proxy_bytes`)
+
+Cross-check the estimate against the measured `hbm/peak_bytes_in_use`
+gauge in telemetry.jsonl — `report`'s `== Audit ==` section does exactly
+that when both exist.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Sequence
+
+GIB = 1024.0**3
+
+# activations proxy multiplier: per live decoder layer the residual stream
+# plus the handful of same-width intermediates remat keeps alive (attention
+# in/out, normed input, MLP in/out) — deliberately coarse; the audit's HBM
+# number is a *fit* check, not a profiler
+ACTIVATION_MULTIPLIER = 12
+
+# spec entry as produced by `resolve_spec`: None | mesh-axis | tuple of them
+SpecEntry = None | str | tuple[str, ...]
+
+
+def entry_ways(entry: SpecEntry, axis_sizes: dict[str, int]) -> int:
+    """How many ways one dimension shards under `axis_sizes` (missing mesh
+    axes count as 1 — an unlisted axis is an unsharded axis)."""
+    if entry is None:
+        return 1
+    axes = (entry,) if isinstance(entry, str) else tuple(entry)
+    ways = 1
+    for axis in axes:
+        ways *= int(axis_sizes.get(axis, 1))
+    return ways
+
+
+def shard_ways(
+    spec: Sequence[SpecEntry], shape: Sequence[int], axis_sizes: dict[str, int]
+) -> tuple[int, ...]:
+    """Per-dimension shard ways, padded with 1s for trailing unspecced dims
+    (a PartitionSpec may be shorter than the tensor rank)."""
+    padded = tuple(spec) + (None,) * (len(shape) - len(spec))
+    return tuple(entry_ways(entry, axis_sizes) for entry in padded[: len(shape)])
+
+
+def per_chip_bytes(
+    shape: Sequence[int], itemsize: int, ways: Sequence[int]
+) -> int:
+    """Bytes one chip holds for a tensor sharded `ways` per dim. Uneven
+    shards cost the ceil — GSPMD pads the ragged tail onto every chip."""
+    total = itemsize
+    for dim, way in zip(shape, ways):
+        total *= math.ceil(dim / max(1, way))
+    return int(total)
+
+
+def global_bytes(shape: Sequence[int], itemsize: int) -> int:
+    return int(itemsize * math.prod(shape))
+
+
+def activation_proxy_bytes(
+    batch: int,
+    seq: int,
+    hidden: int,
+    num_layers: int,
+    itemsize: int,
+    batch_ways: int,
+    seq_ways: int,
+) -> int:
+    """Rough per-chip activation footprint of one training step: the
+    [batch, seq, hidden] residual stream per layer times
+    ACTIVATION_MULTIPLIER, sharded by the batch-like and sequence mesh
+    ways. Deliberately ignores remat policy, attention scores, and logits —
+    a config this proxy says does not fit certainly does not."""
+    return int(
+        math.ceil(batch / max(1, batch_ways))
+        * math.ceil(seq / max(1, seq_ways))
+        * hidden
+        * num_layers
+        * itemsize
+        * ACTIVATION_MULTIPLIER
+    )
+
+
+@dataclass(frozen=True)
+class HbmEstimate:
+    """Per-chip HBM budget for one (family, mesh) cell of the audit."""
+
+    params_bytes: int
+    opt_state_bytes: int
+    kv_cache_bytes: int
+    activation_bytes: int
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            self.params_bytes
+            + self.opt_state_bytes
+            + self.kv_cache_bytes
+            + self.activation_bytes
+        )
+
+    def fits(self, budget_bytes: int) -> bool:
+        return self.total_bytes <= budget_bytes
+
+    def to_json(self) -> dict:
+        # 9 decimal places keeps byte-level resolution (1 B ≈ 9.3e-10 GiB)
+        return {
+            "params_gib": round(self.params_bytes / GIB, 9),
+            "opt_state_gib": round(self.opt_state_bytes / GIB, 9),
+            "kv_cache_gib": round(self.kv_cache_bytes / GIB, 9),
+            "activation_gib": round(self.activation_bytes / GIB, 9),
+            "total_gib": round(self.total_bytes / GIB, 9),
+        }

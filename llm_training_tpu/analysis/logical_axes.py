@@ -1,0 +1,182 @@
+"""Rule `logical-axis-literal`: axis-name strings in models/ must be known.
+
+The AST-level twin of the shardcheck audit's abstract-eval check
+(`shard_audit.py`): every string literal used as logical-axis parameter
+metadata under `models/` must appear in the `KNOWN_LOGICAL_AXES` registry
+(`parallel/sharding.py`). `logical_to_spec` historically mapped an unknown
+name to `None` — a one-character typo in a `with_logical_partitioning`
+tuple became a fully-replicated weight that OOMed or crawled only once it
+reached real hardware. The audit catches that at eval_shape time; this rule
+catches it before anything runs at all, including in config branches no
+tiny audit config reaches (a typo behind `mlp_type='xielu'` still fails).
+
+Checked sites:
+  - tuple arguments of `with_logical_partitioning` / `with_logical_constraint`
+    (args beyond the first, plus `names=` keywords — the first argument is
+    the initializer / the constrained array)
+  - literal tuples at call sites of helper functions declaring a
+    `logical_axes` parameter (the llama/gemma `_dense` pattern)
+  - string values of `metadata_params` dicts (`nn.scan` / `nn.vmap`
+    stacking-axis names: `{nn.PARTITION_NAME: "layers"}`)
+
+The registry is parsed LITERALLY out of the sharding file's AST (the same
+never-drifts trick as `telemetry-prefix`), so adding an axis is exactly one
+edit in `parallel/sharding.py`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_training_tpu.analysis import contracts
+from llm_training_tpu.analysis.astutils import terminal_name
+from llm_training_tpu.analysis.engine import Finding, RepoContext, RuleSpec
+
+
+def known_axes(ctx: RepoContext) -> frozenset[str] | None:
+    """The literal KNOWN_LOGICAL_AXES tuple, or None when unparseable."""
+    parsed = ctx.file(contracts.SHARDING_REGISTRY_FILE)
+    if parsed is None:
+        return None
+    for node in parsed.tree.body:
+        if isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+            value = node.value
+        elif isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        else:
+            continue
+        for target in targets:
+            if (
+                isinstance(target, ast.Name)
+                and target.id == contracts.KNOWN_AXES_NAME
+                and isinstance(value, (ast.Tuple, ast.List))
+            ):
+                return frozenset(
+                    el.value
+                    for el in value.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str)
+                )
+    return None
+
+
+def _tuple_strings(expr: ast.AST) -> list[tuple[str, int]]:
+    """(string, line) for every str constant inside a tuple/list literal
+    anywhere under `expr` — catches `(None,) * k + ("norm",)` style
+    concatenations because the inner Tuple node is still a child."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.append((el.value, el.lineno))
+    return out
+
+
+def _axis_param_index(fn: ast.FunctionDef) -> int | None:
+    """Positional index of a `logical_axes` parameter, if the function
+    declares one."""
+    for index, arg in enumerate(fn.args.args):
+        if arg.arg == contracts.LOGICAL_AXIS_PARAM:
+            return index
+    return None
+
+
+def _candidate_exprs(tree: ast.Module) -> list[ast.AST]:
+    """Every expression in the file whose tuple string literals are
+    logical-axis names."""
+    helpers: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            index = _axis_param_index(node)
+            if index is not None:
+                helpers[node.name] = index
+
+    exprs: list[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = terminal_name(node.func)
+        if name in contracts.LOGICAL_AXIS_CALLS:
+            exprs.extend(node.args[1:])
+            exprs.extend(
+                kw.value for kw in node.keywords if kw.arg == "names"
+            )
+        elif name in helpers:
+            index = helpers[name]
+            if index < len(node.args):
+                exprs.append(node.args[index])
+            exprs.extend(
+                kw.value
+                for kw in node.keywords
+                if kw.arg == contracts.LOGICAL_AXIS_PARAM
+            )
+        for kw in node.keywords:
+            # nn.scan/nn.vmap metadata_params={nn.PARTITION_NAME: "layers"}
+            if kw.arg == "metadata_params" and isinstance(kw.value, ast.Dict):
+                exprs.extend(
+                    v for v in kw.value.values
+                    if isinstance(v, ast.Constant) and isinstance(v.value, str)
+                )
+    return exprs
+
+
+def _run(ctx: RepoContext) -> list[Finding]:
+    axes = known_axes(ctx)
+    if axes is None:
+        return [
+            Finding(
+                rule=RULE.name,
+                path=contracts.SHARDING_REGISTRY_FILE,
+                line=1,
+                message=(
+                    f"could not parse the literal {contracts.KNOWN_AXES_NAME} "
+                    "tuple out of the sharding file; the logical-axis "
+                    "registry contract is unverifiable"
+                ),
+            )
+        ]
+    findings: list[Finding] = []
+    for parsed in ctx.files:
+        if not parsed.path.startswith(contracts.MODELS_DIR):
+            continue
+        seen: set[tuple[str, int]] = set()
+        for expr in _candidate_exprs(parsed.tree):
+            strings = (
+                [(expr.value, expr.lineno)]
+                if isinstance(expr, ast.Constant) and isinstance(expr.value, str)
+                else _tuple_strings(expr)
+            )
+            for value, line in strings:
+                if value in axes or (value, line) in seen:
+                    continue
+                seen.add((value, line))
+                findings.append(
+                    Finding(
+                        rule=RULE.name,
+                        path=parsed.path,
+                        line=line,
+                        message=(
+                            f"string literal '{value}' used as logical-axis "
+                            "metadata is not in "
+                            f"{contracts.KNOWN_AXES_NAME} — logical_to_spec "
+                            "would silently replicate the tensor onto every "
+                            "chip; fix the typo, or register the axis in "
+                            f"{contracts.SHARDING_REGISTRY_FILE}"
+                        ),
+                    )
+                )
+    return findings
+
+
+RULE = RuleSpec(
+    name="logical-axis-literal",
+    description=(
+        "every string literal used as logical-axis param metadata under "
+        "models/ must appear in the KNOWN_LOGICAL_AXES registry "
+        "(parallel/sharding.py) — the AST-level twin of `--audit`'s "
+        "unknown-axis check"
+    ),
+    run=_run,
+)

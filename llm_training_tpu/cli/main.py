@@ -532,6 +532,11 @@ def main(argv: list[str] | None = None) -> int:
         help="supervisor.jsonl with per-segment topology events "
         "(== Elastic == section); default: <run_dir>/supervisor.jsonl",
     )
+    report.add_argument(
+        "--audit-dir", default=None,
+        help="dir searched first for the newest audit*.json shardcheck "
+        "record (== Audit == section); falls back to run_dir",
+    )
     supervise = sub.add_parser(
         "supervise",
         help="run fit as a supervised child process; restart it on "
@@ -578,6 +583,7 @@ def main(argv: list[str] | None = None) -> int:
             args.run_dir,
             bench_dir=args.bench_dir,
             supervisor_log=args.supervisor_log,
+            audit_dir=args.audit_dir,
         )
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU

@@ -28,8 +28,12 @@ from llm_training_tpu.parallel.mesh import (
     SEQUENCE_AXIS,
 )
 from llm_training_tpu.parallel.sharding import (
+    AxisDrop,
     DEFAULT_LOGICAL_AXIS_RULES,
+    KNOWN_LOGICAL_AXES,
+    UnknownLogicalAxisError,
     logical_to_sharding,
+    resolve_spec,
     shard_pytree,
 )
 
@@ -42,7 +46,11 @@ __all__ = [
     "PIPELINE_AXIS",
     "TENSOR_AXIS",
     "SEQUENCE_AXIS",
+    "AxisDrop",
     "DEFAULT_LOGICAL_AXIS_RULES",
+    "KNOWN_LOGICAL_AXES",
+    "UnknownLogicalAxisError",
     "logical_to_sharding",
+    "resolve_spec",
     "shard_pytree",
 ]

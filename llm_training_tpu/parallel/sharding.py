@@ -17,6 +17,7 @@ The rule table reproduces the reference plan:
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 import jax
@@ -30,6 +31,66 @@ from llm_training_tpu.parallel.mesh import (
     SEQUENCE_AXIS,
     TENSOR_AXIS,
 )
+
+# The known-logical-axes registry: THE single place a logical axis name is
+# born (docs/parallelism.md). Everything else derives from it — the default
+# rule table below must only use these names, the trainer resolves param
+# metadata strictly against it, the shardcheck audit
+# (`python -m llm_training_tpu.analysis --audit`) abstract-evals every model
+# family against it, and the `logical-axis-literal` graftlint rule parses
+# this literal tuple out of this file's AST to reject unknown axis strings
+# in models/ before anything runs. Keep it a plain literal tuple.
+KNOWN_LOGICAL_AXES: tuple[str, ...] = (
+    # activations
+    "batch",
+    "act_seq",
+    "act_embed",
+    "act_heads",
+    "act_vocab",
+    # parameters
+    "embed",
+    "heads",
+    "kv_heads",
+    "mlp",
+    "vocab",
+    "norm",
+    "expert",
+    # structural stacking axes: pipeline stage stacks and flax scan stacks
+    "stages",
+    "layers",
+)
+
+
+class UnknownLogicalAxisError(ValueError):
+    """A logical-axis name that no rule knows. Without strict mode this is
+    the silent-replication bug class: `logical_to_spec` maps the unknown
+    name to None and the parameter replicates onto every chip."""
+
+    def __init__(self, axis: str, known: Sequence[str], path: str | None = None):
+        self.axis = axis
+        self.path = path
+        at = f" on leaf {path!r}" if path else ""
+        super().__init__(
+            f"unknown logical axis {axis!r}{at}; known axes: "
+            f"{sorted(known)}. An unknown name silently replicates the "
+            "tensor across the whole mesh — fix the typo, or register the "
+            "new axis in KNOWN_LOGICAL_AXES + the rule table "
+            "(llm_training_tpu/parallel/sharding.py)."
+        )
+
+
+@dataclass(frozen=True)
+class AxisDrop:
+    """A mesh axis silently dropped during spec resolution because an
+    earlier dimension of the same tensor already consumed it (PartitionSpec
+    forbids reuse). Legal — but a tensor that *meant* to shard a large dim
+    this way ends up wider per chip than intended, so resolution returns
+    these as structured warnings instead of vanishing them."""
+
+    axis: str  # the logical axis whose mapping was truncated
+    mesh_axes: tuple[str, ...]  # the mesh axes that were dropped
+    position: int  # dimension index within the tensor
+    path: str | None = None  # pytree leaf path, when the caller knows it
 
 # (logical axis name, mesh axis / axes / None=replicated)
 LogicalAxisRules = Sequence[tuple[str, str | Sequence[str] | None]]
@@ -58,6 +119,14 @@ DEFAULT_LOGICAL_AXIS_RULES: LogicalAxisRules = (
     ("stages", PIPELINE_AXIS),
 )
 
+# the registry and the rule table must never drift: every rule name is
+# registered, and every registered name has a rule ('layers' — the flax
+# scan stacking axis — gets its replicated rule from the Trainer, which
+# appends ('layers', None) to these defaults)
+assert set(KNOWN_LOGICAL_AXES) == (
+    {name for name, _ in DEFAULT_LOGICAL_AXIS_RULES} | {"layers"}
+), "KNOWN_LOGICAL_AXES out of sync with DEFAULT_LOGICAL_AXIS_RULES"
+
 
 def _rules_dict(rules: LogicalAxisRules) -> dict[str, Any]:
     seen: dict[str, Any] = {}
@@ -67,15 +136,29 @@ def _rules_dict(rules: LogicalAxisRules) -> dict[str, Any]:
     return seen
 
 
-def logical_to_spec(
+def resolve_spec(
     logical_axes: Sequence[str | None],
     rules: LogicalAxisRules = DEFAULT_LOGICAL_AXIS_RULES,
-) -> PartitionSpec:
-    """('embed', 'mlp') -> PartitionSpec('fsdp', 'tensor')."""
+    *,
+    strict: bool = False,
+    path: str | None = None,
+) -> tuple[PartitionSpec, tuple[AxisDrop, ...]]:
+    """Resolve logical axis names to a PartitionSpec, reporting what the
+    legacy resolution silently swallowed.
+
+    `strict=True` raises UnknownLogicalAxisError (with `path`, when given)
+    for any name absent from the rule table — the one-character-typo →
+    fully-replicated-weight class. Duplicate-mesh-axis drops (an earlier
+    dim already consumed the axis) come back as structured `AxisDrop`
+    warnings either way; callers that care (the Trainer, the shardcheck
+    audit) surface them instead of letting them vanish."""
     table = _rules_dict(rules)
     spec: list[Any] = []
+    drops: list[AxisDrop] = []
     used: set[str] = set()
-    for axis in logical_axes:
+    for position, axis in enumerate(logical_axes):
+        if axis is not None and axis not in table and strict:
+            raise UnknownLogicalAxisError(axis, known=tuple(table), path=path)
         mesh_axes = table.get(axis) if axis is not None else None
         if mesh_axes is None:
             spec.append(None)
@@ -83,6 +166,11 @@ def logical_to_spec(
         if isinstance(mesh_axes, str):
             mesh_axes = (mesh_axes,)
         free = tuple(a for a in mesh_axes if a not in used)
+        dropped = tuple(a for a in mesh_axes if a in used)
+        if dropped:
+            drops.append(
+                AxisDrop(axis=axis, mesh_axes=dropped, position=position, path=path)
+            )
         used.update(free)
         if not free:
             spec.append(None)
@@ -90,7 +178,19 @@ def logical_to_spec(
             spec.append(free[0])
         else:
             spec.append(free)
-    return PartitionSpec(*spec)
+    return PartitionSpec(*spec), tuple(drops)
+
+
+def logical_to_spec(
+    logical_axes: Sequence[str | None],
+    rules: LogicalAxisRules = DEFAULT_LOGICAL_AXIS_RULES,
+    *,
+    strict: bool = False,
+    path: str | None = None,
+) -> PartitionSpec:
+    """('embed', 'mlp') -> PartitionSpec('fsdp', 'tensor')."""
+    spec, _ = resolve_spec(logical_axes, rules, strict=strict, path=path)
+    return spec
 
 
 def logical_to_sharding(

@@ -236,16 +236,18 @@ def _serving_section(telemetry: dict) -> list[str]:
     return lines
 
 
-def _newest_bench_record(dirs: list[Path]) -> tuple[dict, str] | None:
-    """The newest bench record reachable from `dirs` (first match wins the
-    directory tie; within a directory, newest mtime then name — BENCH_rNN
-    names sort by round). Accepts both shapes: a raw bench.py summary
-    record and the driver's wrapper {n, cmd, rc, tail, parsed}."""
+def _newest_json_record(
+    dirs: list[Path], patterns: tuple[str, ...]
+) -> tuple[dict, str] | None:
+    """The newest JSON dict matching `patterns` reachable from `dirs`:
+    first directory with any match wins the tie; within it, newest mtime
+    then name (BENCH_rNN names sort by round). Unreadable/non-dict files
+    return None — the caller's section degrades or is omitted."""
     candidates: list[Path] = []
     for d in dirs:
         if d is None or not d.is_dir():
             continue
-        for pattern in ("BENCH_r*.json", "bench*.json"):
+        for pattern in patterns:
             candidates.extend(d.glob(pattern))
         if candidates:
             break
@@ -258,13 +260,24 @@ def _newest_bench_record(dirs: list[Path]) -> tuple[dict, str] | None:
         return None
     if not isinstance(record, dict):
         return None
+    return record, newest.name
+
+
+def _newest_bench_record(dirs: list[Path]) -> tuple[dict, str] | None:
+    """The newest bench record reachable from `dirs`. Accepts both shapes:
+    a raw bench.py summary record and the driver's wrapper
+    {n, cmd, rc, tail, parsed}."""
+    found = _newest_json_record(dirs, ("BENCH_r*.json", "bench*.json"))
+    if found is None:
+        return None
+    record, name = found
     if "parsed" in record:  # driver wrapper
         parsed = record.get("parsed")
         if not isinstance(parsed, dict):
             parsed = {"error": f"bench crashed before emitting a record "
                                f"(rc {record.get('rc')})"}
         record = parsed
-    return record, newest.name
+    return record, name
 
 
 def _perf_section(bench: tuple[dict, str] | None) -> list[str]:
@@ -330,6 +343,83 @@ def _perf_lines(record: dict) -> list[str]:
             + (f"  prefill {float(record['prefill_time_s']):.3f}s"
                if record.get("prefill_time_s") is not None else "")
         )
+    return lines
+
+
+def _newest_audit_record(dirs: list[Path]) -> tuple[dict, str] | None:
+    """The newest shardcheck audit record (`--audit --json` output saved as
+    audit*.json) reachable from `dirs`."""
+    return _newest_json_record(dirs, ("audit*.json",))
+
+
+def _audit_section(
+    audit: tuple[dict, str] | None, telemetry: dict
+) -> list[str]:
+    """Newest shardcheck audit record (docs/static-analysis.md#audit):
+    finding count, worst per-chip HBM estimate, and — when the run also
+    recorded the measured `hbm/peak_bytes_in_use` gauge — the measured
+    number next to the estimate so drift between the audit's model of HBM
+    and reality is visible in one place. Omitted when no audit record is
+    reachable; a foreign/malformed audit*.json costs one honest line,
+    mirroring `== Perf ==`."""
+    if audit is None:
+        return []
+    record, name = audit
+    header = ["", "== Audit ==", f"audit record: {name}"]
+    try:
+        return header + _audit_lines(record, telemetry)
+    except (KeyError, TypeError, ValueError, AttributeError):
+        return header + ["unreadable audit record — malformed fields"]
+
+
+def _audit_lines(record: dict, telemetry: dict) -> list[str]:
+    lines = []
+    findings = record.get("findings")
+    families = record.get("families") or []
+    meshes = record.get("meshes") or []
+    if findings is None:
+        lines.append(
+            f"audit: unavailable — {record.get('error', 'no findings recorded')}"
+        )
+        return lines
+    status = "FAIL" if findings else "OK"
+    line = (
+        f"shardcheck: {status} — {len(findings)} finding(s), "
+        f"{len(families)} family(ies) x {len(meshes)} mesh(es)"
+    )
+    baselined = record.get("baselined")
+    if baselined:
+        line += f", {int(baselined)} baselined"
+    lines.append(line)
+    by_rule: dict[str, int] = {}
+    for finding in findings:
+        by_rule[finding.get("rule", "?")] = by_rule.get(finding.get("rule", "?"), 0) + 1
+    if by_rule:
+        lines.append(
+            "findings: " + "  ".join(f"{r} x{n}" for r, n in sorted(by_rule.items()))
+        )
+    # lazy import: shard_audit is jax-free at module level, and this keeps
+    # the one walk over the estimates schema in one place
+    from llm_training_tpu.analysis.shard_audit import worst_estimate
+
+    worst = worst_estimate(record.get("estimates") or {})
+    if worst is not None:
+        line = f"worst per-chip HBM estimate: {worst[2]:.3f} GiB ({worst[0]} @ {worst[1]}"
+        budget = record.get("hbm_budget_gib")
+        if budget is not None:
+            line += f", budget {float(budget):.1f} GiB"
+        line += ")"
+        lines.append(line)
+        measured = telemetry.get("hbm/peak_bytes_in_use")
+        if measured is not None:
+            # the audited families are the tiny registry proxies, not this
+            # run's model — the cross-reference shows scale drift, not a
+            # per-run prediction
+            lines.append(
+                f"measured hbm/peak_bytes_in_use: {float(measured) / _GIB:.3f} "
+                "GiB (this run's model; audit estimates cover the registry "
+                "families)"
+            )
     return lines
 
 
@@ -531,6 +621,7 @@ def render_report(
     run_dir: str | Path,
     bench_dir: str | Path | None = None,
     supervisor_log: str | Path | None = None,
+    audit_dir: str | Path | None = None,
 ) -> str:
     run_dir = Path(run_dir)
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
@@ -625,6 +716,9 @@ def render_report(
     lines.extend(_perf_section(_newest_bench_record([
         Path(bench_dir) if bench_dir else None, run_dir, Path.cwd(),
     ])))
+    lines.extend(_audit_section(_newest_audit_record([
+        Path(audit_dir) if audit_dir else None, run_dir,
+    ]), telemetry))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
     lines.extend(_elastic_section(
@@ -643,11 +737,13 @@ def report_main(
     run_dir: str,
     bench_dir: str | None = None,
     supervisor_log: str | None = None,
+    audit_dir: str | None = None,
 ) -> int:
     """`llm-training-tpu report <run_dir>` entry point."""
     try:
         print(render_report(
-            run_dir, bench_dir=bench_dir, supervisor_log=supervisor_log
+            run_dir, bench_dir=bench_dir, supervisor_log=supervisor_log,
+            audit_dir=audit_dir,
         ))
     except FileNotFoundError as e:
         print(f"report: {e}", file=sys.stderr)

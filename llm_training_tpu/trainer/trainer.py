@@ -35,6 +35,7 @@ from llm_training_tpu.parallel.mesh import MeshConfig, build_mesh
 from llm_training_tpu.parallel.sharding import (
     DEFAULT_LOGICAL_AXIS_RULES,
     logical_to_spec,
+    resolve_spec,
 )
 from llm_training_tpu.resilience import (
     GracefulShutdown,
@@ -334,18 +335,38 @@ class Trainer:
         return jax.eval_shape(make_state, jax.random.key(self.config.seed))
 
     def _state_shardings(self, abstract_state) -> Any:
-        def leaf_sharding(leaf):
+        # STRICT resolution: an unknown logical-axis name in any param's
+        # metadata raises UnknownLogicalAxisError naming the leaf — the
+        # legacy behavior silently replicated the weight across the mesh
+        # (OOM/crawl only on real hardware; see `python -m
+        # llm_training_tpu.analysis --audit`). Duplicate-mesh-axis drops are
+        # legal but no longer invisible: they surface once as a warning.
+        drops = []
+
+        def leaf_sharding(path, leaf):
             if isinstance(leaf, nn.Partitioned):
-                spec = logical_to_spec(leaf.names, LOGICAL_AXIS_RULES)
+                spec, leaf_drops = resolve_spec(
+                    leaf.names, LOGICAL_AXIS_RULES, strict=True,
+                    path=jax.tree_util.keystr(path),
+                )
+                drops.extend(leaf_drops)
             else:
                 spec = PartitionSpec()
             return NamedSharding(self.mesh, spec)
 
-        shardings = jax.tree.map(
+        shardings = jax.tree_util.tree_map_with_path(
             leaf_sharding,
             abstract_state,
             is_leaf=lambda x: isinstance(x, nn.Partitioned),
         )
+        for drop in drops:
+            logger.warning(
+                "sharding: %s dim %d (logical %r) dropped duplicate mesh "
+                "axes %s — an earlier dim of the tensor already consumed "
+                "them; the dim stays wider per chip than the rule table "
+                "suggests", drop.path, drop.position, drop.axis,
+                list(drop.mesh_axes),
+            )
         if self.config.offload_optimizer_state:
             def maybe_host(sharding, leaf):
                 # only real arrays (mu/nu) move to host; rank-0 counters stay

@@ -7,13 +7,34 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+SMOKE_ROOT=$(mktemp -d)
+trap 'rm -rf "${SMOKE_ROOT}"' EXIT
+
 # graftlint FIRST: pure-AST, never imports jax, fails in seconds — the
 # pallas-arity / jax-free-import / host-sync / telemetry-prefix /
-# env-doc-drift invariants (docs/static-analysis.md). A violation message
-# names the rule; `python -m llm_training_tpu.analysis --list-rules` lists
-# them, and `# lint: allow(<rule>): <reason>` suppresses a deliberate one.
+# env-doc-drift / logical-axis-literal invariants
+# (docs/static-analysis.md). A violation message names the rule;
+# `python -m llm_training_tpu.analysis --list-rules` lists them, and
+# `# lint: allow(<rule>): <reason>` suppresses a deliberate one.
 echo "== precommit: graftlint (static analysis, pre-jax) =="
 python -m llm_training_tpu.analysis
+
+# shardcheck SECOND (docs/static-analysis.md#audit): abstract-eval every
+# registered family's init (jax.eval_shape, CPU, zero FLOPs) and resolve
+# the param/opt-state/KV-cache trees against the mesh matrix — unknown
+# logical axes, duplicate-axis drops, indivisible dims, large replicated
+# tensors, per-chip HBM fit. The JSON lands in SMOKE_ROOT so the report
+# gate below renders == Audit == from it.
+echo "== precommit: shardcheck (family x mesh sharding/HBM audit) =="
+if ! JAX_PLATFORMS=cpu python -m llm_training_tpu.analysis --audit --json \
+    | tee "${SMOKE_ROOT}/audit.json" >/dev/null; then
+    # the findings went only to the teed JSON, and the EXIT trap deletes
+    # SMOKE_ROOT — print them before dying or the failure is undebuggable
+    echo "shardcheck FAILED — findings:" >&2
+    python -m json.tool "${SMOKE_ROOT}/audit.json" >&2 \
+        || cat "${SMOKE_ROOT}/audit.json" >&2
+    exit 1
+fi
 
 echo "== precommit: not-slow test tier =="
 python -m pytest tests/ -x -q -m "not slow" "$@"
@@ -21,17 +42,20 @@ python -m pytest tests/ -x -q -m "not slow" "$@"
 # telemetry/report gate: the tiny CPU config must produce a run dir whose
 # metrics.jsonl/telemetry.jsonl render into a goodput table with exit 0
 echo "== precommit: report smoke (CPU fit -> report) =="
-SMOKE_ROOT=$(mktemp -d)
-trap 'rm -rf "${SMOKE_ROOT}"' EXIT
 JAX_PLATFORMS=cpu python -m llm_training_tpu fit \
     --config config/examples/smoke/cpu-smoke.yaml "run_root=${SMOKE_ROOT}"
 JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
-    | tee "${SMOKE_ROOT}/report_smoke.log"
+    --audit-dir "${SMOKE_ROOT}" | tee "${SMOKE_ROOT}/report_smoke.log"
 grep -q "goodput" "${SMOKE_ROOT}/report_smoke.log"
 # the smoke config sets health.every_n_steps on a tiny MoE model, so the
 # report must render the model-health section (per-layer norms + router
 # stats flowed registry -> telemetry.jsonl -> report)
 grep -q "== Health ==" "${SMOKE_ROOT}/report_smoke.log"
+# the shardcheck gate above wrote audit.json into SMOKE_ROOT; report must
+# render it as == Audit == (with the measured-HBM cross-reference when the
+# run recorded the hbm gauge)
+grep -q "== Audit ==" "${SMOKE_ROOT}/report_smoke.log"
+grep -q "shardcheck: OK" "${SMOKE_ROOT}/report_smoke.log"
 
 # inference gate (docs/inference.md): generate + evaluate must run
 # end-to-end from the smoke fit's checkpoint, emit nonzero output, and land

@@ -37,6 +37,14 @@ TELEMETRY_PREFIXES = ("goodput/", "decode/", "flash/")
 TELEMETRY_KEYS = ("compile_time_s",)
 """
 
+# the logical-axis registry the `logical-axis-literal` rule parses out of
+# the sharding file's AST (fixture trees get a tiny stand-in)
+_DEFAULT_SHARDING = """
+KNOWN_LOGICAL_AXES: tuple[str, ...] = (
+    "batch", "embed", "mlp", "norm", "layers", "stages",
+)
+"""
+
 
 def make_repo(tmp_path: Path, files: dict[str, str]) -> Path:
     """A minimal tree the engine accepts as a repo root: package inits, the
@@ -47,6 +55,8 @@ def make_repo(tmp_path: Path, files: dict[str, str]) -> Path:
         "llm_training_tpu/__init__.py": "",
         "llm_training_tpu/callbacks/__init__.py": "",
         "llm_training_tpu/callbacks/loggers.py": _DEFAULT_LOGGERS,
+        "llm_training_tpu/parallel/__init__.py": "",
+        "llm_training_tpu/parallel/sharding.py": _DEFAULT_SHARDING,
         "docs/performance.md": "env table: BENCH_DOCUMENTED, FLASH_DOCUMENTED\n",
     }
     for contract_rel in contracts.JAX_FREE_CONTRACTS:
@@ -70,7 +80,7 @@ def findings_for(root: Path, rule: str | None = None, **kwargs):
 # --------------------------------------------------------------- engine
 
 
-def test_rule_table_has_the_five_rules():
+def test_rule_table_has_the_six_rules():
     names = [rule.name for rule in all_rules()]
     assert names == [
         "pallas-kernel-arity",
@@ -78,6 +88,7 @@ def test_rule_table_has_the_five_rules():
         "host-sync",
         "telemetry-prefix",
         "env-doc-drift",
+        "logical-axis-literal",
     ]
 
 
@@ -647,6 +658,78 @@ def test_env_doc_drift_ignores_docstring_mentions(tmp_path):
     assert findings_for(root, "env-doc-drift") == []
 
 
+# ------------------------------------------------- logical-axis-literal
+
+
+_AXIS_FIXTURE = """
+    import flax.linen as nn
+
+
+    def _dense(features, logical_axes, name):
+        return nn.Dense(
+            features,
+            kernel_init=nn.with_logical_partitioning(init, logical_axes),
+            name=name,
+        )
+
+
+    class Block(nn.Module):
+        def __call__(self, x):
+            w = self.param(
+                "w",
+                nn.with_logical_partitioning(init, ("embd", "mlp")),  # typo
+                (4, 4),
+            )
+            x = nn.with_logical_constraint(x, ("batch", None, "norm"))
+            up = _dense(8, ("embed", "mpl"), "up")  # typo via the helper
+            scanned = nn.scan(
+                Block, metadata_params={nn.PARTITION_NAME: "layrs"},  # typo
+            )
+            shaped = (None,) * 2 + ("norm",)  # concatenated tuple: known
+            return x
+"""
+
+
+def test_logical_axis_literal_flags_typos_in_models(tmp_path):
+    root = make_repo(
+        tmp_path, {"llm_training_tpu/models/fake/model.py": _AXIS_FIXTURE}
+    )
+    found = findings_for(root, "logical-axis-literal")
+    bad = sorted(f.message.split("'")[1] for f in found)
+    assert bad == ["embd", "layrs", "mpl"], [f.render() for f in found]
+    for finding in found:
+        assert "KNOWN_LOGICAL_AXES" in finding.message
+
+
+def test_logical_axis_literal_only_scans_models(tmp_path):
+    # the same typo outside models/ (e.g. an infer helper building specs
+    # dynamically) is the audit's job, not this rule's
+    root = make_repo(
+        tmp_path, {"llm_training_tpu/infer/helper.py": _AXIS_FIXTURE}
+    )
+    assert findings_for(root, "logical-axis-literal") == []
+
+
+def test_logical_axis_literal_unparseable_registry_is_loud(tmp_path):
+    root = make_repo(
+        tmp_path,
+        {
+            "llm_training_tpu/parallel/sharding.py": "KNOWN_LOGICAL_AXES = build()\n",
+            "llm_training_tpu/models/fake/model.py": _AXIS_FIXTURE,
+        },
+    )
+    found = findings_for(root, "logical-axis-literal")
+    assert len(found) == 1 and "unverifiable" in found[0].message
+
+
+def test_logical_axis_literal_real_models_clean():
+    """Every axis literal in the real models/ tree is registered (the
+    whole-repo capstone also proves this; this narrow run localizes a
+    failure to the rule)."""
+    found = findings_for(REPO_ROOT, "logical-axis-literal")
+    assert found == [], [f.render() for f in found]
+
+
 # --------------------------------------------------------------- CLI
 
 
@@ -681,6 +764,16 @@ def test_cli_baseline_workflow(tmp_path, capsys):
     assert main(["--root", str(root)]) == 0  # grandfathered
     assert main(["--root", str(root), "--no-baseline"]) == 1
     capsys.readouterr()
+
+
+def test_cli_audit_rejects_lint_scoping(tmp_path, capsys):
+    # `--audit` must not silently ignore lint-only scoping — a user who
+    # typed `--audit --rules x path/` believes the run was scoped. Returns
+    # 2 BEFORE the audit module (and jax) would load.
+    root = make_repo(tmp_path, {})
+    assert main(["--root", str(root), "--audit", "--rules", "host-sync"]) == 2
+    assert main(["--root", str(root), "--audit", "llm_training_tpu"]) == 2
+    assert "--families/--meshes" in capsys.readouterr().err
 
 
 def test_cli_list_rules(capsys):
