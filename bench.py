@@ -8,6 +8,7 @@ process (the PR 3/PR 5 `Supervisor` + `HangWatchdog` machinery):
   backend_init  prove the jax backend answers at all (the r05 wedge)
   train         the headline MFU fit
   health        A/B fit with the model-health layer on (health_overhead_pct)
+  trace         A/B fit with host tracing fully on (trace_overhead_pct)
   decode        tiny-model generate (decode-program overhead trend)
   serve         tiny-model continuous batching (serve tokens/s/chip + TTFT)
 
@@ -44,7 +45,7 @@ import subprocess
 import sys
 import time
 
-STAGES = ("backend_init", "train", "health", "decode", "serve")
+STAGES = ("backend_init", "train", "health", "trace", "decode", "serve")
 
 # peak bf16 FLOP/s per chip by TPU generation (public specs)
 _PEAK_FLOPS = {
@@ -203,8 +204,10 @@ def _model_setup():
     model_kwargs["max_position_embeddings"] = max(
         model_kwargs["max_position_embeddings"], seq
     )
-    steps = 10 if on_tpu else 3
-    warmup = 2 if on_tpu else 1
+    # BENCH_STEPS/BENCH_WARMUP: more measured intervals tighten the A/B
+    # overhead stages' medians (the CPU default keeps precommit fast)
+    steps = int(os.environ.get("BENCH_STEPS", 10 if on_tpu else 3))
+    warmup = int(os.environ.get("BENCH_WARMUP", 2 if on_tpu else 1))
     return model_kwargs, seq, batch, steps, warmup, on_tpu
 
 
@@ -438,6 +441,38 @@ def stage_health() -> dict:
     return {"sec_per_step_health": round(sec_health, 4)}
 
 
+def stage_trace() -> dict:
+    """Same fit as the train stage with host tracing AT ITS DEFAULT
+    deployment — ring recording every step + an attached trace.jsonl sink
+    receiving the coarse lifecycle events (per-step span WRITES stay off,
+    exactly as a production run defaults). The parent divides against the
+    train stage's sec_per_step for trace_overhead_pct, the gauge that
+    proves the event layer stays under its <2% budget at default sampling
+    (docs/observability.md#tracing). LLMT_TRACE_TRAIN=1 on this stage
+    additionally prices the per-step sink writes."""
+    import shutil
+    import tempfile
+
+    from llm_training_tpu.telemetry.trace import get_tracer
+
+    tracer = get_tracer()
+    sink_dir = tempfile.mkdtemp(prefix="bench-trace-")
+    tracer.attach_sink(os.path.join(sink_dir, "trace.jsonl"))
+    model_kwargs, seq, batch, steps, warmup, on_tpu = _model_setup()
+    try:
+        _, _, sec_trace = _timed_fit(
+            model_kwargs, seq, batch, steps, warmup, on_tpu
+        )
+    finally:
+        counts = tracer.counts()
+        tracer.detach_sink()
+        shutil.rmtree(sink_dir, ignore_errors=True)
+    return {
+        "sec_per_step_trace": round(sec_trace, 4),
+        "trace_events_written": counts["written"],
+    }
+
+
 def stage_decode() -> dict:
     """Decode-path gauge (docs/inference.md): a TINY-model generate run —
     the gauge tracks the decode program's dispatch/step overhead trend, not
@@ -523,6 +558,7 @@ _STAGE_FNS = {
     "backend_init": stage_backend_init,
     "train": stage_train,
     "health": stage_health,
+    "trace": stage_trace,
     "decode": stage_decode,
     "serve": stage_serve,
 }
@@ -551,6 +587,7 @@ def _stage_timeout(stage: str) -> float:
         "backend_init": env("BENCH_BACKEND_TIMEOUT", 300),
         "train": run_timeout,
         "health": env("BENCH_HEALTH_TIMEOUT", run_timeout),
+        "trace": env("BENCH_TRACE_TIMEOUT", run_timeout),
         "decode": env("BENCH_DECODE_TIMEOUT", 600),
         "serve": env("BENCH_SERVE_TIMEOUT", 600),
     }[stage]
@@ -559,6 +596,8 @@ def _stage_timeout(stage: str) -> float:
 def _stage_enabled(stage: str) -> bool:
     if stage == "health":
         return os.environ.get("BENCH_HEALTH", "1") != "0"
+    if stage == "trace":
+        return os.environ.get("BENCH_TRACE", "1") != "0"
     if stage == "decode":
         return os.environ.get("BENCH_DECODE", "1") != "0"
     if stage == "serve":
@@ -688,6 +727,16 @@ def summarize(results: dict) -> dict:
         summary["health_overhead_pct"] = round(100.0 * overhead, 2)
     else:
         summary["health_overhead_pct"] = None
+    # step-time cost of the event layer at its DEFAULT deployment (ring
+    # recording + coarse sink events; per-step writes only if the stage ran
+    # with LLMT_TRACE_TRAIN=1) vs untraced; the <2% acceptance gauge
+    trace = results.get("trace", {})
+    if ok("train") and ok("trace") and train.get("sec_per_step"):
+        overhead = (trace["sec_per_step_trace"] - train["sec_per_step"]) \
+            / train["sec_per_step"]
+        summary["trace_overhead_pct"] = round(100.0 * overhead, 2)
+    else:
+        summary["trace_overhead_pct"] = None
     decode = results.get("decode", {})
     summary["prefill_time_s"] = decode.get("prefill_time_s")
     summary["decode_tokens_per_sec"] = decode.get("decode_tokens_per_sec")

@@ -41,6 +41,11 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "the loadgen drives the serve CLI as a subprocess and must keep "
         "feeding/timing requests while the child owns the backend"
     ),
+    "llm_training_tpu/telemetry/trace.py": (
+        "the serve scheduler (host-only policy) imports the tracer at "
+        "module level, and the trace/report/export paths must run anywhere "
+        "the run dir is mounted — tracing can never pull a backend"
+    ),
     # the lint gate itself: precommit runs it before any backend exists and
     # it must stay millisecond-cheap
     "llm_training_tpu/analysis/__init__.py": (

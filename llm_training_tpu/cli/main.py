@@ -1,5 +1,5 @@
 """Console entry: fit / validate / generate / serve / evaluate / report /
-supervise.
+trace / supervise.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -291,6 +291,18 @@ def _run_serve(args, config: dict) -> int:
         mesh=trainer.mesh, rules=LOGICAL_AXIS_RULES,
     )
 
+    # request-lifecycle tracing (docs/observability.md#tracing): sampled
+    # spans land in the run dir's trace.jsonl for `trace` export / the
+    # report's == Trace == section. Process 0 only, like every run-dir
+    # artifact; a run with no addressable run dir keeps ring-only tracing.
+    from llm_training_tpu.callbacks.loggers import _primary_host
+    from llm_training_tpu.telemetry.trace import get_tracer
+
+    run_dir = _jsonl_run_dir(config)
+    trace_attached = False
+    if run_dir is not None and _primary_host():
+        trace_attached = get_tracer().attach_sink(run_dir / "trace.jsonl")
+
     # a reader thread feeds stdin lines into a queue so request intake
     # never blocks the decode loop — that interleave IS continuous
     # batching: a request arriving mid-decode is admitted at the next step
@@ -342,6 +354,8 @@ def _run_serve(args, config: dict) -> int:
             pass
         emit(engine.step())
     stats = engine.stats()
+    if trace_attached:
+        get_tracer().detach_sink()
     print(json.dumps({"type": "stats", "stats": stats}), flush=True)
     _publish_run_telemetry(config, stats)
     return 0
@@ -537,6 +551,25 @@ def main(argv: list[str] | None = None) -> int:
         help="dir searched first for the newest audit*.json shardcheck "
         "record (== Audit == section); falls back to run_dir",
     )
+    report.add_argument(
+        "--format", default="text", choices=("text", "json"),
+        help="json emits every section as one machine-readable object "
+        "(schema_version-pinned — for CI trend tracking)",
+    )
+    trace = sub.add_parser(
+        "trace",
+        help="export a run's trace.jsonl as Chrome-trace JSON viewable in "
+        "Perfetto (docs/observability.md#tracing)",
+    )
+    trace.add_argument(
+        "source",
+        help="run directory holding trace.jsonl, or a trace/flight-dump "
+        "jsonl file directly",
+    )
+    trace.add_argument(
+        "--out", default=None,
+        help="output path (default: trace-export.json next to the source)",
+    )
     supervise = sub.add_parser(
         "supervise",
         help="run fit as a supervised child process; restart it on "
@@ -584,7 +617,13 @@ def main(argv: list[str] | None = None) -> int:
             bench_dir=args.bench_dir,
             supervisor_log=args.supervisor_log,
             audit_dir=args.audit_dir,
+            format=args.format,
         )
+    if args.command == "trace":
+        # stdlib-only like report: exports run anywhere the dir is mounted
+        from llm_training_tpu.telemetry.trace import trace_main
+
+        return trace_main(args.source, out=args.out)
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import

@@ -119,9 +119,18 @@ class HangWatchdog:
             logger.error("watchdog stall (no run dir for the dump):\n%s", content)
             return None
         self.run_dir.mkdir(parents=True, exist_ok=True)
-        path = self.run_dir / f"hang-dump-{time.strftime('%Y%m%d-%H%M%S')}.txt"
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        path = self.run_dir / f"hang-dump-{stamp}.txt"
         path.write_text(content)
         self.dump_paths.append(path)
+        # flight recorder (docs/observability.md#tracing): the trace ring
+        # holds the spans leading into the stall — what the loop was doing
+        # and for which step/request — next to the thread stacks. Lazy
+        # import keeps this module importable without the telemetry layer;
+        # flight_dump itself never raises.
+        from llm_training_tpu.telemetry.trace import get_tracer
+
+        get_tracer().flight_dump(self.run_dir, f"hang-{stamp}")
         logger.error(
             "watchdog: no train-loop progress for %.1fs — thread stacks "
             "dumped to %s", stalled_s, path,

@@ -43,6 +43,7 @@ from llm_training_tpu.serve.scheduler import (
     SchedulerConfig,
     ServeRequest,
 )
+from llm_training_tpu.telemetry.trace import get_tracer
 
 logger = logging.getLogger(__name__)
 
@@ -143,6 +144,7 @@ class ServingEngine:
         self._rng = jax.random.key(self.config.seed)
         self._call = 0
         self._t0: float | None = None
+        self._step_index = 0
         self.tokens_generated = 0
         self.peak_running = 0
 
@@ -221,6 +223,13 @@ class ServingEngine:
             id=str(id), prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens), priority=int(priority),
         )
+        tracer = get_tracer()
+        request.traced = tracer.sample_request()
+        tracer.instant(
+            "serve", "submit", ts=request.arrival_s, write=request.traced,
+            request_id=request.id, prompt_len=len(request.prompt),
+            max_new_tokens=request.max_new_tokens, priority=request.priority,
+        )
         rejected = self.scheduler.submit(request)
         if rejected is not None:
             return [self._done_event(rejected)]
@@ -234,7 +243,13 @@ class ServingEngine:
         ({'type': 'token', ...} per new token, {'type': 'done', ...} per
         completion)."""
         events: list[dict] = []
-        with self._ctx():
+        tracer = get_tracer()
+        self._step_index += 1
+        with tracer.measure(
+            "serve", "engine_step", step=self._step_index,
+            running=len(self.scheduler.running),
+            waiting=len(self.scheduler.waiting),
+        ), self._ctx():
             before = len(self.scheduler.completed)
             self.scheduler.admit()
             # admit() can terminate a head-of-queue request the pool can
@@ -257,6 +272,14 @@ class ServingEngine:
         self.tokens_generated += 1
         if request.first_token_s is None:
             request.first_token_s = now
+            get_tracer().instant(
+                "serve", "first_token", ts=now, write=request.traced,
+                request_id=request.id,
+                # the same arrival-anchored value stats()/done events carry:
+                # an evicted-then-resumed request's TTFT is measured from
+                # its ORIGINAL arrival, never the requeue
+                ttft_ms=round(1000.0 * (now - request.arrival_s), 3),
+            )
         request.last_token_s = now
         # an evicted-then-resumed request regenerates nothing (its progress
         # rode along in the re-prefill), so every append past `emitted` is
@@ -277,6 +300,7 @@ class ServingEngine:
 
     def _run_prefill(self, request: ServeRequest, chunk: list[int], start: int) -> list[dict]:
         events: list[dict] = []
+        t_chunk = time.perf_counter()
         width = self.config.prefill_chunk
         ids = np.zeros((1, width), np.int32)
         seg = np.zeros((1, width), np.int32)
@@ -297,6 +321,15 @@ class ServingEngine:
         request.cache_len += len(chunk)
         if final:
             self._emit_token(request, int(jax.device_get(token)), events)
+        now = time.perf_counter()
+        get_tracer().span(
+            "serve", "prefill_chunk", t_chunk, now, write=request.traced,
+            request_id=request.id, start=start, tokens=len(chunk), final=final,
+        )
+        if final and not request.done:
+            # the first new token landed inside the prefill phase; decode
+            # (one token per engine step) starts here
+            request.advance_phase("decode", now)
         return events
 
     def _run_decode(self, rows: list[ServeRequest]) -> list[dict]:
@@ -349,6 +382,13 @@ class ServingEngine:
                 1000.0 * (request.last_token_s - request.first_token_s)
                 / (len(request.generated) - 1), 3,
             )
+        get_tracer().instant(
+            "serve", "done", write=request.traced, request_id=request.id,
+            stop_reason=request.stop_reason, n_tokens=len(request.generated),
+            evictions=request.evictions,
+            queue_wait_ms=round(1000.0 * request.queue_wait_s, 3),
+            **({"ttft_ms": event["ttft_ms"]} if "ttft_ms" in event else {}),
+        )
         return event
 
     # ----------------------------------------------------------------- run
@@ -412,6 +452,10 @@ class ServingEngine:
         if tpot:
             stats["serve/tpot_p50_ms"] = float(np.percentile(tpot, 50))
             stats["serve/tpot_p99_ms"] = float(np.percentile(tpot, 99))
+        counts = get_tracer().counts()
+        stats["trace/events_recorded"] = float(counts["recorded"])
+        stats["trace/events_written"] = float(counts["written"])
+        stats["trace/requests_sampled"] = float(counts["requests_sampled"])
         registry = get_registry()
         for key, value in stats.items():
             registry.gauge(key).set(value)

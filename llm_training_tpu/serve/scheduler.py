@@ -21,6 +21,14 @@ Per engine step the scheduler decides three things:
 Slots recycle on eos / max-tokens: blocks return to the pool and the row
 becomes admissible immediately (the "slot stranding" the dense
 `InferenceEngine` batch could not avoid).
+
+Every lifecycle transition additionally emits a trace span
+(docs/observability.md#tracing): a request moves queue → prefill → decode
+(→ back to queue on eviction) and each phase it leaves becomes one span on
+its Perfetto track, so queue-wait and eviction-loss are derivable per
+request. The tracer is jax-free (`telemetry/trace.py` — same graftlint
+contract as this module), so the import costs this host-only policy layer
+nothing.
 """
 
 from __future__ import annotations
@@ -29,6 +37,8 @@ import math
 import time
 from collections import deque
 from dataclasses import dataclass, field
+
+from llm_training_tpu.telemetry.trace import get_tracer
 
 
 @dataclass
@@ -53,6 +63,30 @@ class ServeRequest:
     last_token_s: float | None = None
     evictions: int = 0
     stop_reason: str | None = None
+    # tracing (docs/observability.md#tracing): whether this request's
+    # events reach the trace.jsonl sink (sampling — the ring records all),
+    # the lifecycle phase currently open, when it opened, and the total
+    # time spent waiting in the queue (initial + post-eviction)
+    traced: bool = True
+    phase: str = "queue"
+    phase_start_s: float | None = None
+    queue_wait_s: float = 0.0
+
+    def advance_phase(self, new_phase: str, now: float | None = None) -> None:
+        """Close the open lifecycle phase as a trace span and enter
+        `new_phase`. Phases tile the request's residency wall-clock
+        exactly: each span starts where the previous one ended."""
+        if now is None:
+            now = time.perf_counter()
+        start = self.phase_start_s if self.phase_start_s is not None else self.arrival_s
+        get_tracer().span(
+            "serve", self.phase, start, now, write=self.traced,
+            request_id=self.id, residency=self.evictions,
+        )
+        if self.phase == "queue":
+            self.queue_wait_s += max(0.0, now - start)
+        self.phase = new_phase
+        self.phase_start_s = now
 
     @property
     def done(self) -> bool:
@@ -135,6 +169,7 @@ class Scheduler:
                     # nothing left to drain — this request cannot ever fit
                     self.waiting.popleft()
                     request.stop_reason = "capacity"
+                    request.advance_phase("done")
                     self.completed.append(request)
                     continue
                 break
@@ -144,6 +179,7 @@ class Scheduler:
             request.prefill_tokens = resident
             request.prefilled = 0
             request.cache_len = 0
+            request.advance_phase("prefill")
             self.running[request.slot] = request
             admitted.append(request)
         return admitted
@@ -191,6 +227,12 @@ class Scheduler:
     def evict(self, request: ServeRequest) -> None:
         """Free the request's residency and requeue it (front) with its
         progress folded in; already-streamed tokens are never re-emitted."""
+        lost_cache = request.cache_len
+        request.advance_phase("queue")
+        get_tracer().instant(
+            "serve", "evicted", write=request.traced, request_id=request.id,
+            lost_cache_tokens=lost_cache, generated=len(request.generated),
+        )
         self._release(request)
         request.evictions += 1
         self.evictions += 1
@@ -202,6 +244,7 @@ class Scheduler:
     # -------------------------------------------------------- completion
 
     def finish(self, request: ServeRequest, stop_reason: str) -> None:
+        request.advance_phase("done")
         self._release(request)
         request.stop_reason = stop_reason
         self.completed.append(request)

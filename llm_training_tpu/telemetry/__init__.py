@@ -3,9 +3,15 @@
 One registry + one goodput ledger per fit (owned by the Trainer), device
 gauges sampled on log steps, `jax.profiler` annotations naming the same
 phases, a model-health layer (per-layer grad/update norms, MoE router
-health, host-side spike detection + anomaly dumps), and a `report` CLI that
-renders the persisted artifacts. See docs/observability.md for the schema
-and phase definitions.
+health, host-side spike detection + anomaly dumps), a request/step trace
+recorder with a crash flight recorder (`telemetry/trace.py`), and a
+`report` CLI that renders the persisted artifacts. See
+docs/observability.md for the schema and phase definitions.
+
+The package surface stays jax-free at import time: the health layer (the
+one jax-importing submodule) loads lazily through ``__getattr__``, so the
+serve scheduler — a graftlint jax-free contract — can import the tracer
+through this package without pulling a backend.
 """
 
 from llm_training_tpu.telemetry.anomaly import (
@@ -17,17 +23,34 @@ from llm_training_tpu.telemetry.anomaly import (
 )
 from llm_training_tpu.telemetry.device import compiled_cost_gauges, hbm_gauges
 from llm_training_tpu.telemetry.goodput import PHASES, GoodputLedger
-from llm_training_tpu.telemetry.health import (
-    HealthConfig,
-    build_param_groups,
-    layer_health_metrics,
-    moe_router_health,
-)
 from llm_training_tpu.telemetry.registry import (
     TelemetryRegistry,
     get_registry,
     set_registry,
 )
+from llm_training_tpu.telemetry.trace import (
+    TraceRecorder,
+    get_tracer,
+    set_tracer,
+)
+
+# health imports jax at module level; resolve these names on first access so
+# the package import graph stays backend-free (PEP 562)
+_LAZY_HEALTH = (
+    "HealthConfig",
+    "build_param_groups",
+    "layer_health_metrics",
+    "moe_router_health",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_HEALTH:
+        from llm_training_tpu.telemetry import health
+
+        return getattr(health, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "PHASES",
@@ -35,15 +58,18 @@ __all__ = [
     "GoodputLedger",
     "HealthConfig",
     "TelemetryRegistry",
+    "TraceRecorder",
     "build_param_groups",
     "compiled_cost_gauges",
     "dump_anomaly",
     "get_registry",
+    "get_tracer",
     "hbm_gauges",
     "layer_health_metrics",
     "moe_router_health",
     "offending_layers",
     "resolve_run_dir",
     "set_registry",
+    "set_tracer",
     "top_layers",
 ]

@@ -174,6 +174,12 @@ def dump_anomaly(
             payload.update({k: _jsonable(v) if not isinstance(v, (dict, list)) else v
                             for k, v in extra.items()})
         path.write_text(json.dumps(payload, indent=2))
+        # flight recorder (docs/observability.md#tracing): the trace ring's
+        # last events — the steps/requests leading into the anomaly — land
+        # next to the metric snapshot; flight_dump never raises
+        from llm_training_tpu.telemetry.trace import get_tracer
+
+        get_tracer().flight_dump(run_dir, f"anomaly-{step}")
         return path
     except Exception:
         logger.exception("anomaly dump failed (step %d, reason %s)", step, reason)

@@ -337,6 +337,8 @@ def _perf_lines(record: dict) -> list[str]:
         lines.append("stages: " + "  ".join(parts))
     if record.get("health_overhead_pct") is not None:
         lines.append(f"health_overhead_pct: {float(record['health_overhead_pct']):.2f}")
+    if record.get("trace_overhead_pct") is not None:
+        lines.append(f"trace_overhead_pct: {float(record['trace_overhead_pct']):.2f}")
     if record.get("decode_tokens_per_sec") is not None:
         lines.append(
             f"decode: {float(record['decode_tokens_per_sec']):,.1f} tokens/sec"
@@ -574,6 +576,66 @@ def _elastic_section(
     return lines
 
 
+def _trace_summary(run_dir: Path) -> dict | None:
+    """Span aggregates + slowest-request breakdowns from the run dir's
+    trace.jsonl (docs/observability.md#tracing), or None when the run never
+    traced. A present-but-unparseable file returns an `events: 0` summary
+    so the section can say so honestly."""
+    from llm_training_tpu.telemetry.trace import read_trace_events, summarize_trace
+
+    path = run_dir / "trace.jsonl"
+    if not path.is_file():
+        return None
+    return summarize_trace(read_trace_events(path))
+
+
+def _trace_section(summary: dict | None) -> list[str]:
+    """`== Trace ==`: per-phase span aggregates and the top-k slowest
+    requests with their queue/prefill/decode breakdowns. Omitted when the
+    run has no trace.jsonl; degrades to one honest line on a malformed or
+    empty one."""
+    if summary is None:
+        return []
+    lines = ["", "== Trace =="]
+    try:
+        if not summary.get("events"):
+            lines.append("trace.jsonl present but holds no parseable events")
+            return lines
+        lines.append(
+            f"events: {int(summary['events'])}  "
+            f"requests traced: {int(summary.get('requests_traced', 0))} "
+            f"({int(summary.get('requests_completed', 0))} completed)"
+        )
+        spans = summary.get("spans") or {}
+        if spans:
+            lines.append(f"{'span':<24} {'count':>6} {'total_s':>10} {'mean_ms':>9}")
+            for name, agg in sorted(spans.items()):
+                count = int(agg["count"])
+                total = float(agg["total_s"])
+                lines.append(
+                    f"{name:<24} {count:>6} {total:>10.3f} "
+                    f"{1000.0 * total / count:>9.2f}"
+                )
+        slowest = summary.get("slowest_requests") or []
+        if slowest:
+            lines.append("slowest requests:")
+            for request in slowest:
+                parts = [f"  {request['id']}: {float(request['wall_ms']):,.1f} ms"]
+                breakdown = "  ".join(
+                    f"{phase} {float(request.get(f'{phase}_ms', 0.0)):,.1f}"
+                    for phase in ("queue", "prefill", "decode")
+                )
+                parts.append(f"({breakdown} ms)")
+                if request.get("ttft_ms") is not None:
+                    parts.append(f"ttft {float(request['ttft_ms']):,.1f} ms")
+                if request.get("evictions"):
+                    parts.append(f"{int(request['evictions'])} eviction(s)")
+                lines.append("  ".join(parts))
+    except (KeyError, TypeError, ValueError, AttributeError):
+        return ["", "== Trace ==", "unreadable trace summary — malformed fields"]
+    return lines
+
+
 def _counter_section(title: str, rows: list[tuple[str, str]], telemetry: dict) -> list[str]:
     """An event-counter section: one `label: count` line per nonzero
     counter, the whole section omitted when nothing fired — a clean run's
@@ -617,13 +679,10 @@ def _resilience_section(telemetry: dict) -> list[str]:
     ], telemetry)
 
 
-def render_report(
-    run_dir: str | Path,
-    bench_dir: str | Path | None = None,
-    supervisor_log: str | Path | None = None,
-    audit_dir: str | Path | None = None,
-) -> str:
-    run_dir = Path(run_dir)
+def _load_run(run_dir: Path) -> tuple[list[dict], list[dict], dict]:
+    """(metrics, telemetry_records, telemetry-total) for the NEWEST run
+    segment — the one loader both the text and JSON renderers consume, so
+    segment handling can never drift between them."""
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
     if not metrics:
         raise FileNotFoundError(
@@ -638,47 +697,99 @@ def render_report(
         if telemetry_records
         else (_last_with(metrics, "goodput/total_s") or {})
     )
+    return metrics, telemetry_records, telemetry
+
+
+def _read_world(run_dir: Path) -> dict | None:
+    meta_path = run_dir / "run_metadata.json"
+    if not meta_path.exists():
+        return None
+    try:
+        meta = json.loads(meta_path.read_text())
+        world = meta.get("world", meta)
+        return world if isinstance(world, dict) else None
+    except Exception:
+        return None
+
+
+def _training_summary(metrics: list[dict]) -> dict | None:
+    """The training-section numbers, shared by both renderers. None only
+    when the run logged neither train-loss nor val-loss records."""
+    train = [r for r in metrics if "loss" in r]
+    last_tokens = _last_with(metrics, "consumed_tokens")
+    val = _last_with(metrics, "val_loss")
+    if not train and not val:
+        return None
+    steps = [int(r["step"]) for r in train if "step" in r]
+    losses = [float(r["loss"]) for r in train]
+    sps = [float(r["steps_per_sec"]) for r in train if "steps_per_sec" in r]
+    return {
+        "records": len(train),
+        "step_min": min(steps) if steps else None,
+        "step_max": max(steps) if steps else None,
+        "loss_first": losses[0] if losses else None,
+        "loss_last": losses[-1] if losses else None,
+        "loss_min": min(losses) if losses else None,
+        "steps_per_sec_median": statistics.median(sps) if sps else None,
+        "steps_per_sec_last": sps[-1] if sps else None,
+        "consumed_tokens": (
+            int(last_tokens["consumed_tokens"]) if last_tokens else None
+        ),
+        "consumed_samples": (
+            int(last_tokens.get("consumed_samples", 0)) if last_tokens else None
+        ),
+        "val_loss": float(val["val_loss"]) if val else None,
+        "val_step": val.get("step") if val else None,
+    }
+
+
+def render_report(
+    run_dir: str | Path,
+    bench_dir: str | Path | None = None,
+    supervisor_log: str | Path | None = None,
+    audit_dir: str | Path | None = None,
+) -> str:
+    run_dir = Path(run_dir)
+    metrics, telemetry_records, telemetry = _load_run(run_dir)
 
     lines = [f"Run report: {run_dir}"]
-    meta_path = run_dir / "run_metadata.json"
-    if meta_path.exists():
-        try:
-            meta = json.loads(meta_path.read_text())
-            world = meta.get("world", meta)
-            parts = [
-                f"{key}={world[key]}"
-                for key in ("backend", "device_kind", "device_count", "num_processes")
-                if key in world
-            ]
-            if parts:
-                lines.append("env: " + "  ".join(parts))
-        except Exception:
-            pass
+    world = _read_world(run_dir)
+    if world:
+        parts = [
+            f"{key}={world[key]}"
+            for key in ("backend", "device_kind", "device_count", "num_processes")
+            if key in world
+        ]
+        if parts:
+            lines.append("env: " + "  ".join(parts))
 
-    train = [r for r in metrics if "loss" in r]
+    training = _training_summary(metrics)
     lines.append("")
     lines.append("== Training ==")
-    if train:
-        steps = [int(r["step"]) for r in train if "step" in r]
-        lines.append(f"logged steps: {min(steps)}..{max(steps)} ({len(train)} records)")
-        losses = [float(r["loss"]) for r in train]
+    if training and training["records"]:
         lines.append(
-            f"loss: first {losses[0]:.4f} -> last {losses[-1]:.4f} (min {min(losses):.4f})"
+            f"logged steps: {training['step_min']}..{training['step_max']} "
+            f"({training['records']} records)"
         )
-        sps = [float(r["steps_per_sec"]) for r in train if "steps_per_sec" in r]
-        if sps:
+        lines.append(
+            f"loss: first {training['loss_first']:.4f} -> last "
+            f"{training['loss_last']:.4f} (min {training['loss_min']:.4f})"
+        )
+        if training["steps_per_sec_median"] is not None:
             lines.append(
-                f"steps_per_sec: median {statistics.median(sps):.3f} (last {sps[-1]:.3f})"
+                f"steps_per_sec: median {training['steps_per_sec_median']:.3f} "
+                f"(last {training['steps_per_sec_last']:.3f})"
             )
-        last_tokens = _last_with(metrics, "consumed_tokens")
-        if last_tokens:
+        if training["consumed_tokens"] is not None:
             lines.append(
-                f"consumed: {int(last_tokens['consumed_tokens']):,} tokens, "
-                f"{int(last_tokens.get('consumed_samples', 0)):,} samples"
+                f"consumed: {training['consumed_tokens']:,} tokens, "
+                f"{training['consumed_samples']:,} samples"
             )
-    val = _last_with(metrics, "val_loss")
-    if val:
-        lines.append(f"val_loss: {float(val['val_loss']):.4f} (step {val.get('step', '?')})")
+    if training and training["val_loss"] is not None:
+        lines.append(
+            f"val_loss: {training['val_loss']:.4f} "
+            f"(step {training['val_step'] if training['val_step'] is not None else '?'})"
+        )
 
     # MFU: the time estimator publishes perf/* gauges into telemetry
     for key, label in (
@@ -721,6 +832,7 @@ def render_report(
     ]), telemetry))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
+    lines.extend(_trace_section(_trace_summary(run_dir)))
     lines.extend(_elastic_section(
         telemetry_records,
         _read_supervisor_events(
@@ -733,18 +845,159 @@ def render_report(
     return "\n".join(lines)
 
 
+# schema_version of the JSON report below: bump on any breaking key change
+# (tests/test_trace.py pins the top-level shape)
+REPORT_SCHEMA_VERSION = 1
+
+
+def _numeric_subset(telemetry: dict, prefixes: tuple[str, ...]) -> dict | None:
+    """All numeric telemetry keys under `prefixes`, or None when the run
+    recorded none of them (section omitted in the JSON like in the text)."""
+    out: dict[str, float] = {}
+    for key, value in telemetry.items():
+        if not key.startswith(prefixes):
+            continue
+        try:
+            out[key] = float(value)
+        except (TypeError, ValueError):
+            continue
+    return out or None
+
+
+def _supervisor_segments(events: list[dict] | None) -> list[dict] | None:
+    """Per-segment topology/runtime rows from supervisor.jsonl events —
+    the structured twin of what `== Elastic ==` renders. None when the log
+    was absent or carried no segment events."""
+    if not events:
+        return None
+    topology: dict[int, dict] = {}
+    exits: dict[int, dict] = {}
+    for event in events:
+        try:
+            attempt = int(event.get("attempt", 0))
+        except (TypeError, ValueError):
+            continue
+        if event.get("event") == "segment_topology":
+            topology[attempt] = event
+        elif event.get("event") == "exit":
+            exits[attempt] = event
+    if not topology and not exits:
+        return None
+    return [
+        {
+            "attempt": attempt,
+            "device_count": topology.get(attempt, {}).get("device_count"),
+            "mesh": topology.get(attempt, {}).get("mesh"),
+            "decision": topology.get(attempt, {}).get("decision"),
+            "runtime_s": exits.get(attempt, {}).get("runtime_s"),
+            "exit": (
+                exits.get(attempt, {}).get("signal")
+                or exits.get(attempt, {}).get("rc")
+            ),
+        }
+        for attempt in sorted(set(topology) | set(exits))
+    ]
+
+
+def render_report_data(
+    run_dir: str | Path,
+    bench_dir: str | Path | None = None,
+    supervisor_log: str | Path | None = None,
+    audit_dir: str | Path | None = None,
+) -> dict:
+    """The machine-readable twin of `render_report` (`report --format
+    json`): every section as structured data, for CI trend tracking of
+    goodput/serve/trace numbers. Absent sections are null; `telemetry` is
+    the newest persisted record verbatim so nothing numeric is lost to the
+    section shaping."""
+    run_dir = Path(run_dir)
+    metrics, telemetry_records, telemetry = _load_run(run_dir)
+    world = _read_world(run_dir)
+    training = _training_summary(metrics)
+
+    bench = _newest_bench_record([
+        Path(bench_dir) if bench_dir else None, run_dir, Path.cwd(),
+    ])
+    audit = _newest_audit_record([
+        Path(audit_dir) if audit_dir else None, run_dir,
+    ])
+    audit_data = None
+    if audit is not None:
+        record, name = audit
+        findings = record.get("findings")
+        by_rule: dict[str, int] = {}
+        for finding in findings or []:
+            if isinstance(finding, dict):
+                rule = str(finding.get("rule", "?"))
+                by_rule[rule] = by_rule.get(rule, 0) + 1
+        audit_data = {
+            "file": name,
+            "findings": len(findings) if findings is not None else None,
+            "by_rule": by_rule,
+            "error": record.get("error"),
+        }
+
+    device_memory = None
+    if telemetry.get("hbm/peak_bytes_in_use") is not None:
+        device_memory = {
+            "peak_bytes": float(telemetry["hbm/peak_bytes_in_use"]),
+            "limit_bytes": (
+                float(telemetry["hbm/bytes_limit"])
+                if telemetry.get("hbm/bytes_limit") else None
+            ),
+            "host_fallback": bool(telemetry.get("hbm/host_fallback")),
+        }
+
+    # elastic: the flat gauges plus the per-segment rows text mode renders
+    # from supervisor.jsonl (same default path as `== Elastic ==`)
+    elastic_gauges = _numeric_subset(telemetry, ("elastic/",))
+    segments = _supervisor_segments(_read_supervisor_events(
+        Path(supervisor_log) if supervisor_log
+        else run_dir / "supervisor.jsonl"
+    ))
+    elastic = None
+    if elastic_gauges or segments:
+        elastic = {"gauges": elastic_gauges or {}, "segments": segments}
+
+    return {
+        "schema_version": REPORT_SCHEMA_VERSION,
+        "run_dir": str(run_dir),
+        "world": world,
+        "training": training,
+        "goodput": _numeric_subset(telemetry, ("goodput/",)),
+        "device_memory": device_memory,
+        "health": _numeric_subset(telemetry, ("health/", "nan_guard/")),
+        "perf": {"file": bench[1], "data": bench[0]} if bench else None,
+        "audit": audit_data,
+        "inference": _numeric_subset(telemetry, ("decode/", "eval/")),
+        "serving": _numeric_subset(telemetry, ("serve/",)),
+        "elastic": elastic,
+        "trace": _trace_summary(run_dir),
+        "recovery": _numeric_subset(telemetry, ("resilience/",)),
+        "flash": _numeric_subset(telemetry, ("flash/",)),
+        "telemetry": telemetry,
+    }
+
+
 def report_main(
     run_dir: str,
     bench_dir: str | None = None,
     supervisor_log: str | None = None,
     audit_dir: str | None = None,
+    format: str = "text",
 ) -> int:
     """`llm-training-tpu report <run_dir>` entry point."""
     try:
-        print(render_report(
-            run_dir, bench_dir=bench_dir, supervisor_log=supervisor_log,
-            audit_dir=audit_dir,
-        ))
+        if format == "json":
+            print(json.dumps(render_report_data(
+                run_dir, bench_dir=bench_dir, supervisor_log=supervisor_log,
+                audit_dir=audit_dir,
+            )))
+        else:
+            print(render_report(
+                run_dir, bench_dir=bench_dir, supervisor_log=supervisor_log,
+                audit_dir=audit_dir,
+            ))
     except FileNotFoundError as e:
         print(f"report: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,513 @@
+"""Request-lifecycle tracing + crash flight recorder
+(docs/observability.md#tracing).
+
+The metrics layer (registry gauges, goodput ledger, TTFT/TPOT percentiles)
+answers *how much*; this layer answers *where the time went* for one
+request or one step. A process-wide `TraceRecorder` collects structured
+span/instant events — monotonic timestamps, category, name, and
+correlation ids (`request_id` for serving, `step` for training) — into
+
+- a **bounded ring buffer** that always records (a few microseconds per
+  event), so the last N events are available as a *flight recorder* when
+  something dies: `HangWatchdog` hang dumps, NaN-guard anomaly dumps, and
+  recovery rollbacks each flush it next to their existing dump files; and
+- an optional **`trace.jsonl` sink** in the run directory, fed only by
+  *sampled* events (`LLMT_TRACE_SAMPLE`-th serve request; per-step train
+  spans only with `LLMT_TRACE_TRAIN=1`), so steady-state overhead stays
+  negligible while coarse lifecycle events (compile, checkpoint_save,
+  validation, segment boundaries) are always persisted.
+
+`llm-training-tpu trace <run_dir>` exports the sink as Chrome-trace-format
+JSON viewable in Perfetto (ui.perfetto.dev): one track per request, one
+for the serving engine's steps, one for the trainer's phases.
+
+This module is deliberately **jax-free** (enforced by graftlint's
+jax-free-import contract): the serve scheduler — pure host policy — emits
+lifecycle spans at module level, and the export/report paths must run
+anywhere the run dir is mounted.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Iterator
+
+logger = logging.getLogger(__name__)
+
+# flush the sink every N written events: bounds both the syscall rate on
+# hot paths and how much a crash can tear off the tail
+_FLUSH_EVERY = 64
+
+# serve request-lifecycle phase names, in order (docs/observability.md)
+REQUEST_PHASES = ("queue", "prefill", "decode")
+
+
+def _env_int(name: str, default: int, minimum: int = 1) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return max(minimum, int(raw))
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r (want an int)", name, raw)
+        return default
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw != "0"
+
+
+class TraceRecorder:
+    """Bounded ring of span/instant events + an optional jsonl sink.
+
+    Every `record` lands in the ring (the flight recorder); only events
+    with `write=True` reach the sink — callers gate that flag on sampling
+    (`sample_request()`) or the train-step switch (`train_steps`). All
+    mutation goes through one lock, so any thread may record.
+    """
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        sample_every: int | None = None,
+        train_steps: bool | None = None,
+        enabled: bool | None = None,
+        clock=time.perf_counter,
+    ):
+        # env overlay (docs/observability.md#tracing-env): explicit args win
+        self.capacity = capacity or _env_int("LLMT_TRACE_RING", 2048)
+        self.sample_every = sample_every or _env_int("LLMT_TRACE_SAMPLE", 1)
+        self.train_steps = (
+            train_steps if train_steps is not None
+            else _env_flag("LLMT_TRACE_TRAIN", False)
+        )
+        self.enabled = (
+            enabled if enabled is not None else _env_flag("LLMT_TRACE", True)
+        )
+        self.clock = clock
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._sink = None
+        self._sink_path: Path | None = None
+        self._unflushed = 0
+        self._recorded = 0
+        self._written = 0
+        self._flight_dumps = 0
+        self._requests_seen = 0
+        self._requests_sampled = 0
+
+    # ------------------------------------------------------------ sink
+
+    def attach_sink(self, path: str | Path) -> bool:
+        """Open `path` for appending sampled events; False when tracing is
+        disabled or a sink is already attached (the first owner keeps it —
+        a fit must not steal the sink a bench stage opened)."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            if self._sink is not None:
+                return False
+            path = Path(path)
+            try:
+                path.parent.mkdir(parents=True, exist_ok=True)
+                self._sink = open(path, "a")
+            except OSError:
+                logger.exception("trace sink %s unavailable — ring only", path)
+                self._sink = None
+                return False
+            self._sink_path = path
+            self._unflushed = 0
+            return True
+
+    def detach_sink(self) -> None:
+        with self._lock:
+            sink, self._sink, self._sink_path = self._sink, None, None
+        if sink is not None:
+            try:
+                sink.flush()
+                sink.close()
+            except OSError:
+                logger.exception("trace sink close failed")
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.flush()
+                except OSError:
+                    logger.exception("trace sink flush failed")
+                self._unflushed = 0
+
+    @property
+    def sink_path(self) -> Path | None:
+        return self._sink_path
+
+    # ------------------------------------------------------------ record
+
+    def _record(self, event: dict, write: bool) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(event)
+            self._recorded += 1
+            if write and self._sink is not None:
+                try:
+                    self._sink.write(json.dumps(event) + "\n")
+                except (OSError, TypeError, ValueError):
+                    logger.exception("trace sink write failed (event dropped)")
+                    return
+                self._written += 1
+                self._unflushed += 1
+                if self._unflushed >= _FLUSH_EVERY:
+                    try:
+                        self._sink.flush()
+                    except OSError:
+                        pass
+                    self._unflushed = 0
+
+    def span(
+        self, cat: str, name: str, t0: float, t1: float,
+        write: bool = True, **args,
+    ) -> None:
+        """One complete span [t0, t1) (Chrome-trace 'X' phase). Timestamps
+        are this recorder's clock (monotonic seconds)."""
+        event = {"ts": t0, "dur": max(0.0, t1 - t0), "ph": "X",
+                 "cat": cat, "name": name}
+        if args:
+            event["args"] = args
+        self._record(event, write)
+
+    def instant(
+        self, cat: str, name: str, ts: float | None = None,
+        write: bool = True, **args,
+    ) -> None:
+        event = {"ts": self.clock() if ts is None else ts, "ph": "i",
+                 "cat": cat, "name": name}
+        if args:
+            event["args"] = args
+        self._record(event, write)
+
+    @contextmanager
+    def measure(
+        self, cat: str, name: str, write: bool = True, **args
+    ) -> Iterator[None]:
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            self.span(cat, name, t0, self.clock(), write=write, **args)
+
+    # ---------------------------------------------------------- sampling
+
+    def sample_request(self) -> bool:
+        """Admission decision for one serve request's sink events: every
+        `sample_every`-th submitted request is traced (the ring records
+        all of them regardless)."""
+        with self._lock:
+            nth = self._requests_seen
+            self._requests_seen += 1
+            sampled = self.enabled and nth % self.sample_every == 0
+            if sampled:
+                self._requests_sampled += 1
+            return sampled
+
+    # ----------------------------------------------------- flight recorder
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def flight_dump(self, run_dir: str | Path, tag: str) -> Path | None:
+        """Write the ring's last-N events to `trace-flight-<tag>.jsonl` in
+        `run_dir` — the crash flight recorder. Returns the path, or None on
+        failure; never raises (a dump error must not mask the failure being
+        dumped)."""
+        try:
+            events = self.snapshot()
+            run_dir = Path(run_dir)
+            run_dir.mkdir(parents=True, exist_ok=True)
+            path = run_dir / f"trace-flight-{tag}.jsonl"
+            with open(path, "w") as f:
+                for event in events:
+                    f.write(json.dumps(event) + "\n")
+            with self._lock:
+                self._flight_dumps += 1
+            logger.warning(
+                "flight recorder: %d trace events dumped to %s",
+                len(events), path,
+            )
+            return path
+        except Exception:
+            logger.exception("flight dump failed (tag %s)", tag)
+            return None
+
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "recorded": self._recorded,
+                "written": self._written,
+                "flight_dumps": self._flight_dumps,
+                "requests_seen": self._requests_seen,
+                "requests_sampled": self._requests_sampled,
+            }
+
+
+# ---------------------------------------------------------------- current
+# A plain module global (same rationale as registry.py): worker threads and
+# independently constructed components (scheduler, watchdog, NaN guard) must
+# find the process tracer without plumbing.
+_current_tracer: TraceRecorder | None = None
+_current_lock = threading.Lock()
+
+
+def get_tracer() -> TraceRecorder:
+    """The process tracer (constructed from env on first use)."""
+    global _current_tracer
+    with _current_lock:
+        if _current_tracer is None:
+            _current_tracer = TraceRecorder()
+        return _current_tracer
+
+
+def set_tracer(tracer: TraceRecorder) -> TraceRecorder | None:
+    """Install `tracer` as current; returns the previous one (tests restore
+    it in a finally)."""
+    global _current_tracer
+    with _current_lock:
+        previous = _current_tracer
+        _current_tracer = tracer
+        return previous
+
+
+# ---------------------------------------------------------------- reading
+
+
+def resolve_trace_file(source: str | Path) -> Path | None:
+    """`source` may be a trace.jsonl (or flight dump) file itself or a run
+    directory holding trace.jsonl."""
+    source = Path(source)
+    if source.is_file():
+        return source
+    if source.is_dir():
+        candidate = source / "trace.jsonl"
+        if candidate.is_file():
+            return candidate
+    return None
+
+
+def read_trace_events(path: str | Path) -> list[dict]:
+    """Tolerant jsonl read: torn/malformed lines and non-dict records are
+    skipped — a killed run's trace must still export."""
+    events: list[dict] = []
+    try:
+        text = Path(path).read_text()
+    except OSError:
+        return events
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(record, dict) and "ts" in record and "name" in record:
+            events.append(record)
+    return events
+
+
+# ----------------------------------------------------------------- export
+
+_PIDS = {"serve": 1, "train": 2, "resilience": 3}
+_ENGINE_TID = 1
+_REQUEST_TID_BASE = 10
+
+
+def to_chrome_trace(events: list[dict]) -> dict:
+    """Chrome-trace-format JSON (the Perfetto/about:tracing schema):
+    serving requests become one track each (tid per request id, named),
+    engine steps one track, trainer phases one track, resilience events
+    their own track. Timestamps convert to microseconds (the format's
+    unit); they are monotonic process time, so Perfetto shows a relative
+    timeline."""
+    out: list[dict] = []
+    request_tids: dict[str, int] = {}
+    for name, pid in _PIDS.items():
+        out.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                    "args": {"name": name}})
+    out.append({"ph": "M", "pid": _PIDS["serve"], "tid": _ENGINE_TID,
+                "name": "thread_name", "args": {"name": "engine"}})
+    out.append({"ph": "M", "pid": _PIDS["train"], "tid": 1,
+                "name": "thread_name", "args": {"name": "trainer phases"}})
+    out.append({"ph": "M", "pid": _PIDS["resilience"], "tid": 1,
+                "name": "thread_name", "args": {"name": "events"}})
+    for event in events:
+        try:
+            cat = str(event.get("cat", "other"))
+            pid = _PIDS.get(cat, 9)
+            args = event.get("args") or {}
+            request_id = args.get("request_id")
+            if cat == "serve" and request_id is not None:
+                rid = str(request_id)
+                tid = request_tids.get(rid)
+                if tid is None:
+                    tid = _REQUEST_TID_BASE + len(request_tids)
+                    request_tids[rid] = tid
+                    out.append({
+                        "ph": "M", "pid": pid, "tid": tid,
+                        "name": "thread_name", "args": {"name": f"req {rid}"},
+                    })
+            else:
+                tid = _ENGINE_TID if cat == "serve" else 1
+            converted = {
+                "name": str(event.get("name", "?")),
+                "cat": cat,
+                "ph": "X" if event.get("ph") == "X" else "i",
+                "ts": float(event["ts"]) * 1e6,
+                "pid": pid,
+                "tid": tid,
+            }
+            if converted["ph"] == "X":
+                converted["dur"] = float(event.get("dur", 0.0)) * 1e6
+            else:
+                converted["s"] = "t"  # thread-scoped instant
+            if args:
+                converted["args"] = args
+            out.append(converted)
+        except (TypeError, ValueError, KeyError):
+            continue  # one malformed record must not sink the export
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------- summary
+
+
+def summarize_trace(events: list[dict], top_k: int = 3) -> dict:
+    """Aggregates for `report`'s `== Trace ==` section and the JSON report:
+    per-(category, name) span totals, plus the top-k slowest completed
+    serve requests with their queue/prefill/decode breakdowns. ttft_ms per
+    request comes from its `first_token` instant — the same value the
+    engine put in the protocol's done event."""
+    spans: dict[str, dict] = {}
+    requests: dict[str, dict] = {}
+    # trace.jsonl appends across runs (like metrics.jsonl), and callers
+    # (the loadgen) reuse ids like req-0 per run — a `submit` for an id
+    # whose previous incarnation already completed starts a NEW logical
+    # request (keyed id#N), so phases never merge across runs
+    live: dict[str, str] = {}
+
+    def request_for(rid: str, is_submit: bool) -> dict:
+        key = live.get(rid)
+        if key is None or (
+            is_submit and requests[key].get("stop_reason") is not None
+        ):
+            n = sum(
+                1 for k in requests if k == rid or k.startswith(rid + "#")
+            )
+            key = rid if n == 0 else f"{rid}#{n + 1}"
+            live[rid] = key
+            requests[key] = {"id": key, "phase_s": {}, "evictions": 0}
+        return requests[key]
+
+    for event in events:
+        try:
+            args = event.get("args") or {}
+            name = str(event.get("name", "?"))
+            cat = str(event.get("cat", "other"))
+            rid = args.get("request_id")
+            if rid is not None:
+                request = request_for(str(rid), name == "submit")
+            if event.get("ph") == "X":
+                dur = float(event.get("dur", 0.0))
+                agg = spans.setdefault(
+                    f"{cat}/{name}",
+                    {"count": 0, "total_s": 0.0, "max_s": 0.0},
+                )
+                agg["count"] += 1
+                agg["total_s"] += dur
+                agg["max_s"] = max(agg["max_s"], dur)
+                if rid is not None and name in REQUEST_PHASES:
+                    phases = request["phase_s"]
+                    phases[name] = phases.get(name, 0.0) + dur
+            elif rid is not None:
+                if name == "first_token" and "ttft_ms" in args:
+                    request["ttft_ms"] = float(args["ttft_ms"])
+                elif name == "evicted":
+                    request["evictions"] += 1
+                elif name == "done":
+                    request["stop_reason"] = args.get("stop_reason")
+                    if "n_tokens" in args:
+                        request["n_tokens"] = int(args["n_tokens"])
+        except (TypeError, ValueError):
+            continue
+    completed = [
+        r for r in requests.values()
+        if r.get("stop_reason") in ("eos", "max_tokens")
+    ]
+    for request in requests.values():
+        request["wall_s"] = sum(request["phase_s"].values())
+    slowest = sorted(completed, key=lambda r: -r["wall_s"])[:top_k]
+    return {
+        "events": len(events),
+        "spans": spans,
+        "requests_traced": len(requests),
+        "requests_completed": len(completed),
+        "slowest_requests": [
+            {
+                "id": r["id"],
+                "wall_ms": round(1000.0 * r["wall_s"], 3),
+                **{
+                    f"{phase}_ms": round(1000.0 * r["phase_s"].get(phase, 0.0), 3)
+                    for phase in REQUEST_PHASES
+                },
+                "ttft_ms": r.get("ttft_ms"),
+                "n_tokens": r.get("n_tokens"),
+                "evictions": r["evictions"],
+            }
+            for r in slowest
+        ],
+    }
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def trace_main(source: str, out: str | None = None) -> int:
+    """`llm-training-tpu trace <run_dir|trace.jsonl> [--out file]`: export
+    the trace sink as Chrome-trace JSON for Perfetto (ui.perfetto.dev →
+    Open trace file). Exit 2 when no trace file is reachable."""
+    import sys
+
+    path = resolve_trace_file(source)
+    if path is None:
+        print(
+            f"trace: no trace.jsonl under {source} — run with tracing "
+            "enabled first (docs/observability.md#tracing)",
+            file=sys.stderr,
+        )
+        return 2
+    events = read_trace_events(path)
+    if not events:
+        print(f"trace: {path} holds no parseable events", file=sys.stderr)
+        return 2
+    document = to_chrome_trace(events)
+    out_path = Path(out) if out else path.with_name("trace-export.json")
+    out_path.write_text(json.dumps(document))
+    summary = summarize_trace(events)
+    print(
+        f"trace: exported {summary['events']} events "
+        f"({summary['requests_traced']} request track(s)) from {path} "
+        f"-> {out_path}"
+    )
+    print("open in Perfetto: https://ui.perfetto.dev (Open trace file)")
+    return 0

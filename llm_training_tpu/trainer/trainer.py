@@ -55,8 +55,10 @@ from llm_training_tpu.telemetry import (
     TelemetryRegistry,
     build_param_groups,
     compiled_cost_gauges,
+    get_tracer,
     hbm_gauges,
     layer_health_metrics,
+    resolve_run_dir,
     set_registry,
 )
 from llm_training_tpu.trainer.state import TrainState
@@ -641,16 +643,23 @@ class Trainer:
             GracefulShutdown().install() if resil.handle_signals else None
         )
         self._watchdog = None
+        run_dir = resolve_run_dir(self)
         if resil.watchdog_timeout_s:
-            from llm_training_tpu.telemetry.anomaly import resolve_run_dir
-
             self._watchdog = HangWatchdog(
                 resil.watchdog_timeout_s,
-                run_dir=resolve_run_dir(self),
+                run_dir=run_dir,
                 ledger=self.ledger,
                 registry=self.telemetry,
                 action=resil.watchdog_action,
             ).start()
+        # trace sink (docs/observability.md#tracing): lifecycle events land
+        # in <run_dir>/trace.jsonl; per-step spans only with
+        # LLMT_TRACE_TRAIN=1. Process 0 only — run-dir artifacts follow the
+        # JsonlLogger policy. attach_sink is False when another owner (a
+        # bench stage) already holds the sink — then it keeps it.
+        trace_attached = False
+        if run_dir is not None and jax.process_index() == 0:
+            trace_attached = get_tracer().attach_sink(run_dir / "trace.jsonl")
         try:
             with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
                 return self._fit_inner(objective, datamodule, resume_step, state)
@@ -661,6 +670,8 @@ class Trainer:
             if self._shutdown is not None:
                 self._shutdown.uninstall()
                 self._shutdown = None
+            if trace_attached:
+                get_tracer().detach_sink()
             uninstall_chaos()
             set_registry(previous_registry)
             # callbacks that alter process state (output tees, profiler
@@ -671,6 +682,14 @@ class Trainer:
 
     def _fit_inner(self, objective, datamodule, resume_step, state) -> TrainState:
         cfg = self.config
+        # host-side trace spans mirror the jax.profiler annotation sites
+        # below (docs/observability.md#tracing): coarse lifecycle events
+        # (compile, validation, checkpoint_save, segment boundaries) always
+        # reach the sink; the per-micro-step data_load/train_step spans are
+        # written only with LLMT_TRACE_TRAIN=1 — the ring records them
+        # regardless, so the flight recorder has context on every crash
+        tracer = get_tracer()
+        trace_train = tracer.train_steps
         batches = datamodule.train_batches(start_step=0)
         sample_batch = next(batches)
 
@@ -890,7 +909,8 @@ class Trainer:
             health_every == 1 and cfg.accumulate_grad_batches == 1
         )
         t_compile = time.perf_counter()
-        with self.ledger.measure("compile"):
+        with self.ledger.measure("compile"), \
+                tracer.measure("train", "compile"):
             try:
                 if plain_step_used:
                     aot_step = train_step.lower(state, sample_batch).compile()
@@ -955,6 +975,10 @@ class Trainer:
             below is the whole fit, byte-identical to before."""
             nonlocal health_compiled, step_fn
             prefetcher = None
+            tracer.instant(
+                "train", "segment_start", micro=seg_start,
+                step=seg_start // cfg.accumulate_grad_batches,
+            )
             batches = data_stream(seg_start)
             # throughput window: (start time, start step). Reset after the
             # first optimizer step of this segment so JIT compile/warmup
@@ -991,7 +1015,11 @@ class Trainer:
                         self._watchdog.beat("train_loop", step=micro)
                     with jax.profiler.StepTraceAnnotation("train", step_num=micro):
                         with self.ledger.measure("data_wait"), \
-                                jax.profiler.TraceAnnotation("data_load"):
+                                jax.profiler.TraceAnnotation("data_load"), \
+                                tracer.measure(
+                                    "train", "data_load",
+                                    write=trace_train, step=micro,
+                                ):
                             if prefetcher is not None:
                                 batch, counts = next(batches)
                             else:
@@ -1059,6 +1087,10 @@ class Trainer:
                             self.telemetry.gauge("compile_time_s").set(
                                 time.perf_counter() - t_step
                             )
+                        tracer.span(
+                            "train", "train_step", t_step, time.perf_counter(),
+                            write=trace_train, step=micro,
+                        )
 
                     self._apply_counts(counts)
 
@@ -1142,7 +1174,8 @@ class Trainer:
 
                     if cfg.val_check_interval and step % cfg.val_check_interval == 0:
                         with self.ledger.measure("validation"), \
-                                jax.profiler.TraceAnnotation("validation"):
+                                jax.profiler.TraceAnnotation("validation"), \
+                                tracer.measure("train", "validation", step=step):
                             self._run_validation(eval_step, state, datamodule, step)
 
                     if (
@@ -1157,7 +1190,10 @@ class Trainer:
                         and self._loss_finite(metrics, step)
                     ):
                         with self.ledger.measure("checkpoint_save"), \
-                                jax.profiler.TraceAnnotation("checkpoint_save"):
+                                jax.profiler.TraceAnnotation("checkpoint_save"), \
+                                tracer.measure(
+                                    "train", "checkpoint_save", step=step
+                                ):
                             self.checkpointer.save(
                                 step, state, counters=dict(self.counters),
                                 extra=self._save_extra(),
@@ -1228,6 +1264,21 @@ class Trainer:
                         type(failure).__name__, plan.failed_step, start_micro,
                         win_start, win_start + win_len,
                     )
+                    # flight recorder: the ring holds the steps that led
+                    # into the divergence — dump them next to the guard's
+                    # anomaly-<step>.json before the loop re-enters
+                    tracer.instant(
+                        "resilience", "rollback",
+                        failed_step=plan.failed_step,
+                        restored_micro=start_micro,
+                        rollback_index=plan.rollback_index,
+                        failure=type(failure).__name__,
+                    )
+                    rollback_run_dir = resolve_run_dir(self)
+                    if rollback_run_dir is not None:
+                        tracer.flight_dump(
+                            rollback_run_dir, f"rollback-{plan.failed_step}"
+                        )
                     for cb in self.callbacks:
                         if hasattr(cb, "on_rollback"):
                             cb.on_rollback(
@@ -1281,7 +1332,10 @@ class Trainer:
             # label with the step actually reached: an early stop
             # (should_stop) must not masquerade as a completed run
             with self.ledger.measure("checkpoint_save"), \
-                    jax.profiler.TraceAnnotation("checkpoint_save"):
+                    jax.profiler.TraceAnnotation("checkpoint_save"), \
+                    tracer.measure(
+                        "train", "checkpoint_save", step=self.last_step
+                    ):
                 # force=True: this step may collide with a stale/partial
                 # entry from a PREVIOUS run of the same dir (the emergency-
                 # save case) — but when THIS fit's interval save already
@@ -1308,6 +1362,11 @@ class Trainer:
         # logger's totals would miss that tail (report reads the last
         # telemetry record as the run total)
         if self.last_step is not None:
+            counts = tracer.counts()
+            self.telemetry.gauge("trace/events_recorded").set(counts["recorded"])
+            self.telemetry.gauge("trace/events_written").set(counts["written"])
+            self.telemetry.gauge("trace/flight_dumps").set(counts["flight_dumps"])
+            tracer.flush()
             record = {
                 **self.ledger.summary(),
                 **hbm_gauges(),

@@ -42,8 +42,12 @@ python -m pytest tests/ -x -q -m "not slow" "$@"
 # telemetry/report gate: the tiny CPU config must produce a run dir whose
 # metrics.jsonl/telemetry.jsonl render into a goodput table with exit 0
 echo "== precommit: report smoke (CPU fit -> report) =="
-JAX_PLATFORMS=cpu python -m llm_training_tpu fit \
+# LLMT_TRACE_TRAIN=1: the fit also exercises per-step trace spans so the
+# trace-smoke gate below covers the training track (docs/observability.md)
+JAX_PLATFORMS=cpu LLMT_TRACE_TRAIN=1 python -m llm_training_tpu fit \
     --config config/examples/smoke/cpu-smoke.yaml "run_root=${SMOKE_ROOT}"
+test -s "${SMOKE_ROOT}/smoke/cpu-smoke/trace.jsonl" \
+    || { echo "fit produced no trace.jsonl"; exit 1; }
 JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
     --audit-dir "${SMOKE_ROOT}" | tee "${SMOKE_ROOT}/report_smoke.log"
 grep -q "goodput" "${SMOKE_ROOT}/report_smoke.log"
@@ -105,6 +109,45 @@ JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smo
 grep -q "== Serving ==" "${SMOKE_ROOT}/report_serve.log"
 grep -q "ttft" "${SMOKE_ROOT}/report_serve.log"
 
+# trace gate (docs/observability.md#tracing): the fit (train track) and the
+# serve loadgen (request tracks) both appended to the run dir's
+# trace.jsonl; `trace` must export valid Chrome-trace JSON with both
+# layers present, report must render == Trace ==, and report --format json
+# must emit the machine-readable schema
+echo "== precommit: trace smoke (fit+serve spans -> Perfetto export -> report) =="
+JAX_PLATFORMS=cpu python -m llm_training_tpu trace \
+    "${SMOKE_ROOT}/smoke/cpu-smoke" --out "${SMOKE_ROOT}/trace_export.json"
+python - "${SMOKE_ROOT}/trace_export.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+events = doc["traceEvents"]
+assert isinstance(events, list) and events, "no trace events exported"
+for e in events:
+    assert {"ph", "pid", "tid", "name"} <= set(e), f"bad chrome event: {e}"
+spans = [e for e in events if e["ph"] == "X"]
+assert spans and all("ts" in e and "dur" in e for e in spans), "no complete spans"
+names = {e["name"] for e in events}
+assert "train_step" in names, f"no training track: {sorted(names)}"
+assert {"queue", "prefill", "decode"} <= names, f"no request lifecycle: {sorted(names)}"
+req_tracks = {e["tid"] for e in events if e.get("args", {}).get("request_id")}
+assert req_tracks, "no per-request tracks"
+print("trace export: OK", len(events), "events,", len(req_tracks), "request tracks")
+EOF
+grep -q "== Trace ==" "${SMOKE_ROOT}/report_serve.log"
+JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
+    --format json > "${SMOKE_ROOT}/report.json"
+python - "${SMOKE_ROOT}/report.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema_version"] == 1, doc.get("schema_version")
+for key in ("training", "goodput", "serving", "trace", "telemetry"):
+    assert key in doc, f"report json missing {key!r}"
+assert doc["goodput"]["goodput/total_s"] > 0
+assert doc["trace"]["events"] > 0 and doc["trace"]["requests_completed"] > 0
+assert doc["serving"]["serve/requests_completed"] > 0
+print("report json: OK", doc["trace"]["events"], "trace events")
+EOF
+
 # NaN-provenance + auto-recovery gates: a forced non-finite micro-fit must
 # name the offending layer path in the NonFiniteLossError AND write an
 # anomaly-<step>.json dump; then a chaos-injected NaN with
@@ -158,7 +201,9 @@ grep -q "bench record: bench_dry.json" "${SMOKE_ROOT}/report_perf.log"
 # summary stays parseable (the r04/r05 failure mode, made survivable)
 echo "== precommit: bench chaos wedge (degrade-not-die) =="
 rc=0
-BENCH_CHAOS_WEDGE=train BENCH_RUN_TIMEOUT=15 BENCH_HEALTH=0 \
+# BENCH_TRACE=0: the short RUN_TIMEOUT that kills the wedged train stage
+# would also fuse a legitimate trace-stage fit
+BENCH_CHAOS_WEDGE=train BENCH_RUN_TIMEOUT=15 BENCH_HEALTH=0 BENCH_TRACE=0 \
     python bench.py --dry | tee "${SMOKE_ROOT}/bench_wedge.log" || rc=$?
 test "$rc" -eq 1  # train (the headline) failed -> documented exit 1
 python - "${SMOKE_ROOT}/bench_wedge.log" <<'EOF'

@@ -441,6 +441,7 @@ def test_bench_summary_all_ok():
                      blocks={"fwd": [1024, 1024], "bwd": [512, 1024]},
                      goodput_pct=93.0),
         "health": _ok("health", sec_per_step_health=1.65),
+        "trace": _ok("trace", sec_per_step_trace=1.515, trace_events_written=60),
         "decode": _ok("decode", prefill_time_s=0.1, decode_tokens_per_sec=900.0),
     }
     summary = bench.summarize(results)
@@ -448,6 +449,7 @@ def test_bench_summary_all_ok():
     assert summary["stage"] == "summary" and summary["partial"] is False
     assert summary["value"] == 0.61 and summary["vs_baseline"] == 1.109
     assert summary["health_overhead_pct"] == pytest.approx(10.0)
+    assert summary["trace_overhead_pct"] == pytest.approx(1.0)
     assert summary["blocks"] == {"fwd": [1024, 1024], "bwd": [512, 1024]}
     assert all(summary["stages"][s]["status"] == "ok" for s in results)
 
@@ -466,6 +468,7 @@ def test_bench_summary_degrades_single_stage_to_error():
     summary = bench.summarize(results)
     assert summary["value"] == 0.6
     assert summary["health_overhead_pct"] is None
+    assert summary["trace_overhead_pct"] is None
     assert summary["decode_tokens_per_sec"] == 800.0
     assert summary["stages"]["health"]["status"] == "error"
     assert "wedged" in summary["stages"]["health"]["error"]
