@@ -33,6 +33,11 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "admission/eviction/chunked-prefill policy is pure host code by "
         "design — testable without a backend"
     ),
+    "llm_training_tpu/serve/journal.py": (
+        "the request journal is host-side durability bookkeeping; replay "
+        "must be readable by supervisors and tests that never touch a "
+        "backend"
+    ),
     "bench.py": (
         "the bench parent orchestrates child stages; a wedged backend must "
         "cost a stage timeout, not hang the whole bench (the r05 failure)"

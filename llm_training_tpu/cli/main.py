@@ -251,15 +251,26 @@ def _run_generate(args, config: dict) -> int:
 def _run_serve(args, config: dict) -> int:
     """`serve`: continuous-batching generation over a JSONL stdin/stdout
     protocol (docs/serving.md#protocol). One request per input line
-    ({"id", "prompt": [ids], "max_new_tokens"?, "priority"?}); the engine
-    streams {"type": "token"} chunks and a {"type": "done"} terminator per
-    request as they land, interleaving new admissions with in-flight
-    decodes. stdin EOF drains the queue, then a final {"type": "stats"}
+    ({"id", "prompt": [ids], "max_new_tokens"?, "priority"?,
+    "deadline_ms"?}); the engine streams {"type": "token"} chunks and a
+    {"type": "done"} terminator per request as they land, interleaving new
+    admissions with in-flight decodes. A {"type": "reload"} control line
+    hot-swaps the weights from the newest (or a named) checkpoint between
+    steps. stdin EOF drains the queue, then a final {"type": "stats"}
     record carries the serve/* gauges (also merged into the run dir's
-    telemetry.jsonl for `report`)."""
+    telemetry.jsonl for `report`).
+
+    Resilience (docs/serving.md#resilience): SIGTERM stops intake,
+    finishes what `--drain-timeout-s` allows, evicts-and-journals the
+    rest, and exits 75 so `supervise --child serve` relaunches; the
+    relaunch replays the journal before touching stdin. A wedged engine
+    step trips the `--watchdog-timeout-s` HangWatchdog (flight-dump +
+    SIGABRT — another supervised relaunch). `LLMT_CHAOS_SERVE_*` faults
+    inject all of it."""
     import json
     import queue
     import threading
+    import time as _time
 
     from llm_training_tpu.infer import SamplingConfig
     from llm_training_tpu.serve import ServeConfig, ServingEngine
@@ -276,6 +287,8 @@ def _run_serve(args, config: dict) -> int:
         block_size=args.block_size,
         num_blocks=args.num_blocks,
         prefill_chunk=args.prefill_chunk,
+        max_queue=args.max_queue,
+        shed_ttft_ms=args.shed_ttft_ms,
         cache_dtype=args.cache_dtype,
         seed=args.seed,
         eos_token_id=(
@@ -296,12 +309,59 @@ def _run_serve(args, config: dict) -> int:
     # report's == Trace == section. Process 0 only, like every run-dir
     # artifact; a run with no addressable run dir keeps ring-only tracing.
     from llm_training_tpu.callbacks.loggers import _primary_host
+    from llm_training_tpu.resilience import (
+        RESUMABLE_EXIT_CODE,
+        GracefulShutdown,
+        HangWatchdog,
+        config_from_env,
+        install_chaos,
+        uninstall_chaos,
+    )
+    from llm_training_tpu.serve import RequestJournal, replay_journal
     from llm_training_tpu.telemetry.trace import get_tracer
 
+    log = logging.getLogger(__name__)
     run_dir = _jsonl_run_dir(config)
+    primary = _primary_host()
     trace_attached = False
-    if run_dir is not None and _primary_host():
+    if run_dir is not None and primary:
         trace_attached = get_tracer().attach_sink(run_dir / "trace.jsonl")
+
+    # serve chaos is env-only (LLMT_CHAOS_SERVE_*, docs/resilience.md#chaos)
+    # — serve has no trainer.resilience YAML node to carry a config
+    chaos = install_chaos(config_from_env())
+    shutdown = GracefulShutdown().install()
+    watchdog = None
+    if args.watchdog_timeout_s:
+        watchdog = HangWatchdog(
+            args.watchdog_timeout_s, run_dir=run_dir, action="abort",
+            primary_source="engine_step",
+        ).start()
+
+    # request journal (docs/serving.md#resilience): a relaunch replays
+    # accepted-but-unfinished work so no accepted request is silently
+    # lost. The previous journal is rotated into a durable backup that
+    # survives until every entry has been re-accepted into the FRESH
+    # journal — a death anywhere in the replay window still replays on
+    # the next relaunch (appending handles a relaunch that itself died
+    # mid-replay; the fold's last-acceptance-wins dedupe keeps it exact).
+    journal_path = (
+        run_dir / "serve-journal.jsonl"
+        if run_dir is not None and primary else None
+    )
+    backup_path = None
+    resumed = []
+    if journal_path is not None:
+        backup_path = journal_path.with_name("serve-journal.replaying.jsonl")
+        if journal_path.exists():
+            with open(backup_path, "a") as backup:
+                backup.write(journal_path.read_text())
+            journal_path.unlink()
+        if backup_path.exists():
+            resumed = replay_journal(backup_path)
+        engine.attach_journal(
+            RequestJournal(journal_path), every=args.journal_every
+        )
 
     # a reader thread feeds stdin lines into a queue so request intake
     # never blocks the decode loop — that interleave IS continuous
@@ -309,56 +369,209 @@ def _run_serve(args, config: dict) -> int:
     lines: queue.Queue = queue.Queue()
     _EOF = object()
 
+    def parse_line(line: str):
+        """One raw protocol line -> (record, error), parsed exactly once —
+        the reader journals from the same parse the serve loop submits
+        from. None for blank lines."""
+        line = line.strip()
+        if not line:
+            return None
+        try:
+            record = json.loads(line)
+            if not isinstance(record, dict):
+                raise ValueError("request line must be a JSON object")
+            return (record, None)
+        except (json.JSONDecodeError, ValueError) as e:
+            return (None, f"bad request line: {e}")
+
+    def journal_delivery(record: dict) -> None:
+        """Journal a well-formed request the moment it is READ: a hard
+        death (watchdog SIGABRT) between read and submit would vaporize
+        the intake queue, and a delivered request must replay, not vanish.
+        Malformed/control lines are the ingest path's problem."""
+        if engine.journal is None or record.get("type"):
+            return
+        try:
+            engine.journal.delivered(
+                id=record["id"], prompt=record["prompt"],
+                max_new_tokens=record.get(
+                    "max_new_tokens", args.max_new_tokens
+                ),
+                priority=record.get("priority", 0),
+                deadline_ms=record.get("deadline_ms"),
+            )
+        except (KeyError, TypeError, ValueError):
+            pass
+
     def read_stdin():
         for line in sys.stdin:
-            lines.put(line)
+            item = parse_line(line)
+            if item is None:
+                continue
+            if item[0] is not None:
+                journal_delivery(item[0])
+            lines.put(item)
         lines.put(_EOF)
 
     threading.Thread(target=read_stdin, daemon=True).start()
+
+    # chaos malformed flood: garbage on the intake path must cost error
+    # chunks, never the batch
+    if chaos is not None:
+        for bad in chaos.serve_malformed_lines():
+            item = parse_line(bad)
+            if item is not None:
+                lines.put(item)
 
     def emit(events):
         for event in events:
             print(json.dumps(event), flush=True)
 
-    def ingest(line) -> bool:
-        """One stdin line -> submit; False at EOF."""
-        if line is _EOF:
-            return False
-        line = line.strip()
-        if not line:
-            return True
+    def reload_from_checkpoint(request: dict) -> None:
+        """{"type": "reload", "ckpt_path"?}: restore (newest checkpoint
+        when unnamed) and hot-swap between steps. A failed reload answers
+        an error chunk and the CURRENT weights keep serving."""
+        step = request.get("ckpt_path")
         try:
-            request = json.loads(line)
-            emit(engine.submit(
-                id=request["id"], prompt=request["prompt"],
-                max_new_tokens=int(
-                    request.get("max_new_tokens", args.max_new_tokens)
-                ),
-                priority=int(request.get("priority", 0)),
-            ))
-        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            new_state = trainer.restore_for_inference(
+                objective, int(step) if step is not None else None
+            )
+            generation = engine.reload_weights(new_state.params)
+        except Exception as e:  # noqa: BLE001 — the server must keep serving
             print(json.dumps({
-                "type": "error", "error": f"bad request line: {e}"
+                "type": "error", "error": f"reload failed: {e}"
             }), flush=True)
+            return
+        finally:
+            if watchdog is not None:
+                # the restore is legitimate blocking host work between
+                # engine steps — it must not age the engine_step beat into
+                # an abort of a healthy server
+                watchdog.beat()
+        print(json.dumps({
+            "type": "weights", "generation": generation,
+            "ckpt_path": step,
+        }), flush=True)
+
+    def ingest(item) -> bool:
+        """One parsed stdin item -> submit (or reload control); False at
+        EOF. Coercion failures deep in submit (junk field values inside
+        valid JSON) answer an error chunk like a parse failure."""
+        if item is _EOF:
+            return False
+        record, error = item
+        if error is None and record.get("type") == "reload":
+            reload_from_checkpoint(record)
+            return True
+        if error is None:
+            try:
+                deadline_ms = record.get("deadline_ms")
+                emit(engine.submit(
+                    id=record["id"], prompt=record["prompt"],
+                    max_new_tokens=int(
+                        record.get("max_new_tokens", args.max_new_tokens)
+                    ),
+                    priority=int(record.get("priority", 0)),
+                    deadline_ms=(
+                        float(deadline_ms) if deadline_ms is not None else None
+                    ),
+                ))
+                return True
+            except (KeyError, TypeError, ValueError) as e:
+                error = f"bad request line: {e}"
+        print(json.dumps({"type": "error", "error": error}), flush=True)
         return True
 
-    open_stdin = True
-    while open_stdin or not engine.scheduler.idle:
-        if engine.scheduler.idle:
-            open_stdin = ingest(lines.get())  # nothing in flight: block
-            continue
-        try:  # in flight: drain whatever arrived, never stall the batch
+    def flush_delivered() -> None:
+        """Drain everything the reader thread has already pulled off stdin
+        into submissions. Lines sitting in this queue are DELIVERED
+        requests: they must reach the engine (and so the journal), never
+        die with the process — the drain path depends on this."""
+        nonlocal open_stdin
+        try:
             while open_stdin:
                 open_stdin = ingest(lines.get_nowait()) and open_stdin
         except queue.Empty:
             pass
+
+    # journal replay precedes any stdin work: the relaunch owes the
+    # journaled requests their terminals first (their clients are oldest)
+    if resumed:
+        log.warning(
+            "replaying %d journaled request(s) from the previous serve "
+            "process", len(resumed),
+        )
+        for entry in resumed:
+            emit(engine.submit_resumed(entry))
+    if backup_path is not None and backup_path.exists():
+        # every journaled request is now re-accepted in the FRESH journal
+        # (or already terminal) — the rotation backup has done its job
+        backup_path.unlink()
+
+    open_stdin = True
+    rc = 0
+    while open_stdin or not engine.scheduler.idle:
+        if shutdown.requested:
+            break
+        if engine.scheduler.idle:
+            if watchdog is not None:
+                # a quiet server is healthy, not hung: the engine-step
+                # beat only moves under traffic
+                watchdog.beat()
+            try:  # nothing in flight: wait, but stay SIGTERM-responsive
+                open_stdin = ingest(lines.get(timeout=0.2))
+            except queue.Empty:
+                pass
+            continue
+        # in flight: drain whatever arrived, never stall the batch
+        flush_delivered()
         emit(engine.step())
+        if watchdog is not None:
+            watchdog.beat(step=engine._step_index)
+
+    if shutdown.requested:
+        # graceful drain (docs/serving.md#drain): stop taking NEW stdin,
+        # finish what the budget allows, evict-and-journal the rest, exit
+        # resumable so `supervise --child serve` relaunches into a replay
+        log.warning(
+            "%s: draining in-flight requests for up to %.1fs, then "
+            "journaling the remainder and exiting %d",
+            shutdown.reason, args.drain_timeout_s, RESUMABLE_EXIT_CODE,
+        )
+        deadline = _time.monotonic() + args.drain_timeout_s
+        while True:
+            flush_delivered()
+            if engine.scheduler.idle or _time.monotonic() >= deadline:
+                break
+            emit(engine.step())
+            if watchdog is not None:
+                watchdog.beat(step=engine._step_index)
+        _time.sleep(0.05)  # let a mid-read reader line land in the queue
+        flush_delivered()
+        engine.drain()
+        rc = RESUMABLE_EXIT_CODE
+
     stats = engine.stats()
+    if watchdog is not None:
+        watchdog.stop()
     if trace_attached:
         get_tracer().detach_sink()
     print(json.dumps({"type": "stats", "stats": stats}), flush=True)
     _publish_run_telemetry(config, stats)
-    return 0
+    if engine.journal is not None and rc == 0:
+        # clean completion (stdin at EOF, reader thread done): every
+        # accepted request got its terminal — a stale journal must not
+        # resurrect them in the next run. On the drain path the journal
+        # stays OPEN until process exit: the daemon reader may pull one
+        # last line off the shared pipe in this window, and its delivery
+        # record must hit the journal, not a closed file (records are
+        # flushed as written, so exit loses nothing)
+        engine.journal.close()
+        if journal_path is not None:
+            journal_path.unlink(missing_ok=True)
+    uninstall_chaos()
+    shutdown.uninstall()
+    return rc
 
 
 def _scalar_eos(model_config) -> int | None:
@@ -391,19 +604,28 @@ def _run_evaluate(args, config: dict) -> int:
 
 
 def _run_supervise(args) -> int:
-    """`supervise`: relaunch `fit` on exit 75 and hard deaths
-    (docs/resilience.md#supervise). Pure subprocess driving — no jax."""
+    """`supervise`: relaunch `fit` — or, with `--child serve`, the serving
+    tier — on exit 75 and hard deaths (docs/resilience.md#supervise). Pure
+    subprocess driving — no jax. A relaunched serve child replays its
+    request journal (docs/serving.md#resilience) before reading stdin,
+    which the children inherit from this process."""
+    import shlex
+
     from llm_training_tpu.resilience.supervisor import (
         Supervisor,
         SupervisorConfig,
         build_fit_argv,
+        build_serve_argv,
     )
 
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-        stream=sys.stdout,
+        # the serve protocol owns stdout: supervisor chatter on it would
+        # interleave with the child's JSONL chunk stream
+        stream=sys.stderr if args.child == "serve" else sys.stdout,
     )
+    child_args = list(args.overrides) + shlex.split(args.child_args or "")
     log_path = args.log
     if log_path is None:
         # no explicit --log: land the churn log in the run directory (when
@@ -414,8 +636,15 @@ def _run_supervise(args) -> int:
         # no-jax-in-supervisor invariant
         log_path = "supervisor.jsonl"
         try:
+            # dotted overrides may ride in --child-args (the serve path,
+            # where positional overrides and serve flags share one
+            # channel); only override-shaped tokens matter for the run dir
+            overrides = [
+                token for token in child_args
+                if "=" in token and not token.startswith("-")
+            ]
             run_dir = _jsonl_run_dir_jaxfree(
-                load_config(args.config, args.overrides)
+                load_config(args.config, overrides)
             )
             if run_dir is not None:
                 log_path = str(run_dir / "supervisor.jsonl")
@@ -431,12 +660,13 @@ def _run_supervise(args) -> int:
         probe_backoff_s=args.probe_backoff_s,
         probe_max_wait_s=args.probe_max_wait_s,
     )
+    build = build_serve_argv if args.child == "serve" else build_fit_argv
     supervisor = Supervisor(
-        build_fit_argv(args.config, args.overrides, ckpt_path=args.ckpt_path),
+        build(args.config, child_args, ckpt_path=args.ckpt_path),
         config=config,
         # relaunches drop any explicit --ckpt-path: they must restore the
         # NEWEST checkpoint, not rewind to the pinned step every restart
-        relaunch_argv=build_fit_argv(args.config, args.overrides),
+        relaunch_argv=build(args.config, child_args),
     )
     return supervisor.run()
 
@@ -515,6 +745,33 @@ def main(argv: list[str] | None = None) -> int:
         help="default generation budget for requests that omit it",
     )
     serve.add_argument(
+        "--max-queue", type=int, default=None,
+        help="intake bound: queued requests past this are shed with "
+        "stop_reason='overloaded' (lowest priority first); default "
+        "unbounded (docs/serving.md#resilience)",
+    )
+    serve.add_argument(
+        "--shed-ttft-ms", type=float, default=None,
+        help="shed queued requests whose projected TTFT (EMA service-time "
+        "estimate) crosses this many ms; default off",
+    )
+    serve.add_argument(
+        "--drain-timeout-s", type=float, default=30.0,
+        help="SIGTERM grace: finish in-flight requests for up to this "
+        "long, then evict-and-journal the rest and exit 75 (resumable)",
+    )
+    serve.add_argument(
+        "--watchdog-timeout-s", type=float, default=0.0,
+        help="abort (SIGABRT, after a flight dump) when an engine step "
+        "makes no progress for this long, so `supervise` can relaunch; "
+        "0 disables (default)",
+    )
+    serve.add_argument(
+        "--journal-every", type=int, default=1,
+        help="engine steps between request-journal progress checkpoints "
+        "(1 = every step; drain always journals)",
+    )
+    serve.add_argument(
         "--cache-dtype", default=None, choices=("param", "float32", "bfloat16")
     )
     serve.add_argument("--temperature", type=float, default=0.0)
@@ -572,10 +829,22 @@ def main(argv: list[str] | None = None) -> int:
     )
     supervise = sub.add_parser(
         "supervise",
-        help="run fit as a supervised child process; restart it on "
-        "preemption (exit 75) and hard deaths (SIGKILL/segfault/SIGABRT)",
+        help="run fit (or, with --child serve, the serving tier) as a "
+        "supervised child process; restart it on preemption (exit 75) and "
+        "hard deaths (SIGKILL/segfault/SIGABRT)",
     )
     supervise.add_argument("--config", required=True)
+    supervise.add_argument(
+        "--child", default="fit", choices=("fit", "serve"),
+        help="the supervised subcommand; a relaunched serve child replays "
+        "its request journal before reading stdin (docs/serving.md)",
+    )
+    supervise.add_argument(
+        "--child-args", default="",
+        help="extra flags/overrides for the child, as one shell-quoted "
+        "string (e.g. --child-args '--max-batch 2 run_root=/tmp/x') — the "
+        "channel for serve flags the supervise parser does not know",
+    )
     supervise.add_argument(
         "--ckpt-path", default=None,
         help="explicit resume step for the FIRST launch only (relaunches "

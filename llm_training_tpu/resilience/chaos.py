@@ -1,9 +1,11 @@
 """Fault-injection harness: deterministic and probabilistic failures.
 
 Recovery code that is never exercised is broken code. `ChaosConfig` drives
-three injection sites — data-source pulls (`DevicePrefetcher`), checkpoint
-I/O (`Checkpointer.save`), and a simulated preemption SIGTERM (trainer step
-boundary) — either at fixed step numbers (tests, the kill-and-resume smoke)
+injection sites in both tiers — data-source pulls (`DevicePrefetcher`),
+checkpoint I/O (`Checkpointer.save`), a simulated preemption SIGTERM
+(trainer step boundary), and the serving engine's step loop (stall /
+SIGTERM-mid-stream / malformed intake flood, docs/serving.md#resilience) —
+either at fixed step numbers (tests, the kill-and-resume smoke)
 or with per-call probabilities (soak runs). Injected I/O faults raise
 `ChaosError`, an `OSError` subclass, so they flow through exactly the
 production retry path (`resilience.retry.TRANSIENT_EXCEPTIONS`).
@@ -28,6 +30,7 @@ import os
 import random
 import signal
 import threading
+import time
 
 from pydantic import BaseModel, ConfigDict, Field
 
@@ -72,6 +75,21 @@ class ChaosConfig(BaseModel):
     nan_step: int | None = None
     spike_step: int | None = None
     spike_scale: float = Field(1e3, gt=0)
+    # serving-tier faults (docs/serving.md#resilience), all gated to the
+    # FIRST supervisor attempt (LLMT_SUPERVISOR_ATTEMPT <= 1) so a
+    # supervised relaunch survives re-crossing the trigger step instead of
+    # crash-looping on its own injection (same rationale as sigkill_step's
+    # fresh_start gate):
+    # wedge the serving engine at this engine step (sleep far past any
+    # watchdog window) — the HangWatchdog flight-dump + SIGABRT path
+    serve_stall_step: int | None = None
+    # deliver a real SIGTERM at this engine step, mid-stream — the
+    # graceful-drain -> exit 75 -> supervised-replay path
+    serve_sigterm_step: int | None = None
+    # inject this many malformed request lines into the serve CLI's intake
+    # at startup — the error-chunk boundary must answer each and keep
+    # serving
+    serve_malformed_flood: int = Field(0, ge=0)
 
     def any_active(self) -> bool:
         return bool(
@@ -83,6 +101,9 @@ class ChaosConfig(BaseModel):
             or self.sigkill_step is not None
             or self.nan_step is not None
             or self.spike_step is not None
+            or self.serve_stall_step is not None
+            or self.serve_sigterm_step is not None
+            or self.serve_malformed_flood > 0
         )
 
 
@@ -92,7 +113,9 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
     (comma-separated ints), LLMT_CHAOS_DATA_ERROR_PROB /
     LLMT_CHAOS_CHECKPOINT_ERROR_PROB / LLMT_CHAOS_SPIKE_SCALE (floats),
     LLMT_CHAOS_SIGTERM_STEP / LLMT_CHAOS_SIGKILL_STEP / LLMT_CHAOS_NAN_STEP
-    / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SEED (ints)."""
+    / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SERVE_STALL_STEP /
+    LLMT_CHAOS_SERVE_SIGTERM_STEP / LLMT_CHAOS_SERVE_MALFORMED_FLOOD /
+    LLMT_CHAOS_SEED (ints)."""
     update: dict = {}
     # env names are spelled out as literals (not derived from the field
     # names) so the env-doc-drift lint rule can statically match each one
@@ -107,6 +130,9 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
         ("nan_step", "LLMT_CHAOS_NAN_STEP", int),
         ("spike_step", "LLMT_CHAOS_SPIKE_STEP", int),
         ("spike_scale", "LLMT_CHAOS_SPIKE_SCALE", float),
+        ("serve_stall_step", "LLMT_CHAOS_SERVE_STALL_STEP", int),
+        ("serve_sigterm_step", "LLMT_CHAOS_SERVE_SIGTERM_STEP", int),
+        ("serve_malformed_flood", "LLMT_CHAOS_SERVE_MALFORMED_FLOOD", int),
         ("seed", "LLMT_CHAOS_SEED", int),
     ):
         raw = os.environ.get(env_name)
@@ -187,6 +213,77 @@ class Chaos:
         self._count()
         logger.warning("chaos: delivering SIGKILL to self at step %d", step)
         os.kill(os.getpid(), signal.SIGKILL)
+
+    # ------------------------------------------------------- serving tier
+
+    def _serve_first_attempt(self) -> bool:
+        """Serve faults fire only on the first supervisor attempt: the
+        relaunch that replays the journal must survive re-crossing the
+        trigger step (import is lazy and jax-free — elastic owns the
+        LLMT_SUPERVISOR_ATTEMPT contract)."""
+        from llm_training_tpu.resilience.elastic import segment_attempt
+
+        return segment_attempt() <= 1
+
+    def maybe_serve_stall(self, step: int, sleep=None) -> bool:
+        """Wedge the serving engine at the trigger step (once, first
+        attempt only): sleep far past any plausible watchdog window so the
+        HangWatchdog's flight-dump + SIGABRT is what ends the process, not
+        this sleep. Returns True when the stall fired (tests inject a
+        no-op `sleep`)."""
+        if self.config.serve_stall_step is None or not self._serve_first_attempt():
+            return False
+        with self._lock:
+            if (
+                step != self.config.serve_stall_step
+                or ("serve_stall", step) in self._fired
+            ):
+                return False
+            self._fired.add(("serve_stall", step))
+        self._count()
+        logger.warning("chaos: wedging serve engine step %d", step)
+        (sleep or time.sleep)(3600.0)
+        return True
+
+    def maybe_serve_sigterm_mid_stream(self, step: int) -> bool:
+        """Deliver SIGTERM to this process at the trigger engine step
+        (once, first attempt only) — the kill-mid-stream leg: the serve
+        CLI's GracefulShutdown turns it into drain -> journal -> exit 75."""
+        if (
+            self.config.serve_sigterm_step is None
+            or not self._serve_first_attempt()
+        ):
+            return False
+        with self._lock:
+            if (
+                step != self.config.serve_sigterm_step
+                or ("serve_sigterm", step) in self._fired
+            ):
+                return False
+            self._fired.add(("serve_sigterm", step))
+        self._count()
+        logger.warning(
+            "chaos: delivering SIGTERM to serve process at engine step %d", step
+        )
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+
+    def serve_malformed_lines(self) -> list[str]:
+        """The malformed-flood payload for the serve CLI's intake (first
+        attempt only): syntactically broken and schema-broken lines the
+        error boundary must answer with {"type": "error"} chunks while
+        every well-formed request still completes."""
+        n = self.config.serve_malformed_flood
+        if n <= 0 or not self._serve_first_attempt():
+            return []
+        self._count()
+        shapes = (
+            "{not json at all",
+            '{"id": "flood", "prompt": "not-a-token-list"}',
+            '{"prompt": [1, 2, 3]}',  # no id
+            '{"id": "flood", "prompt": [1], "max_new_tokens": "junk"}',
+        )
+        return [shapes[i % len(shapes)] for i in range(n)]
 
     def maybe_poison_metrics(
         self, step: int, metrics: dict, fresh_start: bool = True

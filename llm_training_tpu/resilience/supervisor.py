@@ -4,7 +4,9 @@ code can survive.
 `recovery.py` heals detected divergence without leaving the process; this
 module covers everything else — SIGKILL (OOM killer), segfaults in native
 code, the watchdog's SIGABRT, and graceful preemptions (exit 75). The
-`supervise` CLI subcommand runs `fit` as a child process and relaunches it:
+`supervise` CLI subcommand runs `fit` (or, with `--child serve`, the
+serving tier — whose relaunch replays the request journal,
+docs/serving.md#resilience) as a child process and relaunches it:
 
 - **exit 0** — run complete, supervisor exits 0;
 - **exit 75** (`RESUMABLE_EXIT_CODE`) — preempted after committing an
@@ -324,4 +326,22 @@ def build_fit_argv(
     if ckpt_path:
         argv += ["--ckpt-path", str(ckpt_path)]
     argv += list(overrides)
+    return argv
+
+
+def build_serve_argv(
+    config_path: str,
+    serve_args: Sequence[str] = (),
+    ckpt_path: str | None = None,
+) -> list[str]:
+    """The child `serve` command for a supervised serving tier
+    (docs/serving.md#resilience). Same contract as `build_fit_argv`:
+    `ckpt_path` pins a restore step for the FIRST launch only — a relaunch
+    after a hot-reload-era death must restore the newest checkpoint, not
+    rewind the weights. `serve_args` carries config overrides and serve
+    flags verbatim (the supervisor never parses them)."""
+    argv = [sys.executable, "-m", "llm_training_tpu", "serve", "--config", config_path]
+    if ckpt_path:
+        argv += ["--ckpt-path", str(ckpt_path)]
+    argv += list(serve_args)
     return argv

@@ -36,6 +36,7 @@ class HangWatchdog:
         action: str = "dump",
         poll_interval_s: float | None = None,
         clock=time.monotonic,
+        primary_source: str = "train_loop",
     ):
         if timeout_s <= 0:
             raise ValueError(f"watchdog timeout_s must be > 0, got {timeout_s}")
@@ -44,6 +45,10 @@ class HangWatchdog:
         self.timeout_s = timeout_s
         self.run_dir = Path(run_dir) if run_dir else None
         self.action = action
+        # the beat source that arms/disarms the timeout: "train_loop" for a
+        # fit, "engine_step" for the serving tier (docs/serving.md) — other
+        # sources stay context-only in the dump
+        self.primary_source = primary_source
         self._ledger = ledger
         self._registry = registry
         self._clock = clock
@@ -59,7 +64,7 @@ class HangWatchdog:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "HangWatchdog":
-        self.beat("train_loop")
+        self.beat(self.primary_source)
         self._thread = threading.Thread(
             target=self._run, name="hang-watchdog", daemon=True
         )
@@ -72,14 +77,17 @@ class HangWatchdog:
             self._thread.join(timeout=5.0)
             self._thread = None
 
-    def beat(self, source: str = "train_loop", step: int | None = None) -> None:
-        """Record progress. Only the `train_loop` source arms/disarms the
-        timeout; other sources (prefetcher) are context in the dump."""
+    def beat(self, source: str | None = None, step: int | None = None) -> None:
+        """Record progress. Only the `primary_source` beat (default
+        `train_loop`) arms/disarms the timeout; other sources (prefetcher)
+        are context in the dump."""
+        if source is None:
+            source = self.primary_source
         with self._lock:
             self._beats[source] = self._clock()
             if step is not None:
                 self._steps[source] = step
-            if source == "train_loop":
+            if source == self.primary_source:
                 self._dumped = False
 
     # ------------------------------------------------------------ polling
@@ -87,7 +95,7 @@ class HangWatchdog:
     def _run(self) -> None:
         while not self._stop.wait(self._poll_s):
             with self._lock:
-                last = self._beats.get("train_loop")
+                last = self._beats.get(self.primary_source)
                 dumped = self._dumped
             if last is None or dumped:
                 continue
@@ -102,8 +110,9 @@ class HangWatchdog:
                 logger.exception("hang-dump failed")
             if self.action == "abort":
                 logger.critical(
-                    "watchdog: no train-loop progress for %.1fs — aborting "
-                    "so the supervisor can relaunch", stalled,
+                    "watchdog: no %s progress for %.1fs — aborting "
+                    "so the supervisor can relaunch",
+                    self.primary_source, stalled,
                 )
                 os.kill(os.getpid(), signal.SIGABRT)
 
@@ -132,8 +141,8 @@ class HangWatchdog:
 
         get_tracer().flight_dump(self.run_dir, f"hang-{stamp}")
         logger.error(
-            "watchdog: no train-loop progress for %.1fs — thread stacks "
-            "dumped to %s", stalled_s, path,
+            "watchdog: no %s progress for %.1fs — thread stacks "
+            "dumped to %s", self.primary_source, stalled_s, path,
         )
         return path
 
@@ -143,8 +152,8 @@ class HangWatchdog:
             beats = dict(self._beats)
             steps = dict(self._steps)
         lines = [
-            f"HANG DUMP — no train-loop heartbeat for {stalled_s:.1f}s "
-            f"(timeout {self.timeout_s:.1f}s)",
+            f"HANG DUMP — no {self.primary_source} heartbeat for "
+            f"{stalled_s:.1f}s (timeout {self.timeout_s:.1f}s)",
             f"wall time: {time.strftime('%Y-%m-%d %H:%M:%S')}",
         ]
         phase = getattr(self._ledger, "current_phase", None)

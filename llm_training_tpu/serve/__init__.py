@@ -7,12 +7,16 @@ a ragged paged-decode attention path (`ops/paged_attention.py`, Pallas
 kernel in `ops/pallas/paged_attention.py`), a request scheduler with
 admission / chunked-prefill interleaving / eviction (`scheduler.py`), and
 the jitted continuous-batching engine (`engine.py`) — behind the streaming
-`serve` CLI subcommand and `scripts/serve_loadgen.py`.
+`serve` CLI subcommand and `scripts/serve_loadgen.py`. The resilience
+layer (docs/serving.md#resilience) adds deadlines + load shedding in the
+scheduler, the `RequestJournal` durability log (`journal.py`), hot weight
+reload, and graceful drain / supervised replay in the CLI.
 
-Scheduler and allocator import eagerly (host-only, no jax); the engine is
-lazy, mirroring `llm_training_tpu.infer`.
+Scheduler, allocator, and journal import eagerly (host-only, no jax); the
+engine is lazy, mirroring `llm_training_tpu.infer`.
 """
 
+from llm_training_tpu.serve.journal import RequestJournal, replay_journal
 from llm_training_tpu.serve.paged_cache import BlockAllocator, init_paged_pool
 from llm_training_tpu.serve.scheduler import (
     Scheduler,
@@ -22,12 +26,14 @@ from llm_training_tpu.serve.scheduler import (
 
 __all__ = [
     "BlockAllocator",
+    "RequestJournal",
     "Scheduler",
     "SchedulerConfig",
     "ServeConfig",
     "ServeRequest",
     "ServingEngine",
     "init_paged_pool",
+    "replay_journal",
 ]
 
 _LAZY = {

@@ -16,6 +16,25 @@ when free blocks suffice, one prefill chunk interleaved between decode
 steps, eviction/requeue under block pressure, slot recycling on eos /
 max-tokens. Per-request TTFT/TPOT and engine throughput publish as
 `serve/*` gauges (rendered by `report`'s `== Serving ==` section).
+
+Resilience seams (docs/serving.md#resilience):
+
+- every step first expires deadlines and re-evaluates shedding, so a
+  terminal chunk (`deadline` / `overloaded`) is never more than one step
+  late;
+- `reload_weights` hot-swaps the model variables BETWEEN steps: every
+  running request is evicted through the standard fold-in requeue (its
+  paged cache was built under the old weights and must not mix), the new
+  buffers are bound, and `serve/weights_generation` bumps — every chunk
+  carries the `generation` it was decoded under, so a client can see
+  exactly where the swap landed in its stream;
+- an attached `RequestJournal` (`attach_journal`) records accept/progress/
+  done so `drain()` — the SIGTERM path — can evict-and-journal everything
+  in flight (freeing every pool block) and a relaunch can `submit_resumed`
+  the remainder, continuing token-identically without re-streaming;
+- chaos serve faults (`LLMT_CHAOS_SERVE_*`, resilience/chaos.py) hook the
+  top of `step()` so a wedged step and a mid-stream SIGTERM are injectable
+  exactly where they would really land.
 """
 
 from __future__ import annotations
@@ -32,6 +51,7 @@ from pydantic import BaseModel, ConfigDict, model_validator
 
 from llm_training_tpu.infer.sampling import SamplingConfig, sample_tokens
 from llm_training_tpu.models.base import PagedDecodeState
+from llm_training_tpu.resilience.chaos import get_chaos
 from llm_training_tpu.serve.paged_cache import (
     BlockAllocator,
     init_paged_pool,
@@ -62,6 +82,12 @@ class ServeConfig(BaseModel):
     # max_batch full-length requests — no block pressure by default
     num_blocks: int | None = None
     prefill_chunk: int = 32  # tokens per prefill-chunk program call
+    # intake bound: queued requests past this are shed with an honest
+    # stop_reason='overloaded' terminal; None = unbounded
+    max_queue: int | None = None
+    # shed when the queue tail's projected TTFT (EMA service-time
+    # estimate) crosses this many ms; None disables
+    shed_ttft_ms: float | None = None
     cache_dtype: str | None = None
     seed: int = 0
     eos_token_id: int | None = None
@@ -78,6 +104,12 @@ class ServeConfig(BaseModel):
         if self.prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {self.prefill_chunk}"
+            )
+        if self.max_queue is not None and self.max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {self.max_queue}")
+        if self.shed_ttft_ms is not None and self.shed_ttft_ms <= 0:
+            raise ValueError(
+                f"shed_ttft_ms must be > 0, got {self.shed_ttft_ms}"
             )
         if self.block_size is not None and self.block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {self.block_size}")
@@ -137,6 +169,8 @@ class ServingEngine:
                 max_model_len=self.config.max_model_len,
                 block_size=self.block_size,
                 prefill_chunk=self.config.prefill_chunk,
+                max_queue=self.config.max_queue,
+                shed_ttft_ms=self.config.shed_ttft_ms,
             ),
             self.allocator,
         )
@@ -147,6 +181,21 @@ class ServingEngine:
         self._step_index = 0
         self.tokens_generated = 0
         self.peak_running = 0
+        # hot weight reload (docs/serving.md#resilience): bumps on every
+        # reload_weights; every emitted chunk carries the generation it was
+        # decoded under
+        self.weights_generation = 0
+        # request journal (attach_journal): accepted/progress/done records
+        # that let a supervised relaunch replay accepted-but-unfinished work
+        self.journal = None
+        self._journal_every = 1
+        # terminals built but possibly not yet delivered to the caller:
+        # their journal `done` records are deferred to the NEXT step (or
+        # drain), by which point the CLI has flushed the chunks — a death
+        # in between re-delivers a detectable duplicate terminal on replay
+        # instead of silently losing one the journal claims was delivered
+        self._unretired: list[ServeRequest] = []
+        self.replayed_requests = 0
 
     # ------------------------------------------------------------ programs
 
@@ -210,9 +259,14 @@ class ServingEngine:
         prompt: Sequence[int],
         max_new_tokens: int = 32,
         priority: int = 0,
+        deadline_ms: float | None = None,
     ) -> list[dict]:
-        """Queue one request; returns immediately-emittable events (a
-        rejection completes synchronously)."""
+        """Queue one request; returns immediately-emittable events — a
+        rejection completes synchronously, and enqueueing over the intake
+        bound may shed a (possibly different) queued request with
+        stop_reason='overloaded'. `deadline_ms` is a latency budget
+        anchored at arrival; a non-positive one is already expired and
+        terminates with stop_reason='deadline' on the spot."""
         if self._t0 is None:
             self._t0 = time.perf_counter()
         request = ServeRequest(
@@ -223,38 +277,221 @@ class ServingEngine:
             id=str(id), prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens), priority=int(priority),
         )
+        if deadline_ms is not None:
+            request.deadline_s = request.arrival_s + float(deadline_ms) / 1000.0
         tracer = get_tracer()
         request.traced = tracer.sample_request()
         tracer.instant(
             "serve", "submit", ts=request.arrival_s, write=request.traced,
             request_id=request.id, prompt_len=len(request.prompt),
             max_new_tokens=request.max_new_tokens, priority=request.priority,
+            **({"deadline_ms": float(deadline_ms)} if deadline_ms is not None else {}),
         )
-        rejected = self.scheduler.submit(request)
-        if rejected is not None:
-            return [self._done_event(rejected)]
-        return []
+        return self._ingest(request)
+
+    def submit_resumed(self, entry: dict) -> list[dict]:
+        """Resubmit one `replay_journal` entry after a relaunch: the
+        journaled continuation folds in exactly like an eviction requeue
+        (re-prefill of prompt + generated under the CURRENT weights), and
+        the `emitted` watermark keeps already-streamed tokens from being
+        re-sent. Deadlines re-anchor at the resumed arrival — the original
+        clock died with the original process."""
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        request = ServeRequest(
+            id=str(entry["id"]),
+            prompt=[int(t) for t in entry["prompt"]],
+            max_new_tokens=int(entry["max_new_tokens"]),
+            priority=int(entry.get("priority", 0)),
+        )
+        request.generated = [int(t) for t in entry.get("generated", [])]
+        request.emitted = min(int(entry.get("emitted", 0)), len(request.generated))
+        if entry.get("deadline_ms") is not None:
+            request.deadline_s = (
+                request.arrival_s + float(entry["deadline_ms"]) / 1000.0
+            )
+        tracer = get_tracer()
+        request.traced = tracer.sample_request()
+        tracer.instant(
+            "serve", "submit", ts=request.arrival_s, write=request.traced,
+            request_id=request.id, prompt_len=len(request.prompt),
+            max_new_tokens=request.max_new_tokens, priority=request.priority,
+            replayed=True, generated=len(request.generated),
+        )
+        self.replayed_requests += 1
+        if len(request.generated) >= request.max_new_tokens:
+            # the journal caught the final token but not the done record:
+            # nothing left to decode — retire it here
+            request.stop_reason = "max_tokens"
+            request.advance_phase("done")
+            self.scheduler.completed.append(request)
+            return [self._done_event(request)]
+        return self._ingest(request)
+
+    def _ingest(self, request: ServeRequest) -> list[dict]:
+        """Hand one constructed request to the scheduler and emit the
+        terminals submission itself produced (rejection, shed victims)."""
+        before = len(self.scheduler.completed)
+        self.scheduler.submit(request)
+        if not request.done and self.journal is not None:
+            self.journal.accepted(request)
+            if request.generated or request.emitted:
+                # a replayed request's folded continuation must survive a
+                # SECOND death immediately: acceptance alone records only
+                # the prompt, and the rotation backup is deleted once
+                # replay completes
+                self.journal.progress(request)
+        return [
+            self._done_event(completed)
+            for completed in self.scheduler.completed[before:]
+        ]
+
+    # ---------------------------------------------------------- resilience
+
+    def attach_journal(self, journal, every: int = 1) -> None:
+        """Record request lifetimes into `journal` (serve/journal.py);
+        progress checkpoints are written every `every` engine steps (and
+        always at drain)."""
+        self.journal = journal
+        self._journal_every = max(1, int(every))
+
+    def _retire_finished(self) -> None:
+        """Write the deferred `done` records for terminals the caller has
+        had a chance to deliver (everything built before this step)."""
+        if self.journal is None or not self._unretired:
+            return
+        retired, self._unretired = self._unretired, []
+        for request in retired:
+            self.journal.finished(request)
+
+    def reload_weights(self, variables: Any) -> int:
+        """Hot-swap the model weights between engine steps
+        (docs/serving.md#reload): every running request is evicted through
+        the standard fold-in requeue — its paged KV was computed under the
+        OLD weights and must not be decoded against the new ones — then the
+        new buffers are bound and `serve/weights_generation` bumps. In-
+        flight streams continue token-identically to a fresh engine on the
+        new weights fed prompt + tokens-so-far; nothing is dropped or
+        re-streamed. `variables` must be restore_for_inference-shaped: the
+        same tree/shapes/dtypes (and shardings under a mesh) the engine was
+        built with. Returns the new generation."""
+        old = jax.tree.structure(self.variables)
+        new = jax.tree.structure(variables)
+        if old != new:
+            raise ValueError(
+                "reload_weights: variable tree mismatch — the reload must "
+                "be the same architecture restored the same way "
+                f"(got {new}, engine holds {old})"
+            )
+        for old_leaf, new_leaf in zip(
+            jax.tree.leaves(self.variables), jax.tree.leaves(variables)
+        ):
+            if (
+                getattr(old_leaf, "shape", None) != getattr(new_leaf, "shape", None)
+                or getattr(old_leaf, "dtype", None) != getattr(new_leaf, "dtype", None)
+            ):
+                raise ValueError(
+                    "reload_weights: leaf shape/dtype mismatch "
+                    f"({getattr(new_leaf, 'shape', None)}/"
+                    f"{getattr(new_leaf, 'dtype', None)} vs engine's "
+                    f"{getattr(old_leaf, 'shape', None)}/"
+                    f"{getattr(old_leaf, 'dtype', None)})"
+                )
+        evicted = 0
+        for request in list(self.scheduler.running.values()):
+            self.scheduler.evict(request)
+            evicted += 1
+        self.variables = variables
+        self.weights_generation += 1
+        from llm_training_tpu.telemetry import get_registry
+
+        get_registry().gauge("serve/weights_generation").set(
+            float(self.weights_generation)
+        )
+        get_tracer().instant(
+            "serve", "weights_reload", generation=self.weights_generation,
+            evicted_for_reload=evicted,
+        )
+        logger.info(
+            "weights reloaded: generation %d (%d in-flight request(s) "
+            "folded for re-prefill)", self.weights_generation, evicted,
+        )
+        return self.weights_generation
+
+    def drain(self) -> dict:
+        """Evict-and-journal everything in flight — the graceful-shutdown
+        tail (docs/serving.md#drain). Running requests fold their progress
+        through the standard eviction requeue (freeing EVERY pool block, so
+        a drained engine never leaks), then every queued request is
+        checkpointed to the journal for a relaunch to `submit_resumed`. No
+        terminal chunks are emitted: the relaunch owes them. Returns a
+        summary for the drain trace event."""
+        # the drain caller has emitted every returned event by now
+        self._retire_finished()
+        for request in list(self.scheduler.running.values()):
+            self.scheduler.evict(request)
+        journaled = 0
+        for request in self.scheduler.waiting:
+            if self.journal is not None:
+                self.journal.progress(request)
+                journaled += 1
+        summary = {
+            "journaled": journaled,
+            "blocks_in_use": self.allocator.blocks_in_use,
+            "step": self._step_index,
+        }
+        get_tracer().instant("serve", "drain", **summary)
+        logger.warning(
+            "drain: %d unfinished request(s) journaled for replay "
+            "(%d pool blocks in use)", journaled, self.allocator.blocks_in_use,
+        )
+        return summary
 
     # ---------------------------------------------------------------- step
 
     def step(self) -> list[dict]:
-        """One scheduler round: admissions, at most one prefill chunk, one
-        decode step over every decoding row. Returns the streamed events
-        ({'type': 'token', ...} per new token, {'type': 'done', ...} per
-        completion)."""
+        """One scheduler round: deadline expiry, admissions, shedding, at
+        most one prefill chunk, one decode step over every decoding row.
+        Returns the streamed events ({'type': 'token', ...} per new token,
+        {'type': 'done', ...} per completion — deadline/overloaded
+        terminations included)."""
         events: list[dict] = []
         tracer = get_tracer()
         self._step_index += 1
+        # terminals and token chunks returned from the PREVIOUS step have
+        # been delivered by now (the caller emits between steps): retire
+        # finished ids and checkpoint progress/emitted watermarks before
+        # this step can wedge or die. Journaling either at build time
+        # would let a death between build and flush lose a terminal (or
+        # skip re-streaming tokens the client never saw).
+        self._retire_finished()
+        if self.journal is not None and self._step_index % self._journal_every == 0:
+            for request in self.scheduler.running.values():
+                self.journal.progress(request)
+        # chaos serve faults (docs/resilience.md#chaos): a wedged step and
+        # a mid-stream SIGTERM are injected exactly where the real ones
+        # land — the top of an engine step, heartbeat already owed
+        chaos = get_chaos()
+        if chaos is not None:
+            chaos.maybe_serve_stall(self._step_index)
+            chaos.maybe_serve_sigterm_mid_stream(self._step_index)
         with tracer.measure(
             "serve", "engine_step", step=self._step_index,
             running=len(self.scheduler.running),
             waiting=len(self.scheduler.waiting),
         ), self._ctx():
             before = len(self.scheduler.completed)
+            # deadlines first: expired queued work never costs a FLOP and
+            # an expired decode row frees its blocks before admission looks
+            # at the pool
+            self.scheduler.expire_deadlines()
             self.scheduler.admit()
-            # admit() can terminate a head-of-queue request the pool can
-            # NEVER hold (stop_reason='capacity') — that is a completion,
-            # and the protocol owes it a done chunk like any other
+            # the service-time EMA moves with every completion, so the
+            # projected-TTFT shed decision is re-evaluated each step too
+            self.scheduler.shed()
+            # scheduler-side completions (capacity/deadline/overloaded) are
+            # completions — the protocol owes each a done chunk like any
+            # other
             for request in self.scheduler.completed[before:]:
                 events.append(self._done_event(request))
             self.peak_running = max(self.peak_running, len(self.scheduler.running))
@@ -288,6 +525,10 @@ class ServingEngine:
             events.append({
                 "type": "token", "id": request.id,
                 "token": request.generated[request.emitted],
+                # the weights generation this token was decoded under — a
+                # mid-stream reload_weights is visible exactly where it
+                # landed (docs/serving.md#reload)
+                "generation": self.weights_generation,
             })
             request.emitted += 1
         eos = self.config.eos_token_id
@@ -366,12 +607,15 @@ class ServingEngine:
         return events
 
     def _done_event(self, request: ServeRequest) -> dict:
+        if self.journal is not None:
+            self._unretired.append(request)
         event = {
             "type": "done", "id": request.id,
             "stop_reason": request.stop_reason,
             "tokens": list(request.generated),
             "n_tokens": len(request.generated),
             "evictions": request.evictions,
+            "generation": self.weights_generation,
         }
         if request.first_token_s is not None:
             event["ttft_ms"] = round(
@@ -437,6 +681,10 @@ class ServingEngine:
                 len(self.scheduler.completed) - len(completed)
             ),
             "serve/requests_evicted": float(self.scheduler.evictions),
+            "serve/shed_total": float(self.scheduler.shed_total),
+            "serve/deadline_total": float(self.scheduler.deadline_total),
+            "serve/weights_generation": float(self.weights_generation),
+            "serve/replayed_requests": float(self.replayed_requests),
             "serve/tokens_generated": float(self.tokens_generated),
             "serve/tokens_per_sec": tps,
             "serve/tokens_per_sec_per_chip": tps / n_chips,

@@ -18,6 +18,24 @@ Per engine step the scheduler decides three things:
   so on re-admission it re-prefills and CONTINUES; greedy decode makes the
   continuation token-identical to an uninterrupted run.
 
+Two admission-control policies ride the same machinery
+(docs/serving.md#resilience):
+
+- **deadlines**: a request may carry `deadline_ms` (a latency budget
+  anchored at arrival). `expire_deadlines` — called at the top of every
+  engine step — terminates past-deadline work with
+  `stop_reason='deadline'` wherever it sits: still queued (never cost a
+  FLOP) or mid-decode (blocks freed, the tokens already streamed stand as
+  the partial result);
+- **load shedding**: the waiting queue is bounded (`max_queue`) and,
+  when a service-time estimate exists, projected TTFT is capped
+  (`shed_ttft_ms`). Over either threshold the LOWEST-priority queued
+  request (ties: youngest arrival — the eviction order) is shed with
+  `stop_reason='overloaded'`: an honest immediate terminal instead of a
+  queue that grows without bound while every resident deadline burns.
+  Intake itself never blocks.
+
+
 Slots recycle on eos / max-tokens: blocks return to the pool and the row
 becomes admissible immediately (the "slot stranding" the dense
 `InferenceEngine` batch could not avoid).
@@ -50,6 +68,9 @@ class ServeRequest:
     max_new_tokens: int
     priority: int = 0  # higher = more important (evicted last)
     arrival_s: float = field(default_factory=time.perf_counter)
+    # absolute (arrival-anchored, perf_counter clock) completion deadline;
+    # None = no deadline. Set from the protocol's relative `deadline_ms`.
+    deadline_s: float | None = None
 
     # runtime (scheduler-owned)
     generated: list[int] = field(default_factory=list)
@@ -109,6 +130,14 @@ class SchedulerConfig:
     max_model_len: int  # per-request cap: len(prompt) + max_new_tokens
     block_size: int
     prefill_chunk: int  # tokens per prefill-chunk program call
+    # intake bound: queued (not running) requests past this are shed with
+    # stop_reason='overloaded'; None = unbounded (the pre-resilience
+    # behavior)
+    max_queue: int | None = None
+    # projected-TTFT bound: when the tail of the queue projects past this
+    # many milliseconds to its first token (estimated from completed
+    # requests' service times), shed until it doesn't; None disables
+    shed_ttft_ms: float | None = None
 
 
 class Scheduler:
@@ -126,12 +155,22 @@ class Scheduler:
         self._free_slots = list(range(config.max_batch - 1, -1, -1))
         self.completed: list[ServeRequest] = []
         self.evictions = 0
+        self.shed_total = 0  # 'overloaded' terminations (load shedding)
+        self.deadline_total = 0  # 'deadline' terminations (queue + decode)
+        # EMA of completed requests' residency seconds (arrival -> done),
+        # the service-time estimate behind projected-TTFT shedding; None
+        # until the first completion (no estimate -> no TTFT shedding)
+        self._service_ema_s: float | None = None
 
     # ------------------------------------------------------------ intake
 
     def submit(self, request: ServeRequest) -> ServeRequest | None:
         """Queue a request; returns it REJECTED (stop_reason='rejected')
-        instead when it can never fit max_model_len."""
+        instead when it can never fit max_model_len. Enqueueing may shed
+        (`stop_reason='overloaded'`) — the victim is the lowest-priority
+        QUEUED request, not necessarily this one — so callers must emit
+        terminals for everything newly in `completed`, not just the return
+        value."""
         total = len(request.prompt) + request.max_new_tokens
         if len(request.prompt) == 0 or request.max_new_tokens < 1:
             request.stop_reason = "rejected"
@@ -141,6 +180,13 @@ class Scheduler:
             self.completed.append(request)
             return request
         self.waiting.append(request)
+        if not self._free_slots:
+            # saturated: nothing will drain this queue before the next
+            # decode completes, so the intake bound applies NOW (an honest
+            # synchronous 'overloaded'). With a slot free, the next step's
+            # admit -> shed pass decides — a burst that fits the free slots
+            # must not be shed on arrival order alone.
+            self.shed()
         return None
 
     @property
@@ -149,6 +195,85 @@ class Scheduler:
 
     def _blocks_for(self, tokens: int) -> int:
         return math.ceil(tokens / self.config.block_size)
+
+    # ------------------------------------------- deadlines + load shedding
+
+    def expire_deadlines(self, now: float | None = None) -> None:
+        """Terminate past-deadline requests with stop_reason='deadline' —
+        queued ones before they cost a prefill FLOP, decoding ones with
+        their blocks freed and the already-streamed tokens standing as the
+        partial result. Callers emit terminals via the `completed` diff."""
+        if now is None:
+            now = time.perf_counter()
+
+        def expired(request: ServeRequest) -> bool:
+            return request.deadline_s is not None and now >= request.deadline_s
+
+        for request in [r for r in self.waiting if expired(r)]:
+            self.waiting.remove(request)
+            self._terminate_queued(request, "deadline", now)
+        for request in [r for r in self.running.values() if expired(r)]:
+            self.finish(request, "deadline")
+            self.deadline_total += 1
+            get_tracer().instant(
+                "serve", "deadline_expired", write=request.traced,
+                request_id=request.id, phase="decode",
+                n_tokens=len(request.generated),
+            )
+
+    def shed(self) -> None:
+        """Shed lowest-priority queued work (stop_reason='overloaded')
+        while the queue is over `max_queue` or its tail projects past
+        `shed_ttft_ms` to a first token. Reuses the eviction-priority
+        order, so under overload the queue keeps exactly the requests
+        eviction would have kept."""
+        while self.waiting and self._over_intake_limits():
+            victim = min(
+                self.waiting, key=lambda r: (r.priority, -r.arrival_s)
+            )
+            self.waiting.remove(victim)
+            self._terminate_queued(victim, "overloaded")
+
+    def _over_intake_limits(self) -> bool:
+        cfg = self.config
+        if cfg.max_queue is not None and len(self.waiting) > cfg.max_queue:
+            return True
+        projected = self.projected_ttft_ms(len(self.waiting) - 1)
+        return (
+            cfg.shed_ttft_ms is not None
+            and projected is not None
+            and projected > cfg.shed_ttft_ms
+        )
+
+    def projected_ttft_ms(self, queue_position: int) -> float | None:
+        """Estimated milliseconds to first token for the request at
+        `queue_position` (0 = head of the waiting queue): each max_batch-
+        sized wave ahead of it costs ~one EMA service time. A coarse,
+        monotone-in-depth estimate — None until a completion has seeded
+        the EMA."""
+        if self._service_ema_s is None or queue_position < 0:
+            return None
+        waves = queue_position // self.config.max_batch + 1
+        return 1000.0 * waves * self._service_ema_s
+
+    def _terminate_queued(
+        self, request: ServeRequest, stop_reason: str,
+        now: float | None = None,
+    ) -> None:
+        """Complete a never-admitted (or no-longer-resident) request from
+        the queue: no slot or blocks to release."""
+        request.stop_reason = stop_reason
+        request.advance_phase("done", now)
+        self.completed.append(request)
+        if stop_reason == "overloaded":
+            self.shed_total += 1
+        elif stop_reason == "deadline":
+            self.deadline_total += 1
+        get_tracer().instant(
+            "serve", "shed" if stop_reason == "overloaded" else "deadline_expired",
+            write=request.traced, request_id=request.id, phase="queue",
+            queue_depth=len(self.waiting), priority=request.priority,
+        )
 
     # --------------------------------------------------------- admission
 
@@ -248,6 +373,15 @@ class Scheduler:
         self._release(request)
         request.stop_reason = stop_reason
         self.completed.append(request)
+        if stop_reason in ("eos", "max_tokens"):
+            # successful completions seed the service-time estimate behind
+            # projected-TTFT shedding (beta 0.8: a few requests converge it,
+            # one outlier doesn't own it)
+            service_s = max(0.0, time.perf_counter() - request.arrival_s)
+            if self._service_ema_s is None:
+                self._service_ema_s = service_s
+            else:
+                self._service_ema_s = 0.8 * self._service_ema_s + 0.2 * service_s
 
     def _release(self, request: ServeRequest) -> None:
         del self.running[request.slot]

@@ -208,6 +208,24 @@ def _serving_section(telemetry: dict) -> list[str]:
     if peak is not None:
         line += f" (peak concurrency {int(peak)})"
     lines.append(line)
+    # resilience counters (docs/serving.md#resilience): shed / expired /
+    # hot-reloaded / replayed — each omitted when absent (an older run's
+    # telemetry predates them) and the whole line omitted when all are
+    shed = num("serve/shed_total")
+    expired = num("serve/deadline_total")
+    generation = num("serve/weights_generation")
+    replayed = num("serve/replayed_requests")
+    parts = []
+    if shed:
+        parts.append(f"{int(shed)} shed (overloaded)")
+    if expired:
+        parts.append(f"{int(expired)} deadline-expired")
+    if generation:
+        parts.append(f"weights generation {int(generation)}")
+    if replayed:
+        parts.append(f"{int(replayed)} replayed from journal")
+    if parts:
+        lines.append("resilience: " + ", ".join(parts))
     if tps is not None:
         line = f"throughput: {tps:,.1f} tokens/s"
         per_chip = num("serve/tokens_per_sec_per_chip")

@@ -400,6 +400,11 @@ def summarize_trace(events: list[dict], top_k: int = 3) -> dict:
     engine put in the protocol's done event."""
     spans: dict[str, dict] = {}
     requests: dict[str, dict] = {}
+    # per-stop_reason terminal counts: under the resilience layer
+    # (docs/serving.md#resilience) deadline/overloaded terminations are
+    # normal operation, and "every request one honest terminal" is exactly
+    # what a trace reader wants to audit
+    terminal_reasons: dict[str, int] = {}
     # trace.jsonl appends across runs (like metrics.jsonl), and callers
     # (the loadgen) reuse ids like req-0 per run — a `submit` for an id
     # whose previous incarnation already completed starts a NEW logical
@@ -448,6 +453,8 @@ def summarize_trace(events: list[dict], top_k: int = 3) -> dict:
                     request["stop_reason"] = args.get("stop_reason")
                     if "n_tokens" in args:
                         request["n_tokens"] = int(args["n_tokens"])
+                    reason = str(args.get("stop_reason"))
+                    terminal_reasons[reason] = terminal_reasons.get(reason, 0) + 1
         except (TypeError, ValueError):
             continue
     completed = [
@@ -462,6 +469,7 @@ def summarize_trace(events: list[dict], top_k: int = 3) -> dict:
         "spans": spans,
         "requests_traced": len(requests),
         "requests_completed": len(completed),
+        "terminal_reasons": terminal_reasons,
         "slowest_requests": [
             {
                 "id": r["id"],

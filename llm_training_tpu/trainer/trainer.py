@@ -69,6 +69,25 @@ logger = logging.getLogger(__name__)
 LOGICAL_AXIS_RULES = tuple(DEFAULT_LOGICAL_AXIS_RULES) + (("layers", None),)
 
 
+def offload_memory_kinds() -> tuple[str, str]:
+    """(compute_kind, host_kind) for optimizer-state offload on THIS
+    backend. TPU/GPU devices address ('device', 'pinned_host'); a CPU
+    device addresses only 'unpinned_host' — which is also its default
+    memory — so both sides collapse to it and offload degrades to a
+    same-memory placement. That keeps the whole offload metadata path
+    (sharding resolution, memory-kind annotation, the blocked step's
+    host/device twins) exercisable in CPU containers instead of raising
+    'Could not find memory addressable by device cpu'."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:  # an exotic backend without the memories API
+        return "device", "pinned_host"
+    if "pinned_host" in kinds:
+        return ("device" if "device" in kinds else "pinned_host", "pinned_host")
+    fallback = "unpinned_host" if "unpinned_host" in kinds else "device"
+    return fallback, fallback
+
+
 class TrainerConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
@@ -370,6 +389,8 @@ class Trainer:
                 list(drop.mesh_axes),
             )
         if self.config.offload_optimizer_state:
+            _, host_kind = offload_memory_kinds()
+
             def maybe_host(sharding, leaf):
                 # only real arrays (mu/nu) move to host; rank-0 counters stay
                 # on device — the SPMD partitioner rejects host placement of
@@ -377,7 +398,7 @@ class Trainer:
                 shape = leaf.value.shape if isinstance(leaf, nn.Partitioned) else leaf.shape
                 if len(shape) == 0:
                     return sharding
-                return sharding.with_memory_kind("pinned_host")
+                return sharding.with_memory_kind(host_kind)
 
             shardings = shardings.replace(
                 opt_state=jax.tree.map(
@@ -404,10 +425,11 @@ class Trainer:
         offload = self.config.offload_optimizer_state
         objective_health = with_health and _objective_supports_health(objective)
         if offload:
-            # device-resident twins of the (pinned_host) opt-state shardings:
+            # device-resident twins of the host-kind opt-state shardings:
             # the update math runs in HBM, bracketed by explicit copies
+            compute_kind, _ = offload_memory_kinds()
             opt_device = jax.tree.map(
-                lambda s: s.with_memory_kind("device"),
+                lambda s: s.with_memory_kind(compute_kind),
                 self.state_shardings.opt_state,
             )
             opt_host = self.state_shardings.opt_state
@@ -806,13 +828,14 @@ class Trainer:
             if hasattr(objective, "pretrained_source")
             else None
         )
-        # init jits emit all-device buffers; offloaded (pinned_host) leaves
+        # init jits emit all-device buffers; offloaded (host-kind) leaves
         # move EAGERLY afterwards — a mixed-memory-kind out_shardings would
         # annotate every output, which some partitioners reject
         init_shardings = self.state_shardings
         if cfg.offload_optimizer_state:
+            compute_kind, _ = offload_memory_kinds()
             init_shardings = jax.tree.map(
-                lambda s: s.with_memory_kind("device"), self.state_shardings
+                lambda s: s.with_memory_kind(compute_kind), self.state_shardings
             )
 
         def init_state() -> TrainState:

@@ -401,7 +401,9 @@ def main(scratch_arg: str) -> int:
     if not watchdog.dump_paths:
         return _fail("watchdog produced no hang dump under a forced stall")
     dump = watchdog.dump_paths[0].read_text()
-    for needle in ("no train-loop heartbeat", "stalled-worker", "MainThread"):
+    # the dump header names the watchdog's PRIMARY beat source (train_loop
+    # for fits, engine_step for the serving tier)
+    for needle in ("no train_loop heartbeat", "stalled-worker", "MainThread"):
         if needle not in dump:
             return _fail(f"hang dump missing {needle!r}: {watchdog.dump_paths[0]}")
     print(f"OK watchdog: forced stall dumped thread stacks to "

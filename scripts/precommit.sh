@@ -109,6 +109,58 @@ JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smo
 grep -q "== Serving ==" "${SMOKE_ROOT}/report_serve.log"
 grep -q "ttft" "${SMOKE_ROOT}/report_serve.log"
 
+# serve-drain gate (docs/serving.md#resilience): the full drain + supervised
+# replay + watchdog story, end to end through the real CLI. Leg 1: chaos
+# SIGTERM mid-stream (+ a malformed flood the error boundary must answer)
+# -> graceful drain (timeout 0 forces journaling) -> exit 75 -> `supervise
+# --child serve` relaunch replays the journal -> the loadgen's terminal
+# contract holds: every request exactly ONE done chunk across the boundary,
+# zero pool-block leaks. Leg 2: chaos stall wedges an engine step -> the
+# serve watchdog flight-dumps the trace ring and SIGABRTs -> another
+# supervised relaunch replays -> same contract, and the flight dump exists.
+echo "== precommit: serve drain (SIGTERM -> 75 -> replay; stall -> watchdog -> replay) =="
+JAX_PLATFORMS=cpu LLMT_CHAOS_SERVE_SIGTERM_STEP=6 LLMT_CHAOS_SERVE_MALFORMED_FLOOD=2 \
+    python scripts/serve_loadgen.py \
+    --config config/examples/smoke/cpu-smoke.yaml \
+    --requests 4 --max-new-tokens 16 --supervised \
+    --deadline-ms 60000 --deadline-every 2 \
+    --out "${SMOKE_ROOT}/serve_drain.json" \
+    "run_root=${SMOKE_ROOT}" --max-batch 2 --max-model-len 64 \
+    --prefill-chunk 4 --eos-token-id -1 --drain-timeout-s 0 \
+    | tee "${SMOKE_ROOT}/serve_drain.log"
+grep -q '"drain"' "${SMOKE_ROOT}/smoke/cpu-smoke/trace.jsonl" \
+    || { echo "no drain event reached trace.jsonl"; exit 1; }
+grep -q '"rc": 75' "${SMOKE_ROOT}/smoke/cpu-smoke/supervisor.jsonl" \
+    || { echo "supervisor never saw the resumable drain exit"; exit 1; }
+python - "${SMOKE_ROOT}/serve_drain.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert not doc["errors"], doc["errors"]
+assert doc["engine"]["serve/replayed_requests"] >= 1, \
+    f"relaunch replayed nothing: {doc['engine']}"
+assert doc["error_chunks"] >= 2, f"malformed flood unanswered: {doc}"
+print("serve drain: OK —", int(doc["engine"]["serve/replayed_requests"]),
+      "replayed,", doc["terminal_reasons"])
+EOF
+JAX_PLATFORMS=cpu LLMT_CHAOS_SERVE_STALL_STEP=4 \
+    python scripts/serve_loadgen.py \
+    --config config/examples/smoke/cpu-smoke.yaml \
+    --requests 3 --max-new-tokens 12 --supervised \
+    --out "${SMOKE_ROOT}/serve_stall.json" \
+    "run_root=${SMOKE_ROOT}" --max-batch 2 --max-model-len 64 \
+    --prefill-chunk 4 --eos-token-id -1 --drain-timeout-s 0 \
+    --watchdog-timeout-s 5 \
+    | tee "${SMOKE_ROOT}/serve_stall.log"
+ls "${SMOKE_ROOT}"/smoke/cpu-smoke/trace-flight-hang-*.jsonl >/dev/null 2>&1 \
+    || { echo "watchdog stall produced no trace flight dump"; exit 1; }
+python - "${SMOKE_ROOT}/serve_stall.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert not doc["errors"], doc["errors"]
+assert doc["engine"]["serve/replayed_requests"] >= 1, doc["engine"]
+print("serve stall: OK —", doc["terminal_reasons"])
+EOF
+
 # trace gate (docs/observability.md#tracing): the fit (train track) and the
 # serve loadgen (request tracks) both appended to the run dir's
 # trace.jsonl; `trace` must export valid Chrome-trace JSON with both

@@ -1,42 +1,63 @@
 #!/usr/bin/env python
 """Synthetic-traffic load driver for the `serve` CLI (docs/serving.md).
 
-Spawns `llm-training-tpu serve` as a child process and drives the real
-JSONL stdin/stdout protocol with OVERLAPPING arrivals: the first request
-goes in immediately; every later request is held until the first streamed
-token chunk proves decode is in flight, then submitted with a small gap —
-so continuous batching (admission mid-decode) is what the run exercises,
-not a closed batch.
+Spawns `llm-training-tpu serve` (or, with `--supervised`, `supervise
+--child serve` — the drain/replay harness) as a child process and drives
+the real JSONL stdin/stdout protocol. Two arrival modes:
+
+- **overlap** (default): the first request goes in immediately; every
+  later request is held until the first streamed token chunk proves decode
+  is in flight, then submitted with a small gap — so continuous batching
+  (admission mid-decode) is what the run exercises, not a closed batch;
+- **burst**: every request is written up front, as fast as the pipe takes
+  them — the overload shape that drives the intake bound / projected-TTFT
+  shedding (`--max-batch`/`--max-queue` small → `overloaded` terminals).
+
+`--deadline-ms N --deadline-every K` stamps every K-th request with a
+latency budget (mixed traffic: some requests carry deadlines, some don't),
+and `--malformed N` interleaves N junk lines the server must answer with
+`{"type": "error"}` chunks while everything well-formed still terminates.
+
+The terminal contract this driver enforces (exit nonzero on violation) is
+the serving tier's resilience acceptance: every submitted request must end
+in EXACTLY ONE `done` chunk — stop_reason ∈ eos / max_tokens / deadline /
+overloaded / rejected / capacity — across the whole run, including a
+supervised drain/replay boundary (the relaunched child inherits this
+driver's pipes, so duplicate or missing terminals are visible here).
+Additional failures: a done with no token chunks for a FULL completion
+(eos/max_tokens), a pool-block leak in the last stats record, fewer error
+chunks than injected malformed lines, and (overlap mode only) arrivals
+that never overlapped (`serve/peak_running` < 2).
 
 Client-side latency is measured per request from its submit time: TTFT to
 the first token chunk, TPOT across subsequent chunks. The summary merges
-the engine's own `serve/*` stats record (throughput, pool pressure) with
-the client percentiles, prints one JSON object, and exits nonzero when
-
-- any request fails to terminate (no `done` chunk),
-- a `done` arrives with no preceding token chunks for that id,
-- the engine leaks pool blocks (`decode/cache_blocks_in_use` != 0), or
-- arrivals never overlapped (`serve/peak_running` < 2).
-
-The child merges its gauges into the run dir's telemetry.jsonl as usual,
-so a following `report` renders `== Serving ==` — the precommit
-serve-smoke gate asserts exactly that chain.
+the engine's own `serve/*` stats record (throughput, shed/deadline/replay
+counters, pool pressure) with the client percentiles and a per-stop_reason
+terminal census, prints one JSON object, and exits nonzero on any failure.
 
 Usage:
     python scripts/serve_loadgen.py --config <yaml> [overrides...] \
-        [--requests 4] [--max-new-tokens 8] [--arrival-gap-s 0.05] \
-        [--out summary.json] [-- <extra serve args>]
+        [--requests 4] [--max-new-tokens 8] [--arrival {overlap,burst}] \
+        [--deadline-ms 0 --deadline-every 2] [--malformed 0] \
+        [--supervised] [--out summary.json] [-- <extra serve args>]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import subprocess
 import sys
 import threading
 import time
+
+# the terminal states the protocol may end a request in — anything else
+# (or anything twice, or nothing at all) is a dropped/duplicated stream
+TERMINAL_REASONS = (
+    "eos", "max_tokens", "deadline", "overloaded", "rejected", "capacity"
+)
 
 
 def percentile(values: list[float], q: float) -> float:
@@ -52,12 +73,35 @@ def build_requests(args) -> list[dict]:
     requests = []
     for n in range(args.requests):
         length = rng.randint(args.min_prompt, args.max_prompt)
-        requests.append({
+        request = {
             "id": f"req-{n}",
             "prompt": [rng.randint(3, args.vocab - 1) for _ in range(length)],
             "max_new_tokens": args.max_new_tokens,
-        })
+        }
+        if args.deadline_ms and args.deadline_every and n % args.deadline_every == 0:
+            request["deadline_ms"] = args.deadline_ms
+        requests.append(request)
     return requests
+
+
+def build_child_argv(args) -> list[str]:
+    """The plain `serve` command, or the supervised wrapper that relaunches
+    it on exit 75 / signal deaths (drain + journal replay,
+    docs/serving.md#resilience)."""
+    if not args.supervised:
+        return [
+            sys.executable, "-m", "llm_training_tpu", "serve",
+            "--config", args.config, *args.serve_args,
+        ]
+    import shlex
+
+    return [
+        sys.executable, "-m", "llm_training_tpu", "supervise",
+        "--child", "serve", "--config", args.config,
+        "--max-restarts", str(args.max_restarts),
+        "--backoff-base-s", "0.2", "--backoff-max-s", "1.0",
+        "--child-args", shlex.join(args.serve_args),
+    ]
 
 
 def main() -> int:
@@ -70,8 +114,38 @@ def main() -> int:
     parser.add_argument("--vocab", type=int, default=64, help="synthetic token id bound")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--arrival", default="overlap", choices=("overlap", "burst"),
+        help="overlap = follow-ups wait for the first token (continuous-"
+        "batching proof); burst = everything up front (overload/shedding)",
+    )
+    parser.add_argument(
         "--arrival-gap-s", type=float, default=0.05,
-        help="gap between follow-up arrivals (all after the first token)",
+        help="gap between follow-up arrivals (overlap mode)",
+    )
+    parser.add_argument(
+        "--deadline-ms", type=float, default=0.0,
+        help="latency budget stamped on every --deadline-every-th request "
+        "(0 = no deadlines)",
+    )
+    parser.add_argument(
+        "--deadline-every", type=int, default=2,
+        help="which requests carry --deadline-ms (every K-th, from the "
+        "first) — mixed deadline traffic by default",
+    )
+    parser.add_argument(
+        "--malformed", type=int, default=0,
+        help="junk lines interleaved into the stream; the server owes an "
+        "error chunk for each and every real request still a terminal",
+    )
+    parser.add_argument(
+        "--supervised", action="store_true",
+        help="drive `supervise --child serve` instead of bare `serve`: "
+        "SIGTERM/SIGABRT deaths relaunch and replay the request journal "
+        "(pair with LLMT_CHAOS_SERVE_* faults)",
+    )
+    parser.add_argument(
+        "--max-restarts", type=int, default=3,
+        help="supervise restart budget (--supervised only)",
     )
     parser.add_argument(
         "--idle-timeout-s", type=float, default=600.0,
@@ -88,12 +162,9 @@ def main() -> int:
     args.serve_args += passthrough
 
     requests = build_requests(args)
-    argv = [
-        sys.executable, "-m", "llm_training_tpu", "serve",
-        "--config", args.config, *args.serve_args,
-    ]
     child = subprocess.Popen(
-        argv, stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, bufsize=1
+        build_child_argv(args),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, bufsize=1,
     )
 
     submit_s: dict[str, float] = {}
@@ -101,27 +172,40 @@ def main() -> int:
     last_token_s: dict[str, float] = {}
     chunks: dict[str, int] = {}
     done: dict[str, dict] = {}
+    done_counts: dict[str, int] = {}
     stats: dict[str, float] = {}
-    errors: list[str] = []
+    error_chunks: list[str] = []
+    failures: list[str] = []
     first_token_seen = threading.Event()
+
+    def send_line(line: str) -> None:
+        child.stdin.write(line + "\n")
+        child.stdin.flush()
 
     def send(request: dict) -> None:
         submit_s[request["id"]] = time.perf_counter()
-        child.stdin.write(json.dumps(request) + "\n")
-        child.stdin.flush()
+        send_line(json.dumps(request))
 
     def feed() -> None:
+        malformed_left = args.malformed
         try:
             send(requests[0])
-            # hold the rest until decode is demonstrably in flight, so
-            # every later arrival exercises mid-stream admission; the first
-            # follow-up goes immediately (a warm decode step is ~ms — any
-            # fixed gap risks outliving the whole first generation)
-            first_token_seen.wait()
+            if args.arrival == "overlap":
+                # hold the rest until decode is demonstrably in flight, so
+                # every later arrival exercises mid-stream admission; the
+                # first follow-up goes immediately (a warm decode step is
+                # ~ms — any fixed gap risks outliving the first generation)
+                first_token_seen.wait()
             for n, request in enumerate(requests[1:]):
-                if n:
+                if malformed_left > 0:
+                    send_line('{"garbage: true')  # interleaved junk
+                    malformed_left -= 1
+                if n and args.arrival == "overlap":
                     time.sleep(args.arrival_gap_s)
                 send(request)
+            while malformed_left > 0:
+                send_line('{"garbage: true')
+                malformed_left -= 1
         except BrokenPipeError:
             pass  # child died; the reader loop reports it
         finally:
@@ -156,35 +240,59 @@ def main() -> int:
                 last_token_s[rid] = now
                 first_token_seen.set()
             elif kind == "done":
-                done[event["id"]] = event
-                # a token-less termination (rejected / capacity) must also
-                # unblock the feeder, or a first request that never streams
-                # wedges the whole run until the idle timeout
+                rid = event["id"]
+                done[rid] = event
+                done_counts[rid] = done_counts.get(rid, 0) + 1
+                # a token-less termination (rejected / capacity / deadline /
+                # overloaded) must also unblock the feeder, or a first
+                # request that never streams wedges the run until the idle
+                # timeout
                 first_token_seen.set()
             elif kind == "stats":
-                stats = event["stats"]
+                stats = event["stats"]  # last record wins across relaunches
             elif kind == "error":
-                errors.append(event.get("error", "unknown"))
+                error_chunks.append(event.get("error", "unknown"))
                 first_token_seen.set()
     finally:
         timer.cancel()
         first_token_seen.set()  # unblock the feeder if the child died early
     rc = child.wait()
 
+    # --- the terminal contract: exactly one honest terminal per request
     for request in requests:
         rid = request["id"]
-        if rid not in done:
-            errors.append(f"{rid}: no done chunk (rc {rc})")
-        elif done[rid].get("stop_reason") in ("eos", "max_tokens") and not chunks.get(rid):
-            errors.append(f"{rid}: done without any streamed token chunks")
+        count = done_counts.get(rid, 0)
+        if count == 0:
+            failures.append(f"{rid}: no done chunk (rc {rc})")
+            continue
+        if count > 1:
+            failures.append(
+                f"{rid}: {count} done chunks — a terminal must arrive "
+                "exactly once (duplicate across a drain/replay boundary?)"
+            )
+        reason = done[rid].get("stop_reason")
+        if reason not in TERMINAL_REASONS:
+            failures.append(f"{rid}: unknown stop_reason {reason!r}")
+        elif reason in ("eos", "max_tokens") and not chunks.get(rid):
+            failures.append(f"{rid}: done without any streamed token chunks")
     leaked = stats.get("decode/cache_blocks_in_use")
     if leaked is None:
-        errors.append("no stats record from the child")
+        failures.append("no stats record from the child")
     elif leaked:
-        errors.append(f"pool leak: {int(leaked)} blocks still in use at exit")
+        failures.append(f"pool leak: {int(leaked)} blocks still in use at exit")
+    # the serve process also answers chaos-injected junk
+    # (LLMT_CHAOS_SERVE_MALFORMED_FLOOD) with error chunks on this stream
+    expected_errors = args.malformed + int(
+        os.environ.get("LLMT_CHAOS_SERVE_MALFORMED_FLOOD", "0") or 0
+    )
+    if len(error_chunks) < expected_errors:
+        failures.append(
+            f"only {len(error_chunks)} error chunk(s) for "
+            f"{expected_errors} malformed line(s)"
+        )
     peak = stats.get("serve/peak_running", 0)
-    if len(requests) > 1 and peak < 2:
-        errors.append(
+    if args.arrival == "overlap" and len(requests) > 1 and peak < 2:
+        failures.append(
             f"arrivals never overlapped (peak_running {peak}) — raise "
             "--max-new-tokens or check --max-batch > 1"
         )
@@ -196,13 +304,17 @@ def main() -> int:
         1000.0 * (last_token_s[r] - first_token_s[r]) / (chunks[r] - 1)
         for r in first_token_s if chunks.get(r, 0) > 1
     ]
+    reasons: dict[str, int] = {}
+    for event in done.values():
+        reason = str(event.get("stop_reason"))
+        reasons[reason] = reasons.get(reason, 0) + 1
     summary = {
         "requests": len(requests),
-        "completed": sum(
-            1 for d in done.values() if d.get("stop_reason") in ("eos", "max_tokens")
-        ),
+        "completed": reasons.get("eos", 0) + reasons.get("max_tokens", 0),
+        "terminal_reasons": reasons,
         "streamed_chunks": sum(chunks.values()),
-        "errors": errors,
+        "error_chunks": len(error_chunks),
+        "errors": failures,
         "engine": stats,
     }
     if ttft:
@@ -217,7 +329,7 @@ def main() -> int:
     if args.out:
         with open(args.out, "w") as f:
             json.dump(summary, f)
-    return 1 if errors else 0
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -205,7 +205,9 @@ def test_watchdog_dumps_stacks_on_stall(tmp_path):
         thread.join()
     assert watchdog.dump_paths, "no hang dump produced under a forced stall"
     content = watchdog.dump_paths[0].read_text()
-    assert "no train-loop heartbeat" in content
+    # the header names the PRIMARY beat source (train_loop for a fit,
+    # engine_step for the serving tier)
+    assert "no train_loop heartbeat" in content
     assert "goodput phase open at stall: data_wait" in content
     assert "parked-worker" in content  # every thread's stack is in the dump
     assert "MainThread" in content
