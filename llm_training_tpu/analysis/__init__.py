@@ -24,8 +24,19 @@ chip-hours before surfacing:
   param metadata under models/ appears in the `KNOWN_LOGICAL_AXES`
   registry (`parallel/sharding.py`) — a typo'd axis name used to become a
   silently fully-replicated weight.
+- **thread-jax-free**: functions reachable from `threading.Thread`
+  targets, `Timer` callbacks, or signal handlers never reach jax — a
+  watchdog calling into jax can block behind the wedged dispatch it
+  exists to diagnose (the prefetcher worker is the one sanctioned,
+  suppressed exception).
 
-The package also ships **shardcheck** (`--audit`, `shard_audit.py` +
+The package also ships **racecheck** (`--races`, `racecheck.py` +
+`threadmodel.py` + `interleave.py`, docs/static-analysis.md#racecheck):
+a jax-free thread-model audit — the AST's thread-entry graph checked
+against the `# guarded by:` contract registry (unguarded shared
+mutation, lock-order inversions, signal-handler safety) plus a
+seed-deterministic interleaving harness whose failing schedules replay
+byte-identically — and **shardcheck** (`--audit`, `shard_audit.py` +
 `hbm_budget.py`): an abstract-interpretation audit that `jax.eval_shape`s
 every registered model family's init and resolves the param/opt-state/
 KV-cache trees against a mesh-configuration matrix — unknown axes,

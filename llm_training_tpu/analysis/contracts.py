@@ -107,6 +107,88 @@ LOGICAL_AXIS_PARAM = "logical_axes"
 # tests construct intentionally-broken fixtures)
 MODELS_DIR = "llm_training_tpu/models/"
 
+# ------------------------------------------------------- racecheck (--races)
+# Classes / module functions a FOREIGN thread is contractually allowed to
+# call — concurrency the AST cannot see from their own module (the spawn
+# site lives elsewhere). Keys are repo-relative paths; inner keys are class
+# or function names; values are WHY the surface is cross-thread — quoted in
+# findings so a violation message explains the contract it broke. Declaring
+# a class here makes racecheck require a `# guarded by:` declaration (and a
+# held lock at every mutation) for each of its shared attributes.
+THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
+    "llm_training_tpu/telemetry/registry.py": {
+        "Counter": "producer threads (prefetcher, checkpointer) record "
+        "concurrently with the step loop",
+        "Gauge": "same contract as Counter — any thread may publish",
+        "Timer": "same contract as Counter — any thread may time",
+        "TelemetryRegistry": "the registry's docstring contract: all "
+        "mutation goes through one RLock, so any thread may record",
+        "get_registry": "the module-global current registry is read from "
+        "worker threads (new threads do not inherit contextvars)",
+    },
+    "llm_training_tpu/telemetry/trace.py": {
+        "TraceRecorder": "the ring is the crash flight recorder — the "
+        "watchdog thread flight-dumps it while the main loop records",
+        "get_tracer": "worker threads and the watchdog resolve the "
+        "process tracer through this module global",
+        "set_tracer": "same global as get_tracer",
+    },
+    "llm_training_tpu/telemetry/goodput.py": {
+        "GoodputLedger": "the hang watchdog reads current_phase from its "
+        "poll thread while the train loop brackets phases",
+    },
+    "llm_training_tpu/serve/journal.py": {
+        "RequestJournal": "the serve CLI journals deliveries from its "
+        "stdin reader thread while the engine journals progress from the "
+        "step loop (the PR 12 lost-delivery race class)",
+    },
+    "llm_training_tpu/resilience/chaos.py": {
+        "Chaos": "chaos_point fires from the prefetcher worker (data "
+        "site) concurrently with trainer-thread sites",
+        "chaos_point": "the process-global harness is read from worker "
+        "threads at every injection site",
+        "get_chaos": "same global as chaos_point (the serve engine reads "
+        "it from the step loop)",
+    },
+    "llm_training_tpu/resilience/watchdog.py": {
+        "HangWatchdog": "beat() is called from the prefetcher worker "
+        "(heartbeat hook) as well as the train loop, racing the poll "
+        "thread's staleness checks",
+    },
+}
+
+# Global lock-acquisition order (outer first): while holding a lock, only
+# locks LATER in this tuple may be acquired. The interleaving harness
+# (analysis/interleave.py) records acquisition edges at test time and
+# asserts them against this order; the static race-lock-order rule reports
+# inversions it can prove lexically. Rationale: the journal/trace/registry
+# locks are leaves that any subsystem may take while doing its own locked
+# work (metric publication, flight dumps), so they sort last; harness and
+# watchdog locks wrap policy decisions and sort first.
+LOCK_ORDER = (
+    "chaos",     # resilience/chaos.py Chaos._lock + _active_lock
+    "watchdog",  # resilience/watchdog.py HangWatchdog._lock
+    "goodput",   # telemetry/goodput.py GoodputLedger._lock
+    "journal",   # serve/journal.py RequestJournal._lock
+    "trace",     # telemetry/trace.py TraceRecorder._lock + _current_lock
+    "registry",  # telemetry/registry.py TelemetryRegistry._lock (leaf)
+)
+
+# ---------------------------------------------------------------- rule 7
+# Why thread targets must stay jax-free (the `thread-jax-free` rule): the
+# host layer's threads exist to stay responsive while the main thread owns
+# the device — a watchdog that calls into jax can block behind the exact
+# wedged dispatch it is supposed to diagnose, and a reader/journal thread
+# that triggers compilation stalls intake for seconds. The ONE sanctioned
+# exception is the DevicePrefetcher worker, whose entire job is overlapping
+# jax.device_put with the step — it carries an inline
+# `# lint: allow(thread-jax-free)` suppression with this rationale.
+THREAD_JAX_FREE_WHY = (
+    "host-layer threads (watchdog, stdin reader, journal, timers) must "
+    "never own device work: a jax call there can deadlock behind the "
+    "wedged main-thread dispatch it exists to outlive"
+)
+
 # ---------------------------------------------------------------- rule 3
 # jit wrappers whose first function argument starts a traced region
 JIT_WRAPPERS = ("jit", "pjit")

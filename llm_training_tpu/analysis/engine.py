@@ -27,6 +27,7 @@ from typing import Callable, Iterable
 # intentionally-broken fixtures); point the CLI at extra paths to widen.
 DEFAULT_SCAN = ("llm_training_tpu", "scripts", "bench.py")
 DEFAULT_BASELINE = "config/lint_baseline.json"
+DEFAULT_RACE_BASELINE = "config/race_baseline.json"
 # meta-findings that must never be grandfathered: a baselined reasonless
 # suppression would permanently void the mandatory-reason rule, and a
 # baselined parse error hides every finding in the broken file
@@ -178,6 +179,7 @@ def all_rules() -> list[RuleSpec]:
         logical_axes,
         pallas_arity,
         telemetry_prefixes,
+        thread_jax_free,
     )
 
     return [
@@ -187,6 +189,7 @@ def all_rules() -> list[RuleSpec]:
         telemetry_prefixes.RULE,
         env_docs.RULE,
         logical_axes.RULE,
+        thread_jax_free.RULE,
     ]
 
 
@@ -203,10 +206,14 @@ def run_analysis(
     paths: Iterable[str] | None = None,
     rules: Iterable[str] | None = None,
     baseline_keys: set[str] | None = None,
+    rule_specs: list[RuleSpec] | None = None,
 ) -> AnalysisResult:
+    """Run `rule_specs` (default: the graftlint rule table) over the scan
+    set; the racecheck mode passes its own rule list through here so the
+    suppression/baseline machinery is shared verbatim."""
     t0 = time.monotonic()
     ctx = RepoContext(root, paths)
-    selected = all_rules()
+    selected = rule_specs if rule_specs is not None else all_rules()
     if rules is not None:
         wanted = set(rules)
         known = {rule.name for rule in selected}
@@ -304,13 +311,47 @@ def _default_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
+def _changed_scan_paths(root: Path) -> list[str] | None:
+    """Repo-relative .py files changed vs HEAD (worktree + staged +
+    untracked), restricted to the default scan set. None when git is
+    unavailable or errors — the caller then falls back to the full tree
+    (scanning MORE than asked is the safe degradation)."""
+    import subprocess
+
+    changed: set[str] = set()
+    for argv in (
+        ["git", "-C", str(root), "diff", "--name-only", "HEAD", "--"],
+        ["git", "-C", str(root), "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                argv, capture_output=True, text=True, timeout=30
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        changed.update(line.strip() for line in proc.stdout.splitlines())
+    scan_roots = tuple(
+        entry + "/" for entry in DEFAULT_SCAN if not entry.endswith(".py")
+    )
+    scan_files = tuple(entry for entry in DEFAULT_SCAN if entry.endswith(".py"))
+    return sorted(
+        rel for rel in changed
+        if rel.endswith(".py")
+        and (rel.startswith(scan_roots) or rel in scan_files)
+        and (root / rel).is_file()  # deletions need no scan
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m llm_training_tpu.analysis",
         description=(
             "graftlint: repo-native static analysis (the AST rules never "
-            "import jax; `--audit` runs the shardcheck abstract-eval audit, "
-            "which does — CPU-only, zero FLOPs). "
+            "import jax; `--races` runs the racecheck thread-model audit, "
+            "also jax-free; `--audit` runs the shardcheck abstract-eval "
+            "audit, which does import jax — CPU-only, zero FLOPs). "
             "Exit 0 = clean, 1 = findings, 2 = usage error."
         ),
         epilog=(
@@ -360,6 +401,25 @@ def main(argv: list[str] | None = None) -> int:
         help="tensors above this size may not resolve fully-replicated on "
         "param-capable meshes (default 4)",
     )
+    races = parser.add_argument_group(
+        "racecheck",
+        "`--races` switches to the thread-model audit (racecheck.py): "
+        "shared-state guarded-by contracts, lock-order cycles, and "
+        "signal-handler safety, built from the AST's thread-entry graph. "
+        "Jax-free like the lint, with its own baseline "
+        f"(config/race_baseline.json). docs/static-analysis.md#racecheck.",
+    )
+    races.add_argument(
+        "--races", action="store_true",
+        help="run the thread-model race audit instead of the lint rules",
+    )
+    parser.add_argument(
+        "--changed-only", action="store_true",
+        help="scan only .py files changed vs git HEAD (plus untracked) — "
+        "the fast local-commit mode; cross-file contract walks still parse "
+        "the rest of the tree on demand, and CI/precommit keep the "
+        "full-tree default",
+    )
     parser.add_argument(
         "--root", type=Path, default=None, help="repo root (default: cwd if it holds llm_training_tpu/)"
     )
@@ -377,14 +437,41 @@ def main(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    rule_specs: list[RuleSpec] | None = None
+    if args.races:
+        from llm_training_tpu.analysis.racecheck import race_rules
+
+        rule_specs = race_rules()
+
     if args.list_rules:
-        for rule in all_rules():
+        for rule in rule_specs or all_rules():
             print(f"{rule.name:24s} {rule.description}")
         return 0
 
     root = (args.root or _default_root()).resolve()
     if not (root / "llm_training_tpu").is_dir():
         print(f"graftlint: {root} does not look like the repo root", file=sys.stderr)
+        return 2
+    if args.races and args.audit:
+        print(
+            "graftlint: --races and --audit are separate gates; run them "
+            "separately",
+            file=sys.stderr,
+        )
+        return 2
+    if args.changed_only and args.audit:
+        print(
+            "graftlint: --changed-only scopes the AST scan set; the "
+            "audit has no path scoping",
+            file=sys.stderr,
+        )
+        return 2
+    if args.changed_only and args.paths:
+        print(
+            "graftlint: --changed-only and explicit paths are "
+            "mutually exclusive",
+            file=sys.stderr,
+        )
         return 2
     audit_only_flags = (
         args.families is not None
@@ -416,7 +503,40 @@ def main(argv: list[str] | None = None) -> int:
             file=sys.stderr,
         )
         return 2
-    baseline_path = args.baseline or (root / DEFAULT_BASELINE)
+    if args.changed_only:
+        # AFTER every usage-flag validation: an invalid invocation must
+        # exit 2 regardless of git diff state, never a state-dependent 0
+        changed = _changed_scan_paths(root)
+        if changed is None:
+            print(
+                "graftlint: git unavailable for --changed-only — falling "
+                "back to the full tree",
+                file=sys.stderr,
+            )
+        elif not changed:
+            if args.json:
+                # precommit tees this into audit/race record files the
+                # report renders — an empty diff must still be valid JSON
+                print(json.dumps({
+                    "version": 1,
+                    "mode": "races" if args.races else "lint",
+                    "findings": [],
+                    "suppressed": 0,
+                    "baselined": 0,
+                    "elapsed_s": 0.0,
+                    "changed_only": "empty diff — nothing scanned",
+                }))
+            else:
+                print(
+                    "graftlint: OK — no changed .py files in the scan set "
+                    "(--changed-only)"
+                )
+            return 0
+        else:
+            args.paths = changed
+    gate = "racecheck" if args.races else "graftlint"
+    default_baseline = DEFAULT_RACE_BASELINE if args.races else DEFAULT_BASELINE
+    baseline_path = args.baseline or (root / default_baseline)
     baseline_keys = set() if args.no_baseline else load_baseline(baseline_path)
 
     try:
@@ -425,9 +545,10 @@ def main(argv: list[str] | None = None) -> int:
             paths=args.paths or None,
             rules=args.rules.split(",") if args.rules else None,
             baseline_keys=baseline_keys,
+            rule_specs=rule_specs,
         )
     except ValueError as exc:
-        print(f"graftlint: {exc}", file=sys.stderr)
+        print(f"{gate}: {exc}", file=sys.stderr)
         return 2
 
     if args.update_baseline:
@@ -444,7 +565,7 @@ def main(argv: list[str] | None = None) -> int:
             keep_keys |= baseline_keys
         write_baseline(baseline_path, keep_keys)
         print(
-            f"graftlint: baseline updated with {len(keep_keys)} finding(s) "
+            f"{gate}: baseline updated with {len(keep_keys)} finding(s) "
             f"({len(result.baselined)} still firing, carried over) at {baseline_path}"
         )
         return 0
@@ -454,6 +575,7 @@ def main(argv: list[str] | None = None) -> int:
             json.dumps(
                 {
                     "version": 1,
+                    "mode": "races" if args.races else "lint",
                     "findings": [
                         {
                             "rule": f.rule,
@@ -476,7 +598,7 @@ def main(argv: list[str] | None = None) -> int:
         print(finding.render())
     status = "FAIL" if result.findings else "OK"
     print(
-        f"graftlint: {status} — {len(result.findings)} finding(s) "
+        f"{gate}: {status} — {len(result.findings)} finding(s) "
         f"({len(result.suppressed)} suppressed, {len(result.baselined)} baselined) "
         f"in {result.elapsed_s:.2f}s"
     )

@@ -87,7 +87,11 @@ def _enclosing_class_method(mod: _Module, fn: ast.AST, method: str) -> ast.AST |
 
 
 class _Graph:
-    """Cross-module call resolution over the scan set."""
+    """Cross-module call resolution. Seeded with the scan set; modules
+    OUTSIDE it resolve on demand through the context's parse cache, so a
+    narrowed run (`--changed-only`, explicit paths) still follows calls
+    into unscanned files — entry points are only discovered inside the
+    scan set, but their reachability is whole-tree."""
 
     def __init__(self, ctx: RepoContext):
         self.ctx = ctx
@@ -103,7 +107,19 @@ class _Graph:
         file = self.ctx.file_for_module(dotted)
         if file is None:
             return None
-        return self.modules.get(self.ctx.rel(file))
+        rel = self.ctx.rel(file)
+        mod = self.modules.get(rel)
+        if mod is None:
+            parsed = self.ctx.parsed(file)
+            if parsed is None:
+                return None
+            mod = _Module(
+                parsed=parsed,
+                scopes=ScopeIndex(parsed.tree),
+                imports=_import_map(parsed.tree),
+            )
+            self.modules[rel] = mod
+        return mod
 
     def resolve_callables(
         self, mod: _Module, expr: ast.AST, site: ast.AST, depth: int = 0
@@ -157,7 +173,8 @@ class _Graph:
 
 def _entry_points(graph: _Graph) -> list[tuple[_Module, ast.AST]]:
     entries: list[tuple[_Module, ast.AST]] = []
-    for mod in graph.modules.values():
+    # snapshot: resolve_callables may lazily add out-of-scan modules
+    for mod in list(graph.modules.values()):
         for node in ast.walk(mod.parsed.tree):
             if isinstance(node, ast.Call):
                 if terminal_name(node.func) in contracts.JIT_WRAPPERS and node.args:

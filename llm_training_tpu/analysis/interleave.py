@@ -1,0 +1,463 @@
+"""Deterministic interleaving harness: the dynamic half of racecheck
+(docs/static-analysis.md#racecheck).
+
+Concurrency bugs that survive the static rules are schedule-dependent:
+they need a *specific* interleaving of the stdin-reader's journal delivery
+against the drain path, or of a `flight_dump` against the sink writer.
+Stress tests find those schedules once in a thousand runs; this harness
+finds them on purpose and replays them forever:
+
+- logical threads run as real `threading.Thread`s, but a **baton** keeps
+  exactly one runnable at a time — every context switch is an explicit
+  scheduler decision;
+- switch decisions come from `random.Random(seed)` (or an explicit replay
+  `schedule` list), so a failing run replays **byte-identically** from its
+  seed: same decisions, same lock interleavings, same trace;
+- switch points are lock operations (`threading.Lock`/`RLock` constructed
+  under `instrumented_locks()` yield before every acquire) plus explicit
+  `sched_point()` calls tests sprinkle between steps of the operation
+  under test;
+- a blocked acquire parks the thread until the owner releases; if every
+  live thread is parked the harness raises `DeadlockError` naming who
+  waits on what — a lock-order inversion becomes a crisp test failure
+  instead of a hung CI job;
+- every acquisition taken while holding another lock records an order
+  edge; `assert_lock_order()` checks the edges against the repo's declared
+  `contracts.LOCK_ORDER` (and against itself for cycles).
+
+`shrink()` minimizes a failing seed: it replays the recorded decision
+list and greedily deletes context switches (extending the previous
+thread's run instead), keeping each deletion only if the failure
+survives. The result is an explicit minimal `schedule` to commit in a
+regression test.
+
+Jax-free and stdlib-only, like everything in `analysis/`.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+
+from llm_training_tpu.analysis import contracts
+
+
+# captured before any instrumented_locks() patching, so SchedLock's own
+# inner lock never recurses into the patched constructor
+_REAL_LOCK = threading.Lock
+
+
+class DeadlockError(AssertionError):
+    """Every live logical thread is parked on a lock: a real deadlock,
+    found deterministically."""
+
+
+class LockOrderError(AssertionError):
+    """Recorded acquisition edges violate the declared order (or form a
+    cycle among themselves)."""
+
+
+class InterleaveFailure(AssertionError):
+    """An exception escaped a logical thread; carries the seed and the
+    decision trace needed to replay it."""
+
+    def __init__(self, thread_name: str, original: BaseException, run: "Interleaver"):
+        super().__init__(
+            f"thread {thread_name!r} raised {original!r} under seed "
+            f"{run.seed} after {len(run.choices)} switch decision(s); "
+            f"replay with Interleaver(schedule={run.choices!r})"
+        )
+        self.thread_name = thread_name
+        self.original = original
+        self.seed = run.seed
+        self.choices = list(run.choices)
+
+
+class _Abort(BaseException):
+    """Unwinds parked logical threads when the run is torn down."""
+
+
+@dataclass
+class _LogicalThread:
+    name: str
+    fn: object
+    go: threading.Event = field(default_factory=threading.Event)
+    parked: threading.Event = field(default_factory=threading.Event)
+    waiting_on: "SchedLock | None" = None
+    done: bool = False
+    error: BaseException | None = None
+    thread: threading.Thread | None = None
+
+
+_tls = threading.local()
+
+
+def _current_run() -> "Interleaver | None":
+    return getattr(_tls, "run", None)
+
+
+def sched_point(label: str | None = None) -> None:
+    """A voluntary preemption point. No-op outside a managed logical
+    thread, so operations under test may call it unconditionally."""
+    run = _current_run()
+    if run is not None:
+        run._yield(label)
+
+
+class SchedLock:
+    """`threading.Lock` stand-in whose acquire is a scheduling point and
+    whose ownership feeds deadlock detection and order recording.
+    Constructed via `Interleaver.lock()` or transparently under
+    `instrumented_locks()`. From non-managed threads (test setup code) it
+    degrades to the plain underlying lock."""
+
+    _REENTRANT = False
+
+    def __init__(self, run: "Interleaver", name: str):
+        self.run = run
+        self.name = name
+        self._inner = _REAL_LOCK()
+        self._owner: _LogicalThread | None = None
+        self._count = 0
+
+    def rename(self, name: str) -> "SchedLock":
+        """Give the lock its contract label (e.g. 'journal') so order
+        edges line up with contracts.LOCK_ORDER."""
+        self.run.trace.append(("rename", self.name, name))
+        self.name = name
+        return self
+
+    # ------------------------------------------------------------ protocol
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        run = self.run
+        me = run._me()
+        if me is None:  # not a managed thread: plain semantics
+            return self._inner.acquire(blocking, timeout)
+        run._yield(f"acquire:{self.name}")  # preemption point BEFORE the op
+        if self._REENTRANT and self._owner is me:
+            self._count += 1
+            run.trace.append(("reacquire", me.name, self.name))
+            return True
+        while not self._inner.acquire(blocking=False):
+            if not blocking:
+                return False
+            me.waiting_on = self
+            run.trace.append(("block", me.name, self.name))
+            run._yield(f"blocked:{self.name}")
+        me.waiting_on = None
+        self._owner = me
+        self._count = 1
+        held = run._held.setdefault(me.name, [])
+        for outer in held:
+            if outer != self.name:
+                run.lock_edges.add((outer, self.name))
+        held.append(self.name)
+        run.trace.append(("acquire", me.name, self.name))
+        return True
+
+    def release(self) -> None:
+        run = self.run
+        me = run._me()
+        if me is None:
+            self._inner.release()
+            return
+        if self._REENTRANT and self._owner is me and self._count > 1:
+            self._count -= 1
+            run.trace.append(("rerelease", me.name, self.name))
+            return
+        self._owner = None
+        self._count = 0
+        held = run._held.get(me.name, [])
+        if self.name in held:
+            held.reverse()
+            held.remove(self.name)
+            held.reverse()
+        self._inner.release()
+        run.trace.append(("release", me.name, self.name))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class SchedRLock(SchedLock):
+    _REENTRANT = True
+
+
+class instrumented_locks:
+    """Context manager: while active, `threading.Lock()`/`threading.RLock()`
+    construct Sched(R)Locks registered with `run` (named lock0, lock1, ...
+    in creation order — deterministic). Construct the objects under test
+    inside the block; code that creates locks later (lazily) stays on real
+    locks and simply offers no scheduling points."""
+
+    def __init__(self, run: "Interleaver"):
+        self.run = run
+
+    def __enter__(self) -> "instrumented_locks":
+        self._lock, self._rlock = threading.Lock, threading.RLock
+        run = self.run
+
+        def make_lock() -> SchedLock:
+            return run.lock(f"lock{len(run.locks)}")
+
+        def make_rlock() -> SchedRLock:
+            return run.rlock(f"lock{len(run.locks)}")
+
+        threading.Lock = make_lock  # type: ignore[assignment]
+        threading.RLock = make_rlock  # type: ignore[assignment]
+        return self
+
+    def __exit__(self, *exc) -> None:
+        threading.Lock = self._lock  # type: ignore[assignment]
+        threading.RLock = self._rlock  # type: ignore[assignment]
+
+
+class Interleaver:
+    """One deterministic run over a set of logical threads.
+
+    >>> run = Interleaver(seed=7)
+    >>> with instrumented_locks(run):
+    ...     journal = RequestJournal(path)
+    >>> run.thread(lambda: journal.delivered("a", [1], 4), name="reader")
+    >>> run.thread(lambda: journal.progress(req), name="drain")
+    >>> run.run()
+
+    `run()` drives the schedule to completion and re-raises any logical-
+    thread exception as `InterleaveFailure` (carrying seed + decisions).
+    `trace` is the replayable event list; `run_fingerprint()` serializes
+    it for byte-identical-replay assertions.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        schedule: list[str] | None = None,
+        max_switches: int = 100_000,
+    ):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.schedule = list(schedule) if schedule else None
+        self.max_switches = max_switches
+        self.threads: dict[str, _LogicalThread] = {}
+        self.locks: list[SchedLock] = []
+        self.lock_edges: set[tuple[str, str]] = set()
+        self.trace: list[tuple] = []
+        self.choices: list[str] = []  # the decisions actually taken
+        self._held: dict[str, list[str]] = {}
+        self._started = False
+
+    # ------------------------------------------------------------ building
+
+    def lock(self, name: str) -> SchedLock:
+        lock = SchedLock(self, name)
+        self.locks.append(lock)
+        return lock
+
+    def rlock(self, name: str) -> SchedRLock:
+        lock = SchedRLock(self, name)
+        self.locks.append(lock)
+        return lock
+
+    def thread(self, fn, name: str | None = None) -> None:
+        name = name or f"t{len(self.threads)}"
+        if name in self.threads:
+            raise ValueError(f"duplicate logical thread name {name!r}")
+        self.threads[name] = _LogicalThread(name=name, fn=fn)
+
+    # ------------------------------------------------------------- running
+
+    def _me(self) -> _LogicalThread | None:
+        return getattr(_tls, "logical", None) if _current_run() is self else None
+
+    def _yield(self, label: str | None = None) -> None:
+        me = self._me()
+        if me is None:
+            return
+        if label is not None:
+            self.trace.append(("point", me.name, label))
+        me.parked.set()
+        me.go.wait()
+        me.go.clear()
+        if getattr(self, "_aborting", False):
+            raise _Abort()
+
+    def _bootstrap(self, logical: _LogicalThread) -> None:
+        _tls.run = self
+        _tls.logical = logical
+        logical.go.wait()
+        logical.go.clear()
+        try:
+            if not getattr(self, "_aborting", False):
+                logical.fn()
+        except _Abort:
+            pass
+        except BaseException as exc:  # noqa: BLE001 — surfaced by run()
+            logical.error = exc
+        finally:
+            logical.done = True
+            logical.parked.set()
+
+    def _runnable(self) -> list[_LogicalThread]:
+        out = []
+        for logical in self.threads.values():
+            if logical.done:
+                continue
+            waiting = logical.waiting_on
+            if waiting is not None and waiting._owner is not None:
+                continue
+            out.append(logical)
+        return out
+
+    def run(self) -> "Interleaver":
+        if self._started:
+            raise RuntimeError("an Interleaver runs once; build a fresh one")
+        self._started = True
+        self._aborting = False
+        for logical in self.threads.values():
+            logical.thread = threading.Thread(
+                target=self._bootstrap, args=(logical,),
+                name=f"interleave-{logical.name}", daemon=True,
+            )
+            logical.thread.start()
+        failure: InterleaveFailure | None = None
+        try:
+            switches = 0
+            while True:
+                live = [t for t in self.threads.values() if not t.done]
+                if not live:
+                    break
+                runnable = sorted(self._runnable(), key=lambda t: t.name)
+                if not runnable:
+                    waits = {
+                        t.name: t.waiting_on.name for t in live
+                        if t.waiting_on is not None
+                    }
+                    raise DeadlockError(
+                        f"deadlock under seed {self.seed}: every live "
+                        f"thread is parked on a lock ({waits}); replay "
+                        f"with Interleaver(schedule={self.choices!r})"
+                    )
+                chosen = self._pick(runnable)
+                self.choices.append(chosen.name)
+                self.trace.append(("run", chosen.name))
+                chosen.parked.clear()
+                chosen.go.set()
+                chosen.parked.wait()
+                if chosen.error is not None:
+                    # stop on first failure: the dead thread may have
+                    # unwound holding nothing, but survivors could now
+                    # block forever on state it half-mutated
+                    failure = InterleaveFailure(
+                        chosen.name, chosen.error, self
+                    )
+                    break
+                switches += 1
+                if switches > self.max_switches:
+                    raise RuntimeError(
+                        f"schedule exceeded {self.max_switches} switches "
+                        "(livelock in the code under test?)"
+                    )
+        finally:
+            self._abort_remaining()
+        if failure is not None:
+            raise failure
+        return self
+
+    def _pick(self, runnable: list[_LogicalThread]) -> _LogicalThread:
+        if self.schedule:
+            wanted = self.schedule.pop(0)
+            for logical in runnable:
+                if logical.name == wanted:
+                    return logical
+            # the named thread is done/parked: fall through to the rng so
+            # shrunk schedules stay total
+        return self.rng.choice(runnable)
+
+    def _abort_remaining(self) -> None:
+        self._aborting = True
+        for logical in self.threads.values():
+            if not logical.done:
+                logical.parked.clear()
+                logical.go.set()
+                logical.parked.wait(timeout=5.0)
+            if logical.thread is not None:
+                logical.thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------ queries
+
+    def run_fingerprint(self) -> str:
+        """Serialized trace for byte-identical replay assertions."""
+        return "\n".join(repr(event) for event in self.trace)
+
+    def assert_lock_order(self, declared: tuple[str, ...] | None = None) -> None:
+        """Recorded acquisition edges must be consistent with `declared`
+        (default: contracts.LOCK_ORDER) and acyclic among themselves."""
+        declared = declared if declared is not None else contracts.LOCK_ORDER
+        index = {name: i for i, name in enumerate(declared)}
+        for outer, inner in sorted(self.lock_edges):
+            if outer in index and inner in index and index[outer] > index[inner]:
+                raise LockOrderError(
+                    f"lock `{inner}` (order {index[inner]}) was acquired "
+                    f"while holding `{outer}` (order {index[outer]}) — "
+                    f"violates the declared order {declared}"
+                )
+        for a, b in sorted(self.lock_edges):
+            if (b, a) in self.lock_edges:
+                raise LockOrderError(
+                    f"cyclic acquisition recorded: `{a}` before `{b}` AND "
+                    f"`{b}` before `{a}` — deadlock potential"
+                )
+
+
+def find_failing_seed(build_and_run, seeds=range(64)) -> int | None:
+    """First seed in `seeds` for which `build_and_run(Interleaver)` raises
+    an AssertionError (InterleaveFailure/DeadlockError included), or None.
+    `build_and_run` receives a fresh Interleaver, registers threads, and
+    calls run()."""
+    for seed in seeds:
+        try:
+            build_and_run(Interleaver(seed=seed))
+        except AssertionError:
+            return seed
+    return None
+
+
+def shrink(build_and_run, seed: int, rounds: int = 200) -> list[str]:
+    """Minimize the failing schedule for `seed`: record its decision list,
+    then greedily drop one decision at a time (the scheduler re-fills from
+    the rng, usually extending the previous thread's run), keeping each
+    deletion only while the failure reproduces. Returns the minimal
+    decision list — commit it in a regression test via
+    `Interleaver(seed=<seed>, schedule=<result>)`."""
+
+    def fails(schedule: list[str] | None) -> list[str] | None:
+        run = Interleaver(seed=seed, schedule=list(schedule) if schedule else None)
+        try:
+            build_and_run(run)
+        except AssertionError:
+            return list(run.choices)
+        return None
+
+    best = fails(None)
+    if best is None:
+        raise ValueError(f"seed {seed} does not fail; nothing to shrink")
+    attempts = 0
+    i = 0
+    while i < len(best) and attempts < rounds:
+        candidate = best[:i] + best[i + 1:]
+        attempts += 1
+        result = fails(candidate)
+        if result is not None and len(result) <= len(best):
+            best = result
+            i = 0  # a successful deletion may enable earlier ones
+        else:
+            i += 1
+    return best

@@ -1,0 +1,534 @@
+"""racecheck: the static half of the `--races` gate
+(docs/static-analysis.md#racecheck).
+
+Three rules over the thread model `threadmodel.py` builds per module:
+
+- **race-unguarded-shared** — an instance attribute or module global that
+  is *mutated* from one concurrency entry while another entry can also
+  reach it must carry a `# guarded by: <lock>` declaration, and every
+  mutation must hold that lock lexically. Also covers closure variables
+  shared with a nested `threading.Thread` target (the PR 12 stdin-reader
+  class). Findings name the attribute, the entries on both sides, and the
+  missing or violated lock.
+- **race-lock-order** — lock-acquisition edges (lexical `with` nesting
+  plus one level of same-class call propagation) that form a cycle:
+  deadlock potential. Reported only in modules that actually have a
+  concurrency entry — a single-threaded module cannot deadlock with
+  itself.
+- **race-signal-unsafe** — work reachable from a `signal.signal` handler
+  that is not safe in a handler context: lock acquisition (the handler
+  interrupting the lock's holder self-deadlocks), `print`/`open`/logging
+  (CPython raises on reentering a buffered stream — the exact failure
+  GracefulShutdown._handler documents), and jax calls. `os.write` is the
+  sanctioned alternative and is never flagged.
+
+Reads are deliberately not findings: CPython attribute loads are atomic
+under the GIL and the repo's benign single-reader patterns (chaos_point's
+global peek) are part of the documented design. The gate targets compound
+mutation — the class of bug a reviewer caught by hand in PR 12.
+
+Shares the engine's suppression (`# lint: allow(rule): reason`) and
+baseline machinery; the committed baseline is `config/race_baseline.json`
+and the goal is to keep it empty.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_training_tpu.analysis import contracts, threadmodel
+from llm_training_tpu.analysis.engine import Finding, RepoContext, RuleSpec
+from llm_training_tpu.analysis.astutils import root_name, terminal_name
+from llm_training_tpu.analysis.threadmodel import (
+    MAIN,
+    ClassModel,
+    ModuleModel,
+    build_module_model,
+    class_entry_map,
+)
+
+RACE_BASELINE = "config/race_baseline.json"
+
+_LOG_METHODS = ("debug", "info", "warning", "error", "exception", "critical", "log")
+
+
+def build_models(ctx: RepoContext) -> dict[str, ModuleModel]:
+    models: dict[str, ModuleModel] = {}
+    for parsed in ctx.files:
+        models[parsed.path] = build_module_model(parsed)
+    return models
+
+
+# ------------------------------------------------- rule: race-unguarded-shared
+
+
+def _entry_pair(writers: set, accessors: set) -> tuple[str, str] | None:
+    """A (writing entry, other accessing entry) witness pair, or None when
+    the state is effectively single-entry."""
+    for writer in sorted(writers):
+        for accessor in sorted(accessors):
+            if accessor != writer:
+                return writer, accessor
+    return None
+
+
+def _shared_class_findings(model: ModuleModel, cls: ClassModel) -> list[Finding]:
+    findings: list[Finding] = []
+    if not threadmodel.concurrent_entries(cls):
+        return findings
+    reach = class_entry_map(cls)
+    by_attr: dict[str, list] = {}
+    for access in cls.accesses:
+        by_attr.setdefault(access.attr, []).append(access)
+    declared_contract = contracts.THREAD_SHARED_CONTRACTS.get(
+        model.parsed.path, {}
+    ).get(cls.name)
+    for attr, accesses in sorted(by_attr.items()):
+        if attr in cls.locks or attr in cls.threadsafe_attrs:
+            continue
+        writers = {
+            e for a in accesses if a.write for e in reach.get(a.method, ())
+        }
+        accessors = {e for a in accesses for e in reach.get(a.method, ())}
+        pair = _entry_pair(writers, accessors)
+        if pair is None:
+            continue
+        label = f"{cls.name}.{attr}"
+        guard = cls.guards.get(attr)
+        why = f" — {declared_contract}" if declared_contract else ""
+        if guard is None:
+            findings.append(Finding(
+                rule=RULE_SHARED.name,
+                path=model.parsed.path,
+                line=cls.init_lines.get(
+                    attr, min(a.line for a in accesses)
+                ),
+                message=(
+                    f"shared mutable state `{label}` is written from "
+                    f"entry `{pair[0]}` and reachable from entry "
+                    f"`{pair[1]}` with no declared guard{why}; declare "
+                    f"`# guarded by: <lock>` on its __init__ assignment "
+                    "and hold that lock at every mutation"
+                ),
+            ))
+            continue
+        if guard not in cls.locks and guard not in model.module_locks:
+            findings.append(Finding(
+                rule=RULE_SHARED.name,
+                path=model.parsed.path,
+                line=cls.init_lines.get(attr, accesses[0].line),
+                message=(
+                    f"`{label}` declares guard `{guard}`, but `{guard}` "
+                    "is not a Lock/RLock this class (or module) constructs"
+                ),
+            ))
+            continue
+        for access in accesses:
+            if access.write and guard not in access.held:
+                findings.append(Finding(
+                    rule=RULE_SHARED.name,
+                    path=model.parsed.path,
+                    line=access.line,
+                    message=(
+                        f"mutation of `{label}` in `{access.method}` "
+                        f"without holding its declared guard `{guard}` "
+                        f"(shared between `{pair[0]}` and `{pair[1]}`"
+                        f"{why})"
+                    ),
+                ))
+    return findings
+
+
+def _shared_global_findings(model: ModuleModel) -> list[Finding]:
+    findings: list[Finding] = []
+    if not model.entries:
+        return findings
+    # entry label -> reachable module functions (bare-name call closure)
+    reach: dict[str, set] = {name: {MAIN} for name in model.functions}
+    for label, root in model.entries.items():
+        seen, stack = set(), [root]
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in model.functions:
+                continue
+            seen.add(name)
+            stack.extend(model.functions[name].calls)
+        for name in seen:
+            reach[name].add(label)
+    by_global: dict[str, list] = {}
+    for fn in model.functions.values():
+        for access in fn.accesses:
+            by_global.setdefault(access.attr, []).append(access)
+    declared = contracts.THREAD_SHARED_CONTRACTS.get(model.parsed.path, {})
+    for name, accesses in sorted(by_global.items()):
+        if name in model.module_locks:
+            continue
+        writers = {
+            e for a in accesses if a.write for e in reach.get(a.method, ())
+        }
+        accessors = {e for a in accesses for e in reach.get(a.method, ())}
+        pair = _entry_pair(writers, accessors)
+        if pair is None:
+            continue
+        guard = threadmodel._guard_for_line(
+            model.guards, model.module_globals.get(name, 0)
+        )
+        why = ""
+        for declared_name, reason in declared.items():
+            if declared_name in (a.method for a in accesses):
+                why = f" — {reason}"
+                break
+        if guard is None:
+            findings.append(Finding(
+                rule=RULE_SHARED.name,
+                path=model.parsed.path,
+                line=model.module_globals.get(name, accesses[0].line),
+                message=(
+                    f"module global `{name}` is written from entry "
+                    f"`{pair[0]}` and reachable from entry `{pair[1]}` "
+                    f"with no declared guard{why}; declare "
+                    "`# guarded by: <lock>` on its module-level assignment"
+                ),
+            ))
+            continue
+        if guard not in model.module_locks:
+            findings.append(Finding(
+                rule=RULE_SHARED.name,
+                path=model.parsed.path,
+                line=model.module_globals.get(name, accesses[0].line),
+                message=(
+                    f"module global `{name}` declares guard `{guard}`, "
+                    "but no module-level Lock/RLock of that name exists"
+                ),
+            ))
+            continue
+        for access in accesses:
+            if access.write and guard not in access.held:
+                findings.append(Finding(
+                    rule=RULE_SHARED.name,
+                    path=model.parsed.path,
+                    line=access.line,
+                    message=(
+                        f"mutation of module global `{name}` in "
+                        f"`{access.method}` without holding its declared "
+                        f"guard `{guard}` (shared between `{pair[0]}` and "
+                        f"`{pair[1]}`{why})"
+                    ),
+                ))
+    return findings
+
+
+def _closure_findings(model: ModuleModel) -> list[Finding]:
+    """Nested thread targets: closure variables the target mutates while
+    its enclosing function's other code also touches them — the stdin-
+    reader shape. Only `nonlocal` rebinds and in-place mutator calls on
+    enclosing-scope names count; queue/Event handoffs are exempt."""
+    findings: list[Finding] = []
+    for kind, call, target, _cls, fn_stack in model.spawns:
+        if kind != "thread" or not isinstance(target, ast.Name) or not fn_stack:
+            continue
+        enclosing = fn_stack[-1]
+        target_def = None
+        for node in ast.walk(enclosing):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == target.id
+            ):
+                target_def = node
+                break
+        if target_def is None:
+            continue
+        # names bound to thread-safe constructors in the enclosing scope
+        safe: set[str] = set()
+        lock_names: set[str] = set()
+        for node in ast.walk(enclosing):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                ctor = terminal_name(node.value.func)
+                for assign_target in node.targets:
+                    if isinstance(assign_target, ast.Name):
+                        if ctor in threadmodel.THREADSAFE_CTORS:
+                            safe.add(assign_target.id)
+                        if ctor in threadmodel.LOCK_CTORS:
+                            lock_names.add(assign_target.id)
+
+        def writes_in(fn: ast.AST, *, skip: ast.AST | None, free_only: bool) -> dict:
+            """name -> line of in-place mutations and rebinds. With
+            `free_only` (the nested target), a plain store counts only
+            when declared `nonlocal` and a mutator call only on names the
+            function does not bind itself — i.e. writes that reach
+            through the closure. For the enclosing function every write
+            counts: its locals ARE the shared cells."""
+            stores: dict[str, int] = {}
+            mutators: dict[str, int] = {}
+            nonlocals: set[str] = set()
+            stack = list(ast.iter_child_nodes(fn))
+            while stack:
+                node = stack.pop()
+                if node is skip:
+                    continue
+                if isinstance(node, ast.Nonlocal):
+                    nonlocals.update(node.names)
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if (
+                        node.func.attr in threadmodel.MUTATING_METHODS
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id not in safe
+                    ):
+                        mutators.setdefault(node.func.value.id, node.lineno)
+                elif isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    stores.setdefault(node.id, node.lineno)
+                stack.extend(ast.iter_child_nodes(node))
+            if free_only:
+                local_stores = set(stores) - nonlocals
+                out = {
+                    name: line for name, line in mutators.items()
+                    if name not in local_stores
+                }
+                out.update({
+                    name: line for name, line in stores.items()
+                    if name in nonlocals
+                })
+                return out
+            return {**stores, **mutators}
+
+        thread_writes = writes_in(target_def, skip=None, free_only=True)
+        sibling_writes = writes_in(enclosing, skip=target_def, free_only=False)
+        for name in sorted(set(thread_writes) & set(sibling_writes)):
+            if name in safe or name in lock_names:
+                continue
+            findings.append(Finding(
+                rule=RULE_SHARED.name,
+                path=model.parsed.path,
+                line=thread_writes[name],
+                message=(
+                    f"closure variable `{name}` is mutated by thread "
+                    f"target `{target.id}` and by its enclosing function "
+                    f"`{enclosing.name}` (entries `thread:{target.id}` "
+                    f"and `main`) with no guard; route the handoff "
+                    "through a queue.Queue or guard both sides with one "
+                    "lock"
+                ),
+            ))
+    return findings
+
+
+# ------------------------------------------------- rule: race-lock-order
+
+
+def _lock_order_findings(model: ModuleModel) -> list[Finding]:
+    has_entry = bool(model.entries) or any(
+        threadmodel.concurrent_entries(cls) for cls in model.classes.values()
+    )
+    if not has_entry:
+        return []
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for outer, inner, fn_name, line in model.lock_edges:
+        edges.setdefault((outer, inner), (fn_name, line))
+    # one level of same-class call propagation: holding L while calling a
+    # method whose closure acquires M adds L -> M
+    for cls in model.classes.values():
+        for caller, callee, held in cls.held_calls:
+            for inner in sorted(cls.transitive_acquires(callee)):
+                for outer in sorted(held):
+                    if inner != outer:
+                        edges.setdefault(
+                            (outer, inner),
+                            (f"{caller}->{callee}", cls.methods[caller].lineno),
+                        )
+    findings = []
+    for (a, b), (fn_ab, line) in sorted(edges.items()):
+        if (b, a) in edges and a < b:  # report each inversion pair once
+            fn_ba, _ = edges[(b, a)]
+            findings.append(Finding(
+                rule=RULE_ORDER.name,
+                path=model.parsed.path,
+                line=line,
+                message=(
+                    f"lock-order inversion: `{a}` is acquired before "
+                    f"`{b}` in `{fn_ab}` but after it in `{fn_ba}` — "
+                    "two threads interleaving these paths deadlock; pick "
+                    "one order (contracts.LOCK_ORDER) and stick to it"
+                ),
+            ))
+    return findings
+
+
+# ----------------------------------------------- rule: race-signal-unsafe
+
+
+def _signal_unsafe_in(model: ModuleModel, fn_node: ast.AST, cls: ClassModel | None):
+    """(line, what) for every non-async-signal-safe operation lexically in
+    `fn_node` (no descent into nested defs)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            roots = [
+                alias.name.split(".")[0] for alias in node.names
+            ] if isinstance(node, ast.Import) else [
+                (node.module or "").split(".")[0]
+            ]
+            if any(r in ("jax", "jaxlib") for r in roots):
+                yield node.lineno, "a jax import"
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                label = None
+                if cls is not None and isinstance(expr, ast.Attribute):
+                    if (
+                        isinstance(expr.value, ast.Name)
+                        and expr.value.id == "self"
+                        and expr.attr in cls.locks
+                    ):
+                        label = expr.attr
+                if isinstance(expr, ast.Name) and expr.id in model.module_locks:
+                    label = expr.id
+                if label is not None:
+                    yield node.lineno, f"acquisition of lock `{label}`"
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = terminal_name(fn)
+            root = root_name(fn)
+            if isinstance(fn, ast.Name) and fn.id == "print":
+                yield node.lineno, "print() (buffered-stream reentrancy)"
+            elif isinstance(fn, ast.Name) and fn.id == "open":
+                yield node.lineno, "open() (file I/O)"
+            elif (
+                isinstance(fn, ast.Attribute)
+                and name in _LOG_METHODS
+                and root is not None
+                and "log" in root.lower()
+            ):
+                yield node.lineno, (
+                    f"logging via `{root}.{name}` (buffered-stream "
+                    "reentrancy — the exact in-handler failure "
+                    "GracefulShutdown documents)"
+                )
+            elif name == "acquire" and isinstance(fn, ast.Attribute):
+                yield node.lineno, "an explicit lock .acquire()"
+            elif root in model.jax_aliases:
+                yield node.lineno, f"a jax call (`{root}`)"
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _signal_findings(model: ModuleModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for cls_name, handler in model.signal_handlers:
+        if cls_name is not None:
+            cls = model.classes.get(cls_name)
+            if cls is None or handler not in cls.methods:
+                continue
+            reached = [
+                (cls.methods[m], cls, m) for m in sorted(cls.reach(handler))
+            ]
+            # bare-name module functions the handler's closure calls
+            for method in sorted(cls.reach(handler)):
+                for bare in sorted(cls.raw_calls.get(method, ())):
+                    if bare in model.functions:
+                        reached.append(
+                            (model.functions[bare].node, None, bare)
+                        )
+            label = f"{cls_name}.{handler}"
+        else:
+            fn = model.functions.get(handler)
+            if fn is None:
+                continue
+            seen, stack = set(), [handler]
+            reached = []
+            while stack:
+                name = stack.pop()
+                if name in seen or name not in model.functions:
+                    continue
+                seen.add(name)
+                reached.append((model.functions[name].node, None, name))
+                stack.extend(model.functions[name].calls)
+            label = handler
+        for fn_node, fn_cls, fn_name in reached:
+            for line, what in _signal_unsafe_in(model, fn_node, fn_cls):
+                findings.append(Finding(
+                    rule=RULE_SIGNAL.name,
+                    path=model.parsed.path,
+                    line=line,
+                    message=(
+                        f"signal handler `{label}` reaches {what} in "
+                        f"`{fn_name}` — handlers run on whatever frame "
+                        "the signal interrupted; set a flag and do the "
+                        "work at a step boundary (os.write is the safe "
+                        "alternative)"
+                    ),
+                ))
+    return findings
+
+
+# ------------------------------------------------------------------ rules
+
+
+def _models_cached(ctx: RepoContext) -> dict[str, ModuleModel]:
+    cache = getattr(ctx, "_race_models", None)
+    if cache is None:
+        cache = build_models(ctx)
+        ctx._race_models = cache
+    return cache
+
+
+def _run_shared(ctx: RepoContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for model in _models_cached(ctx).values():
+        for cls in model.classes.values():
+            findings.extend(_shared_class_findings(model, cls))
+        findings.extend(_shared_global_findings(model))
+        findings.extend(_closure_findings(model))
+    return findings
+
+
+def _run_order(ctx: RepoContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for model in _models_cached(ctx).values():
+        findings.extend(_lock_order_findings(model))
+    return findings
+
+
+def _run_signal(ctx: RepoContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for model in _models_cached(ctx).values():
+        findings.extend(_signal_findings(model))
+    return findings
+
+
+RULE_SHARED = RuleSpec(
+    name="race-unguarded-shared",
+    description=(
+        "state mutated from one thread entry while another can reach it "
+        "must declare `# guarded by: <lock>` and hold that lock at every "
+        "mutation"
+    ),
+    run=_run_shared,
+)
+
+RULE_ORDER = RuleSpec(
+    name="race-lock-order",
+    description=(
+        "lock acquisition order must be acyclic across all code paths "
+        "(deadlock potential)"
+    ),
+    run=_run_order,
+)
+
+RULE_SIGNAL = RuleSpec(
+    name="race-signal-unsafe",
+    description=(
+        "signal handlers must not acquire locks, touch buffered streams "
+        "(print/open/logging), or call jax"
+    ),
+    run=_run_signal,
+)
+
+
+def race_rules() -> list[RuleSpec]:
+    return [RULE_SHARED, RULE_ORDER, RULE_SIGNAL]

@@ -1,0 +1,119 @@
+"""Rule `thread-jax-free`: thread targets and signal handlers stay off jax.
+
+The host layer's threads exist to stay responsive while the main thread
+owns the device (contracts.THREAD_JAX_FREE_WHY): a watchdog poll that
+calls into jax can block behind the exact wedged dispatch it is supposed
+to diagnose and SIGABRT; a stdin-reader or journal thread that triggers
+compilation stalls intake for seconds; a signal handler that touches the
+backend re-enters it mid-dispatch.
+
+Mechanics: every `threading.Thread(target=...)` / `threading.Timer` /
+`signal.signal` registration found by `threadmodel` seeds a walk over the
+same conservative cross-module call graph the host-sync rule uses
+(`host_sync._Graph`). Any reachable function that uses a jax-rooted name
+(`jax`, `jnp`, `from jax import ...` aliases) or lazily `import jax`s in
+its body is reported with the entry that reaches it. The one sanctioned
+exception — the DevicePrefetcher worker, whose whole job is overlapping
+`jax.device_put` with the step — carries an inline suppression with that
+reason; new exceptions should be equally deliberate.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from llm_training_tpu.analysis import contracts
+from llm_training_tpu.analysis.astutils import root_name
+from llm_training_tpu.analysis.engine import Finding, RepoContext, RuleSpec
+from llm_training_tpu.analysis.host_sync import (
+    _callees,
+    _Graph,
+    _Module,
+    _own_nodes,
+)
+from llm_training_tpu.analysis.threadmodel import _collect_spawns
+
+_JAX_ROOTS = ("jax", "jaxlib")
+
+
+def _jax_aliases(mod: _Module) -> set[str]:
+    """Local names bound to jax/jaxlib (module-level or anywhere)."""
+    aliases = set()
+    for local, target in mod.imports.items():
+        root = (target[1] if target[0] in ("module", "symbol") else "").split(".")[0]
+        if root in _JAX_ROOTS:
+            aliases.add(local)
+    return aliases
+
+
+def _violations(mod: _Module, fn: ast.AST, aliases: set[str]):
+    fn_name = getattr(fn, "name", "<lambda>")
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] in _JAX_ROOTS:
+                    yield node.lineno, fn_name, f"import {alias.name}"
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0 and (node.module or "").split(".")[0] in _JAX_ROOTS:
+                yield node.lineno, fn_name, f"from {node.module} import ..."
+        elif isinstance(node, ast.Call):
+            root = root_name(node.func)
+            if root in aliases:
+                yield node.lineno, fn_name, f"a `{root}.*` call"
+
+
+def _entries(graph: _Graph) -> list:
+    """(module, fn node, entry label) for every thread/timer target and
+    signal handler resolvable in the scan set."""
+    out = []
+    # snapshot: resolve_callables may lazily add out-of-scan modules
+    for mod in list(graph.modules.values()):
+        for kind, call, target, _cls, _fns in _collect_spawns(mod.parsed.tree):
+            for tmod, tfn in graph.resolve_callables(mod, target, call):
+                label = f"{kind}:{getattr(tfn, 'name', '<lambda>')}"
+                out.append((tmod, tfn, label, mod.parsed.path))
+    return out
+
+
+def _run(ctx: RepoContext) -> list[Finding]:
+    graph = _Graph(ctx)
+    findings: dict[tuple, Finding] = {}
+    for entry_mod, entry_fn, entry_label, spawn_path in _entries(graph):
+        seen: set[tuple[str, int]] = set()
+        worklist = [(entry_mod, entry_fn)]
+        while worklist:
+            mod, fn = worklist.pop()
+            key = (mod.parsed.path, id(fn))
+            if key in seen:
+                continue
+            seen.add(key)
+            aliases = _jax_aliases(mod)
+            for line, fn_name, what in _violations(mod, fn, aliases):
+                fkey = (mod.parsed.path, line, entry_label)
+                if fkey not in findings:
+                    findings[fkey] = Finding(
+                        rule=RULE.name,
+                        path=mod.parsed.path,
+                        line=line,
+                        message=(
+                            f"`{fn_name}` is reachable from `{entry_label}` "
+                            f"(spawned in {spawn_path}) but does {what} — "
+                            f"{contracts.THREAD_JAX_FREE_WHY}; move the "
+                            "device work to the main loop, or suppress "
+                            "with a reason if this thread IS the "
+                            "sanctioned device-work thread"
+                        ),
+                    )
+            # host-sync's conservative callee resolution, reused
+            worklist.extend(_callees(graph, mod, fn))
+    return list(findings.values())
+
+
+RULE = RuleSpec(
+    name="thread-jax-free",
+    description=(
+        "threading.Thread targets, Timer callbacks, and signal handlers "
+        "must not reach jax (transitively through the call graph)"
+    ),
+    run=_run,
+)

@@ -1,0 +1,637 @@
+"""Thread-model construction for racecheck (docs/static-analysis.md#racecheck).
+
+The host layer is genuinely concurrent — stdin reader, HangWatchdog,
+DevicePrefetcher, journal/trace/registry writers — and the only structural
+record of who may touch what was comments. This module turns the AST into
+an explicit model:
+
+- **entries**: every way control enters the module concurrently — the main
+  thread, each `threading.Thread(target=...)` site, each
+  `signal.signal(sig, handler)` registration, plus the *declared* foreign-
+  thread surfaces in `contracts.THREAD_SHARED_CONTRACTS` (classes like the
+  telemetry registry whose docstring contract is "any thread may call");
+- **accesses**: every read/mutation of instance attributes and module
+  globals, annotated with which locks were lexically held (`with
+  self._lock:` / `with _module_lock:`) at the site;
+- **guards**: the `# guarded by: <lock-attr>` comment registry — on an
+  attribute's `__init__` assignment it declares the attribute's guard, on
+  a `def` line it declares a caller-holds-the-lock contract for the whole
+  method body (the `RequestJournal._append` pattern).
+
+`racecheck.py` turns the model into findings. Everything here is pure AST
+(jax-free, like the rest of the lint package) and deliberately
+under-approximate: lexical `with` blocks are the only recognized way to
+hold a lock, and call resolution never leaves the module — so a hit is
+worth reading, and silence is not a proof.
+
+Known limits (documented, not bugs): `.acquire()`/`.release()` pairs are
+invisible to held-lock tracking, cross-module thread attribution goes
+through the declared contract table, and CPython signal handlers run on
+the main thread between bytecodes — so signal entries are *excluded* from
+the lock-guard analysis (a lock cannot fix reentrancy and taking one in a
+handler is itself the deadlock; the signal-safety rule owns handlers).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from llm_training_tpu.analysis import contracts
+from llm_training_tpu.analysis.astutils import root_name, terminal_name
+from llm_training_tpu.analysis.engine import ParsedFile
+
+# `# guarded by: _lock` — the declaration registry. Only real COMMENT
+# tokens are scanned (like the lint suppressions), so the phrase may sit
+# anywhere in the comment: `# re-armed by the next beat; guarded by: _lock`
+GUARD_RE = re.compile(r"guarded by:\s*([A-Za-z_]\w*)")
+
+# method calls that mutate their receiver in place; attribute rebinds,
+# augmented assigns, subscript stores and `del` are handled structurally
+MUTATING_METHODS = frozenset({
+    "append", "appendleft", "add", "update", "remove", "discard", "pop",
+    "popleft", "popitem", "clear", "extend", "insert", "setdefault",
+    "sort", "reverse",
+})
+
+# constructors whose instances are internally synchronized (or are the
+# synchronization): attributes initialized from these are exempt from the
+# shared-mutation analysis
+THREADSAFE_CTORS = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue", "Event",
+    "Condition", "Semaphore", "BoundedSemaphore", "Barrier", "local",
+    "Lock", "RLock", "getLogger",
+})
+LOCK_CTORS = frozenset({"Lock", "RLock"})
+
+MAIN = "main"
+
+
+def parse_guards(source: str) -> dict[int, str]:
+    """line -> declared lock name, from real `# guarded by:` comments."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = GUARD_RE.search(tok.string)
+            if match:
+                out[tok.start[0]] = match.group(1)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _guard_for_line(guards: dict[int, str], line: int) -> str | None:
+    """A declaration counts on the flagged line or the line above, like
+    lint suppressions."""
+    for candidate in (line, line - 1):
+        if candidate in guards:
+            return guards[candidate]
+    return None
+
+
+@dataclass(frozen=True)
+class Access:
+    attr: str
+    method: str  # "" for module body
+    line: int
+    write: bool
+    held: frozenset
+
+
+@dataclass
+class ClassModel:
+    name: str
+    node: ast.ClassDef
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    locks: set = field(default_factory=set)  # self-attr lock names
+    guards: dict = field(default_factory=dict)  # attr -> declared lock name
+    method_guards: dict = field(default_factory=dict)  # method -> held lock
+    accesses: list = field(default_factory=list)
+    init_lines: dict = field(default_factory=dict)  # attr -> decl line
+    threadsafe_attrs: set = field(default_factory=set)
+    calls: dict = field(default_factory=dict)  # method -> {callee methods}
+    raw_calls: dict = field(default_factory=dict)  # method -> {bare names}
+    acquires: dict = field(default_factory=dict)  # method -> {lock labels}
+    # (method, callee method, frozenset of held lock labels) — call sites
+    # made while holding a lock, for cross-procedure lock-order edges
+    held_calls: list = field(default_factory=list)
+    # entry label -> root method name ("" for declared whole-class entries)
+    entries: dict = field(default_factory=dict)
+
+    def reach(self, root: str) -> set:
+        seen, stack = set(), [root]
+        while stack:
+            name = stack.pop()
+            if name in seen or name not in self.methods:
+                continue
+            seen.add(name)
+            stack.extend(self.calls.get(name, ()))
+        return seen
+
+    def transitive_acquires(self, root: str) -> set:
+        out = set()
+        for name in self.reach(root):
+            out |= self.acquires.get(name, set())
+        return out
+
+    def main_roots(self) -> list:
+        """Methods the main thread may call from outside: the public
+        surface plus dunders (minus constructors)."""
+        return [
+            name for name in self.methods
+            if not name.startswith("_")
+            or (name.startswith("__") and name.endswith("__")
+                and name not in ("__init__", "__new__", "__del__"))
+        ]
+
+
+@dataclass
+class FunctionModel:
+    """Module-level (or nested thread-target) function: its module-global
+    accesses and lock-order edges."""
+
+    name: str
+    node: ast.AST
+    accesses: list = field(default_factory=list)
+    calls: set = field(default_factory=set)  # bare-name callees
+
+
+@dataclass
+class ModuleModel:
+    parsed: ParsedFile
+    guards: dict = field(default_factory=dict)  # line -> lock name
+    classes: dict = field(default_factory=dict)  # name -> ClassModel
+    module_locks: set = field(default_factory=set)
+    module_globals: dict = field(default_factory=dict)  # name -> decl line
+    functions: dict = field(default_factory=dict)  # name -> FunctionModel
+    # module-function entries: label -> function name
+    entries: dict = field(default_factory=dict)
+    signal_handlers: list = field(default_factory=list)  # (class|None, name)
+    # (kind, call node, target expr, class name|None, [enclosing FunctionDefs])
+    spawns: list = field(default_factory=list)
+    # (outer label, inner label, method-or-fn name, line) lock-order edges
+    lock_edges: set = field(default_factory=set)
+    # names bound to jax/jaxlib roots at module level (for thread-jax-free)
+    jax_aliases: set = field(default_factory=set)
+
+
+# --------------------------------------------------------------- discovery
+
+
+def _target_of(call: ast.Call) -> ast.AST | None:
+    """The entry callable of a Thread/Timer construction or signal.signal
+    registration, or None."""
+    fn = terminal_name(call.func)
+    if fn == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return kw.value
+        return None
+    if fn == "Timer":
+        if len(call.args) >= 2:
+            return call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "function":
+                return kw.value
+        return None
+    return None
+
+
+def _is_signal_registration(call: ast.Call) -> bool:
+    return (
+        terminal_name(call.func) == "signal"
+        and root_name(call.func) == "signal"
+        and len(call.args) >= 2
+    )
+
+
+# ------------------------------------------------------------------ walker
+
+
+class _BodyWalker:
+    """One pass over a function body: attribute/global accesses with the
+    lexically held lock set, self-call edges, lock acquisitions."""
+
+    def __init__(self, model: ModuleModel, cls: ClassModel | None, fn_name: str):
+        self.model = model
+        self.cls = cls
+        self.fn_name = fn_name
+        self.accesses: list[Access] = []
+        self.calls: set[str] = set()
+        self.self_calls: set[str] = set()
+        self.held_calls: list[tuple[str, frozenset]] = []
+        self.acquired: set[str] = set()
+        self.global_decls: set[str] = set()
+        self.local_names: set[str] = set()
+
+    # -- lock labels ------------------------------------------------------
+
+    def _lock_label(self, expr: ast.AST) -> str | None:
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+            and self.cls is not None
+            and expr.attr in self.cls.locks
+        ):
+            return f"{self.cls.name}.{expr.attr}"
+        if isinstance(expr, ast.Name) and expr.id in self.model.module_locks:
+            return expr.id
+        return None
+
+    def _held_names(self, held: frozenset) -> frozenset:
+        """Lock labels -> bare attr/global names (guard declarations use
+        the bare name)."""
+        return frozenset(label.rsplit(".", 1)[-1] for label in held)
+
+    # -- recording --------------------------------------------------------
+
+    def _record(self, attr: str, line: int, write: bool, held: frozenset) -> None:
+        self.accesses.append(
+            Access(attr=attr, method=self.fn_name, line=line,
+                   write=write, held=self._held_names(held))
+        )
+
+    def _self_attr(self, node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node.attr
+        return None
+
+    def _global_name(self, node: ast.AST) -> str | None:
+        if (
+            isinstance(node, ast.Name)
+            and node.id in self.model.module_globals
+            and node.id not in self.model.module_locks
+            and (node.id in self.global_decls or node.id not in self.local_names)
+        ):
+            return node.id
+        return None
+
+    def _record_target(self, target: ast.AST, held: frozenset) -> None:
+        """A store/del target: the attribute or global it mutates."""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for element in target.elts:
+                self._record_target(element, held)
+            return
+        if isinstance(target, ast.Starred):
+            self._record_target(target.value, held)
+            return
+        attr = self._self_attr(target)
+        if attr is not None:
+            self._record(attr, target.lineno, True, held)
+            return
+        if isinstance(target, ast.Subscript):
+            base = target.value
+            attr = self._self_attr(base)
+            if attr is not None:
+                self._record(attr, target.lineno, True, held)
+            else:
+                name = self._global_name(base)
+                if name is not None:
+                    self._record(name, target.lineno, True, held)
+            self.walk(target.slice, held)
+            return
+        if isinstance(target, ast.Name):
+            if target.id in self.global_decls:
+                self._record(target.id, target.lineno, True, held)
+        elif isinstance(target, ast.Attribute):
+            # self.x.y = v mutates x's referent
+            attr = self._self_attr(target.value)
+            if attr is not None:
+                self._record(attr, target.lineno, True, held)
+
+    # -- the walk ---------------------------------------------------------
+
+    def walk(self, node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested defs are separate entries, walked separately
+        if isinstance(node, ast.Global):
+            self.global_decls.update(node.names)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held
+            for item in node.items:
+                label = self._lock_label(item.context_expr)
+                if label is not None:
+                    if label not in held:
+                        for outer in sorted(held):
+                            if outer != label:
+                                self.model.lock_edges.add(
+                                    (outer, label, self.fn_name, node.lineno)
+                                )
+                        self.acquired.add(label)
+                    inner = inner | {label}
+                else:
+                    self.walk(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self._record_target(item.optional_vars, held)
+            for child in node.body:
+                self.walk(child, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for target in targets:
+                self._record_target(target, held)
+            if node.value is not None:
+                self.walk(node.value, held)
+            # locals bookkeeping for global shadow detection
+            for target in targets:
+                if isinstance(target, ast.Name) and target.id not in self.global_decls:
+                    self.local_names.add(target.id)
+            return
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                self._record_target(target, held)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute):
+                receiver = fn.value
+                if fn.attr in MUTATING_METHODS:
+                    attr = self._self_attr(receiver)
+                    if attr is not None:
+                        self._record(attr, node.lineno, True, held)
+                    else:
+                        name = self._global_name(receiver)
+                        if name is not None:
+                            self._record(name, node.lineno, True, held)
+                if isinstance(receiver, ast.Name) and receiver.id == "self":
+                    self.self_calls.add(fn.attr)
+                    if held:
+                        self.held_calls.append((fn.attr, held))
+            elif isinstance(fn, ast.Name):
+                self.calls.add(fn.id)
+            for child in ast.iter_child_nodes(node):
+                self.walk(child, held)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = self._self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                self._record(attr, node.lineno, False, held)
+            for child in ast.iter_child_nodes(node):
+                self.walk(child, held)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load):
+                name = self._global_name(node)
+                if name is not None:
+                    self._record(name, node.lineno, False, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.walk(child, held)
+
+
+# ------------------------------------------------------------------ build
+
+
+def _class_of(node_stack: list, call: ast.Call) -> str | None:
+    for enclosing in reversed(node_stack):
+        if isinstance(enclosing, ast.ClassDef):
+            return enclosing.name
+    return None
+
+
+def _collect_spawns(tree: ast.Module) -> list:
+    """(kind, call node, target expr, enclosing-class-name, [enclosing
+    FunctionDefs outermost-first]) for every Thread/Timer construction and
+    signal registration, with lexical attribution."""
+    spawns = []
+
+    def _cls(stack: list) -> str | None:
+        for enclosing in reversed(stack):
+            if isinstance(enclosing, ast.ClassDef):
+                return enclosing.name
+        return None
+
+    def _fns(stack: list) -> list:
+        return [
+            n for n in stack
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+
+    def visit(node: ast.AST, stack: list) -> None:
+        if isinstance(node, ast.Call):
+            target = _target_of(node)
+            if target is not None:
+                spawns.append(("thread", node, target, _cls(stack), _fns(stack)))
+            elif _is_signal_registration(node):
+                spawns.append(
+                    ("signal", node, node.args[1], _cls(stack), _fns(stack))
+                )
+        stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+        stack.pop()
+
+    visit(tree, [])
+    return spawns
+
+
+def _lock_ctor(value: ast.AST | None) -> bool:
+    return (
+        isinstance(value, ast.Call)
+        and terminal_name(value.func) in LOCK_CTORS
+    )
+
+
+def _threadsafe_ctor(value: ast.AST) -> bool:
+    return (
+        isinstance(value, ast.Call)
+        and terminal_name(value.func) in THREADSAFE_CTORS
+    )
+
+
+def build_module_model(parsed: ParsedFile) -> ModuleModel:
+    model = ModuleModel(parsed=parsed, guards=parse_guards(parsed.source))
+    tree = parsed.tree
+
+    # module-level globals + locks + jax aliases
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            roots = []
+            if isinstance(stmt, ast.Import):
+                for alias in stmt.names:
+                    roots.append(
+                        (alias.asname or alias.name.split(".")[0],
+                         alias.name.split(".")[0])
+                    )
+            elif stmt.module is not None and stmt.level == 0:
+                for alias in stmt.names:
+                    roots.append(
+                        (alias.asname or alias.name, stmt.module.split(".")[0])
+                    )
+            for local, root in roots:
+                if root in ("jax", "jaxlib"):
+                    model.jax_aliases.add(local)
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [
+                t.id for t in stmt.targets if isinstance(t, ast.Name)
+            ]
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            targets, value = [stmt.target.id], stmt.value
+        else:
+            continue
+        for name in targets:
+            model.module_globals.setdefault(name, stmt.lineno)
+            if _lock_ctor(value):
+                model.module_locks.add(name)
+
+    # classes
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.ClassDef):
+            continue
+        cls = ClassModel(name=stmt.name, node=stmt)
+        for member in stmt.body:
+            if isinstance(member, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[member.name] = member
+                guard = _guard_for_line(model.guards, member.lineno)
+                if guard is not None:
+                    cls.method_guards[member.name] = guard
+        # lock attrs + guard declarations + threadsafe attrs: scan every
+        # `self.X = <ctor>` in the class (constructors usually, but a lock
+        # handed in as a parameter counts by NAME — the registry pattern
+        # `self._lock = lock`)
+        for member_name, member in cls.methods.items():
+            for node in ast.walk(member):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                for target in targets:
+                    if not (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        continue
+                    attr = target.attr
+                    if member_name in ("__init__", "__new__"):
+                        cls.init_lines.setdefault(attr, node.lineno)
+                        guard = _guard_for_line(model.guards, node.lineno)
+                        if guard is not None:
+                            cls.guards.setdefault(attr, guard)
+                        if value is not None and _threadsafe_ctor(value):
+                            cls.threadsafe_attrs.add(attr)
+                    # a lock is a Lock()/RLock() construction, or an
+                    # injected lock bound under a lock-NAMED attr (the
+                    # registry's `self._lock = lock`). Word-boundary
+                    # match only: `_blocks`/`_clock` must NOT classify
+                    # as locks, or their state silently leaves the
+                    # shared-mutation analysis
+                    if _lock_ctor(value) or (
+                        (attr == "lock" or attr.endswith("_lock"))
+                        and isinstance(value, ast.Name)
+                    ):
+                        cls.locks.add(attr)
+        model.classes[stmt.name] = cls
+
+    # per-method walks (need locks resolved first)
+    for cls in model.classes.values():
+        for name, method in cls.methods.items():
+            walker = _BodyWalker(model, cls, name)
+            initial = frozenset()
+            guard = cls.method_guards.get(name)
+            if guard is not None:
+                initial = frozenset({f"{cls.name}.{guard}"})
+            for child in method.body:
+                walker.walk(child, initial)
+            cls.calls[name] = walker.self_calls & set(cls.methods)
+            cls.raw_calls[name] = walker.calls
+            cls.acquires[name] = walker.acquired
+            for callee, held in walker.held_calls:
+                if callee in cls.methods:
+                    cls.held_calls.append((name, callee, held))
+            if name not in ("__init__", "__new__"):
+                cls.accesses.extend(walker.accesses)
+
+    # module functions
+    for stmt in tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walker = _BodyWalker(model, None, stmt.name)
+            for child in stmt.body:
+                walker.walk(child, frozenset())
+            fn = FunctionModel(name=stmt.name, node=stmt)
+            fn.accesses = walker.accesses
+            fn.calls = walker.calls
+            model.functions[stmt.name] = fn
+
+    # entries from spawn/registration sites
+    model.spawns = _collect_spawns(tree)
+    for kind, call, target, cls_name, fn_stack in model.spawns:
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and cls_name in model.classes
+        ):
+            cls = model.classes[cls_name]
+            if target.attr in cls.methods:
+                label = f"{kind}:{target.attr}"
+                if kind == "signal":
+                    model.signal_handlers.append((cls_name, target.attr))
+                cls.entries[label] = target.attr
+        elif isinstance(target, ast.Name):
+            if target.id in model.functions:
+                label = f"{kind}:{target.id}"
+                model.entries[label] = target.id
+                if kind == "signal":
+                    model.signal_handlers.append((None, target.id))
+            # nested thread targets (closures) are handled by racecheck's
+            # closure check directly from the spawn site
+
+    # declared foreign-thread surfaces (contracts)
+    declared = contracts.THREAD_SHARED_CONTRACTS.get(parsed.path, {})
+    for name in declared:
+        if name in model.classes:
+            model.classes[name].entries[f"xthread:{name}"] = ""
+        elif name in model.functions:
+            model.entries[f"xthread:{name}"] = name
+
+    return model
+
+
+# -------------------------------------------------------- shared analysis
+
+
+def class_entry_map(cls: ClassModel) -> dict:
+    """method name -> set of entry labels that reach it. `main` reaches the
+    public surface's closure; a declared `xthread:` entry reaches every
+    method; `signal:` entries are tracked separately (reentrancy, not
+    parallelism — see the module docstring)."""
+    reach: dict[str, set] = {name: set() for name in cls.methods}
+    for label, root in cls.entries.items():
+        if label.startswith("signal:"):
+            continue
+        targets = cls.reach(root) if root else set(cls.methods)
+        for name in targets:
+            reach.setdefault(name, set()).add(label)
+    main_reachable: set = set()
+    for root in cls.main_roots():
+        main_reachable |= cls.reach(root)
+    for name in main_reachable:
+        reach.setdefault(name, set()).add(MAIN)
+    return reach
+
+
+def concurrent_entries(cls: ClassModel) -> set:
+    """All non-signal entry labels, main included (if the class has any
+    thread-style entry at all)."""
+    labels = {lbl for lbl in cls.entries if not lbl.startswith("signal:")}
+    if labels:
+        labels.add(MAIN)
+    return labels

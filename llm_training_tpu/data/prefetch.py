@@ -91,6 +91,7 @@ class DevicePrefetcher:
         self._wait_timer = registry.timer("data/host_wait")
         self._retry_counter = registry.counter("data/retries")
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        # lint: allow(race-unguarded-shared): single-writer handoff — only the worker assigns _error, and the consumer reads it strictly after the queue SENTINEL the same worker enqueues later; the Queue's lock orders write-then-read
         self._error: BaseException | None = None
         self._stop = threading.Event()
         self._finished = False
@@ -156,6 +157,7 @@ class DevicePrefetcher:
                 self._produced += 1
                 t0 = time.perf_counter()
                 aux = self._host_aux_fn(batch) if self._host_aux_fn else None
+                # lint: allow(thread-jax-free): this worker IS the sanctioned device-work thread — overlapping H2D transfer with the step is its entire job, coordinated through a bounded queue (contracts.THREAD_JAX_FREE_WHY)
                 placed = (jax.device_put(batch, self._shardings), aux)
                 self._produce_timer.add(
                     self._last_pull_s + time.perf_counter() - t0

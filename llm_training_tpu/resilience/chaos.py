@@ -154,7 +154,7 @@ class Chaos:
     def __init__(self, config: ChaosConfig, registry=None):
         self.config = config
         self._rng = random.Random(config.seed)
-        self._fired: set[tuple[str, int]] = set()
+        self._fired: set[tuple[str, int]] = set()  # guarded by: _lock
         self._lock = threading.Lock()
         self._registry = registry
 
@@ -327,7 +327,7 @@ class Chaos:
 
 
 # ---------------------------------------------------------------- current
-_active: Chaos | None = None
+_active: Chaos | None = None  # guarded by: _active_lock
 _active_lock = threading.Lock()
 
 

@@ -53,29 +53,34 @@ class HangWatchdog:
         self._registry = registry
         self._clock = clock
         self._poll_s = poll_interval_s or min(max(timeout_s / 4.0, 0.05), 5.0)
-        self._beats: dict[str, float] = {}
-        self._steps: dict[str, int] = {}
+        self._beats: dict[str, float] = {}  # guarded by: _lock
+        self._steps: dict[str, int] = {}  # guarded by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
-        self._dumped = False  # re-armed by the next beat
-        self._thread: threading.Thread | None = None
-        self.dump_paths: list[Path] = []
+        self._dumped = False  # re-armed by the next beat; guarded by: _lock
+        self._thread: threading.Thread | None = None  # guarded by: _lock
+        self.dump_paths: list[Path] = []  # guarded by: _lock
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "HangWatchdog":
         self.beat(self.primary_source)
-        self._thread = threading.Thread(
+        thread = threading.Thread(
             target=self._run, name="hang-watchdog", daemon=True
         )
-        self._thread.start()
+        with self._lock:
+            self._thread = thread
+        thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        # swap under the lock, join outside it: joining while holding the
+        # lock would deadlock against a poll thread blocked on beat()
+        with self._lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
 
     def beat(self, source: str | None = None, step: int | None = None) -> None:
         """Record progress. Only the `primary_source` beat (default
@@ -94,27 +99,35 @@ class HangWatchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self._poll_s):
-            with self._lock:
-                last = self._beats.get(self.primary_source)
-                dumped = self._dumped
-            if last is None or dumped:
-                continue
+            self._poll_once()
+
+    def _poll_once(self) -> bool:
+        """One staleness check; returns True when a dump fired. The check
+        and the `_dumped` commit happen in ONE critical section: with two
+        separate acquisitions (the original shape), a beat() landing
+        between them was clobbered and a now-healthy process could still
+        be dumped — and with action='abort', killed
+        (tests/test_interleave.py pins the window)."""
+        with self._lock:
+            last = self._beats.get(self.primary_source)
+            if last is None or self._dumped:
+                return False
             stalled = self._clock() - last
             if stalled < self.timeout_s:
-                continue
-            with self._lock:
-                self._dumped = True
-            try:
-                self.dump(stalled)
-            except Exception:  # the watchdog must never kill a healthy run
-                logger.exception("hang-dump failed")
-            if self.action == "abort":
-                logger.critical(
-                    "watchdog: no %s progress for %.1fs — aborting "
-                    "so the supervisor can relaunch",
-                    self.primary_source, stalled,
-                )
-                os.kill(os.getpid(), signal.SIGABRT)
+                return False
+            self._dumped = True
+        try:
+            self.dump(stalled)
+        except Exception:  # the watchdog must never kill a healthy run
+            logger.exception("hang-dump failed")
+        if self.action == "abort":
+            logger.critical(
+                "watchdog: no %s progress for %.1fs — aborting "
+                "so the supervisor can relaunch",
+                self.primary_source, stalled,
+            )
+            os.kill(os.getpid(), signal.SIGABRT)
+        return True
 
     # ------------------------------------------------------------ dumping
 
@@ -131,7 +144,11 @@ class HangWatchdog:
         stamp = time.strftime("%Y%m%d-%H%M%S")
         path = self.run_dir / f"hang-dump-{stamp}.txt"
         path.write_text(content)
-        self.dump_paths.append(path)
+        with self._lock:
+            # dump() fires on the poll thread while tests/smokes poll
+            # dump_paths from the main thread — list append is atomic, but
+            # the guarded-by contract keeps every mutation accountable
+            self.dump_paths.append(path)
         # flight recorder (docs/observability.md#tracing): the trace ring
         # holds the spans leading into the stall — what the loop was doing
         # and for which step/request — next to the thread stacks. Lazy

@@ -53,11 +53,11 @@ class RequestJournal:
     def __init__(self, path: str | Path):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        self._file = open(self.path, "a")
+        self._file = open(self.path, "a")  # guarded by: _lock
         self._lock = threading.Lock()
         # last progress state written per id, so an unchanged request does
         # not grow the journal every step
-        self._written: dict[str, tuple[int, int]] = {}
+        self._written: dict[str, tuple[int, int]] = {}  # guarded by: _lock
 
     # ------------------------------------------------------------ records
 

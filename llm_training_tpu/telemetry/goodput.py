@@ -29,16 +29,16 @@ class GoodputLedger:
     def __init__(self, clock=time.perf_counter):
         self._clock = clock
         self._lock = threading.Lock()
-        self._t0: float | None = None
-        self._phase_s: dict[str, float] = {p: 0.0 for p in PHASES}
+        self._t0: float | None = None  # guarded by: _lock
+        self._phase_s: dict[str, float] = {p: 0.0 for p in PHASES}  # guarded by: _lock
         # stack of currently-open measure() phases: the hang watchdog reads
         # the innermost one to say what the loop was stuck inside
-        self._open: list[str] = []
+        self._open: list[str] = []  # guarded by: _lock
         # cost basis (elastic accounting, docs/resilience.md#elastic): the
         # chip count this segment runs on and its $/chip-hour; None keeps
         # summary() byte-identical to the pre-elastic schema
-        self._chip_count: int | None = None
-        self._price_per_chip_hour: float | None = None
+        self._chip_count: int | None = None  # guarded by: _lock
+        self._price_per_chip_hour: float | None = None  # guarded by: _lock
 
     def set_cost_basis(
         self,

@@ -23,7 +23,7 @@ class Counter:
 
     def __init__(self, lock: threading.RLock):
         self._lock = lock
-        self._value = 0.0
+        self._value = 0.0  # guarded by: _lock
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
@@ -42,7 +42,7 @@ class Gauge:
 
     def __init__(self, lock: threading.RLock):
         self._lock = lock
-        self._value: float | None = None
+        self._value: float | None = None  # guarded by: _lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -62,8 +62,8 @@ class Timer:
     def __init__(self, lock: threading.RLock, clock=time.perf_counter):
         self._lock = lock
         self._clock = clock
-        self.total_s = 0.0
-        self.count = 0
+        self.total_s = 0.0  # guarded by: _lock
+        self.count = 0  # guarded by: _lock
 
     def add(self, seconds: float) -> None:
         with self._lock:
@@ -87,9 +87,9 @@ class TelemetryRegistry:
     def __init__(self, clock=time.perf_counter):
         self._lock = threading.RLock()
         self._clock = clock
-        self._counters: dict[str, Counter] = {}
-        self._gauges: dict[str, Gauge] = {}
-        self._timers: dict[str, Timer] = {}
+        self._counters: dict[str, Counter] = {}  # guarded by: _lock
+        self._gauges: dict[str, Gauge] = {}  # guarded by: _lock
+        self._timers: dict[str, Timer] = {}  # guarded by: _lock
 
     def counter(self, name: str) -> Counter:
         with self._lock:
@@ -121,7 +121,7 @@ class TelemetryRegistry:
 # A plain module global (not a contextvar): worker threads spawned inside a
 # fit must see the fit's registry, and new threads do not inherit contextvars.
 _default_registry = TelemetryRegistry()
-_current_registry = _default_registry
+_current_registry = _default_registry  # guarded by: _current_lock
 _current_lock = threading.Lock()
 
 

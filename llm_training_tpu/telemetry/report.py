@@ -372,24 +372,72 @@ def _newest_audit_record(dirs: list[Path]) -> tuple[dict, str] | None:
     return _newest_json_record(dirs, ("audit*.json",))
 
 
+def _newest_race_record(dirs: list[Path]) -> tuple[dict, str] | None:
+    """The newest racecheck record (`--races --json` output saved as
+    race*.json) reachable from `dirs` (precommit tees one next to
+    audit.json)."""
+    return _newest_json_record(dirs, ("race*.json",))
+
+
 def _audit_section(
-    audit: tuple[dict, str] | None, telemetry: dict
+    audit: tuple[dict, str] | None,
+    races: tuple[dict, str] | None,
+    telemetry: dict,
 ) -> list[str]:
     """Newest shardcheck audit record (docs/static-analysis.md#audit):
     finding count, worst per-chip HBM estimate, and — when the run also
     recorded the measured `hbm/peak_bytes_in_use` gauge — the measured
     number next to the estimate so drift between the audit's model of HBM
-    and reality is visible in one place. Omitted when no audit record is
-    reachable; a foreign/malformed audit*.json costs one honest line,
-    mirroring `== Perf ==`."""
-    if audit is None:
+    and reality is visible in one place. A race*.json from the `--races`
+    gate adds its one-line summary (docs/static-analysis.md#racecheck).
+    Omitted when neither record is reachable; a foreign/malformed record
+    costs one honest line, mirroring `== Perf ==`."""
+    if audit is None and races is None:
         return []
-    record, name = audit
-    header = ["", "== Audit ==", f"audit record: {name}"]
-    try:
-        return header + _audit_lines(record, telemetry)
-    except (KeyError, TypeError, ValueError, AttributeError):
-        return header + ["unreadable audit record — malformed fields"]
+    lines = ["", "== Audit =="]
+    if audit is not None:
+        record, name = audit
+        lines.append(f"audit record: {name}")
+        try:
+            lines.extend(_audit_lines(record, telemetry))
+        except (KeyError, TypeError, ValueError, AttributeError):
+            lines.append("unreadable audit record — malformed fields")
+    if races is not None:
+        record, name = races
+        try:
+            lines.extend(_race_lines(record, name))
+        except (KeyError, TypeError, ValueError, AttributeError):
+            lines.append(f"racecheck: unreadable race record {name} — malformed fields")
+    return lines
+
+
+def _race_lines(record: dict, name: str) -> list[str]:
+    findings = record.get("findings")
+    if not isinstance(findings, list):
+        return [f"racecheck: unreadable race record {name} — malformed fields"]
+    status = "FAIL" if findings else "OK"
+    line = (
+        f"racecheck: {status} — {len(findings)} finding(s) "
+        f"(record {name}"
+    )
+    suppressed = record.get("suppressed")
+    if suppressed:
+        line += f", {int(suppressed)} suppressed"
+    baselined = record.get("baselined")
+    if baselined:
+        line += f", {int(baselined)} baselined"
+    line += ")"
+    lines = [line]
+    by_rule: dict[str, int] = {}
+    for finding in findings:
+        rule = finding.get("rule", "?") if isinstance(finding, dict) else "?"
+        by_rule[rule] = by_rule.get(rule, 0) + 1
+    if by_rule:
+        lines.append(
+            "race findings: "
+            + "  ".join(f"{r} x{n}" for r, n in sorted(by_rule.items()))
+        )
+    return lines
 
 
 def _audit_lines(record: dict, telemetry: dict) -> list[str]:
@@ -845,9 +893,15 @@ def render_report(
     lines.extend(_perf_section(_newest_bench_record([
         Path(bench_dir) if bench_dir else None, run_dir, Path.cwd(),
     ])))
-    lines.extend(_audit_section(_newest_audit_record([
-        Path(audit_dir) if audit_dir else None, run_dir,
-    ]), telemetry))
+    lines.extend(_audit_section(
+        _newest_audit_record([
+            Path(audit_dir) if audit_dir else None, run_dir,
+        ]),
+        _newest_race_record([
+            Path(audit_dir) if audit_dir else None, run_dir,
+        ]),
+        telemetry,
+    ))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
     lines.extend(_trace_section(_trace_summary(run_dir)))

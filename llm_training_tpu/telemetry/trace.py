@@ -95,16 +95,16 @@ class TraceRecorder:
             enabled if enabled is not None else _env_flag("LLMT_TRACE", True)
         )
         self.clock = clock
-        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self._ring: deque[dict] = deque(maxlen=self.capacity)  # guarded by: _lock
         self._lock = threading.Lock()
-        self._sink = None
-        self._sink_path: Path | None = None
-        self._unflushed = 0
-        self._recorded = 0
-        self._written = 0
-        self._flight_dumps = 0
-        self._requests_seen = 0
-        self._requests_sampled = 0
+        self._sink = None  # guarded by: _lock
+        self._sink_path: Path | None = None  # guarded by: _lock
+        self._unflushed = 0  # guarded by: _lock
+        self._recorded = 0  # guarded by: _lock
+        self._written = 0  # guarded by: _lock
+        self._flight_dumps = 0  # guarded by: _lock
+        self._requests_seen = 0  # guarded by: _lock
+        self._requests_sampled = 0  # guarded by: _lock
 
     # ------------------------------------------------------------ sink
 
@@ -266,7 +266,7 @@ class TraceRecorder:
 # A plain module global (same rationale as registry.py): worker threads and
 # independently constructed components (scheduler, watchdog, NaN guard) must
 # find the process tracer without plumbing.
-_current_tracer: TraceRecorder | None = None
+_current_tracer: TraceRecorder | None = None  # guarded by: _current_lock
 _current_lock = threading.Lock()
 
 

@@ -12,14 +12,35 @@ trap 'rm -rf "${SMOKE_ROOT}"' EXIT
 
 # graftlint FIRST: pure-AST, never imports jax, fails in seconds — the
 # pallas-arity / jax-free-import / host-sync / telemetry-prefix /
-# env-doc-drift / logical-axis-literal invariants
+# env-doc-drift / logical-axis-literal / thread-jax-free invariants
 # (docs/static-analysis.md). A violation message names the rule;
 # `python -m llm_training_tpu.analysis --list-rules` lists them, and
 # `# lint: allow(<rule>): <reason>` suppresses a deliberate one.
+# PRECOMMIT_LINT_CHANGED=1 narrows the lint + race gates to the git diff
+# for quick local commits; this script's default (and the CI/nightly
+# path) stays full-tree so nothing rots outside the diff.
+LINT_SCOPE=""
+if [ "${PRECOMMIT_LINT_CHANGED:-0}" = "1" ]; then
+    LINT_SCOPE="--changed-only"
+fi
 echo "== precommit: graftlint (static analysis, pre-jax) =="
-python -m llm_training_tpu.analysis
+python -m llm_training_tpu.analysis ${LINT_SCOPE}
 
-# shardcheck SECOND (docs/static-analysis.md#audit): abstract-eval every
+# racecheck SECOND (docs/static-analysis.md#racecheck): the thread-model
+# audit — unguarded shared mutation vs the `# guarded by:` contract
+# registry, lock-order inversions, signal-handler safety. Still jax-free
+# and pure-AST; its JSON lands in SMOKE_ROOT so the report gate below
+# renders the race-gate line in == Audit ==.
+echo "== precommit: racecheck (thread-model audit, pre-jax) =="
+if ! python -m llm_training_tpu.analysis --races --json ${LINT_SCOPE} \
+    | tee "${SMOKE_ROOT}/race.json" >/dev/null; then
+    echo "racecheck FAILED — findings:" >&2
+    python -m json.tool "${SMOKE_ROOT}/race.json" >&2 \
+        || cat "${SMOKE_ROOT}/race.json" >&2
+    exit 1
+fi
+
+# shardcheck THIRD (docs/static-analysis.md#audit): abstract-eval every
 # registered family's init (jax.eval_shape, CPU, zero FLOPs) and resolve
 # the param/opt-state/KV-cache trees against the mesh matrix — unknown
 # logical axes, duplicate-axis drops, indivisible dims, large replicated
@@ -60,6 +81,9 @@ grep -q "== Health ==" "${SMOKE_ROOT}/report_smoke.log"
 # run recorded the hbm gauge)
 grep -q "== Audit ==" "${SMOKE_ROOT}/report_smoke.log"
 grep -q "shardcheck: OK" "${SMOKE_ROOT}/report_smoke.log"
+# the racecheck gate above teed race.json into SMOKE_ROOT; report renders
+# its one-line race-gate summary in the same == Audit == section
+grep -q "racecheck: OK" "${SMOKE_ROOT}/report_smoke.log"
 
 # inference gate (docs/inference.md): generate + evaluate must run
 # end-to-end from the smoke fit's checkpoint, emit nonzero output, and land

@@ -80,7 +80,7 @@ def findings_for(root: Path, rule: str | None = None, **kwargs):
 # --------------------------------------------------------------- engine
 
 
-def test_rule_table_has_the_six_rules():
+def test_rule_table_has_the_seven_rules():
     names = [rule.name for rule in all_rules()]
     assert names == [
         "pallas-kernel-arity",
@@ -89,6 +89,7 @@ def test_rule_table_has_the_six_rules():
         "telemetry-prefix",
         "env-doc-drift",
         "logical-axis-literal",
+        "thread-jax-free",
     ]
 
 

@@ -315,7 +315,9 @@ def test_report_audit_section_renders_and_degrades(tmp_path):
             "total_gib": 0.004, "fits": True,
         }}}},
     }
-    lines = _audit_section((good, "audit.json"), {"hbm/peak_bytes_in_use": 2 * 1024**3})
+    lines = _audit_section(
+        (good, "audit.json"), None, {"hbm/peak_bytes_in_use": 2 * 1024**3}
+    )
     text = "\n".join(lines)
     assert "== Audit ==" in text
     assert "shardcheck: OK" in text
@@ -323,16 +325,16 @@ def test_report_audit_section_renders_and_degrades(tmp_path):
     assert "measured hbm/peak_bytes_in_use: 2.000" in text
 
     failing = dict(good, findings=[{"rule": "shard-unknown-axis"}] * 2)
-    text = "\n".join(_audit_section((failing, "a.json"), {}))
+    text = "\n".join(_audit_section((failing, "a.json"), None, {}))
     assert "shardcheck: FAIL — 2 finding(s)" in text
     assert "shard-unknown-axis x2" in text
 
     # malformed record: one honest line, never a crash
-    text = "\n".join(_audit_section(({"findings": "what"}, "a.json"), {}))
+    text = "\n".join(_audit_section(({"findings": "what"}, "a.json"), None, {}))
     assert "unreadable audit record" in text
 
     # absent: the section is omitted entirely
-    assert _audit_section(None, {}) == []
+    assert _audit_section(None, None, {}) == []
 
     # end-to-end: render_report picks audit.json out of the run dir
     run_dir = tmp_path / "run"
