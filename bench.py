@@ -9,8 +9,16 @@ process (the PR 3/PR 5 `Supervisor` + `HangWatchdog` machinery):
   train         the headline MFU fit
   health        A/B fit with the model-health layer on (health_overhead_pct)
   trace         A/B fit with host tracing fully on (trace_overhead_pct)
+  exporter      A/B fit with the /metrics exporter scraped at Prometheus
+                cadence (exporter_overhead_pct)
   decode        tiny-model generate (decode-program overhead trend)
   serve         tiny-model continuous batching (serve tokens/s/chip + TTFT)
+
+`--check-regression` runs no bench at all: it parses the committed
+BENCH_r*.json history (telemetry/perf_ledger.py), prints the round-over-
+round trend table, and exits nonzero when the newest same-backend round
+regressed MFU / decode tokens-per-sec / serve TTFT beyond
+BENCH_REGRESSION_TOLERANCE_PCT.
 
 The PARENT never imports jax — a wedged backend can only hang a child,
 which the per-stage timeout kills (and the fit stages arm the in-process
@@ -45,7 +53,9 @@ import subprocess
 import sys
 import time
 
-STAGES = ("backend_init", "train", "health", "trace", "decode", "serve")
+STAGES = (
+    "backend_init", "train", "health", "trace", "exporter", "decode", "serve"
+)
 
 # peak bf16 FLOP/s per chip by TPU generation (public specs)
 _PEAK_FLOPS = {
@@ -473,6 +483,55 @@ def stage_trace() -> dict:
     }
 
 
+def stage_exporter() -> dict:
+    """Same fit as the train stage with the live-telemetry exporter ON and
+    a Prometheus-cadence scraper polling /metrics throughout — the A/B
+    for `exporter_overhead_pct` (docs/observability.md#live-telemetry).
+    The scraper runs in-process (a daemon thread hitting localhost), so
+    the measured overhead includes both the serving thread and the
+    registry snapshots each scrape takes."""
+    import threading
+    import urllib.request
+
+    from llm_training_tpu.telemetry.exporter import find_free_port
+
+    # ephemeral port chosen here (bind-then-release) rather than port 0:
+    # the trainer reads LLMT_METRICS_PORT and the scraper must know where
+    # to point before the fit starts
+    port = find_free_port()
+    os.environ["LLMT_METRICS_PORT"] = str(port)
+
+    stop = threading.Event()
+    scrapes = {"ok": 0, "failed": 0, "last": ""}
+
+    def scrape_loop():
+        url = f"http://127.0.0.1:{port}/metrics"
+        while not stop.wait(0.5):
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as resp:
+                    scrapes["last"] = resp.read().decode("utf-8", "replace")
+                scrapes["ok"] += 1
+            except OSError:
+                scrapes["failed"] += 1  # exporter not up yet / fit finished
+
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+    model_kwargs, seq, batch, steps, warmup, on_tpu = _model_setup()
+    try:
+        _, _, sec_exporter = _timed_fit(
+            model_kwargs, seq, batch, steps, warmup, on_tpu
+        )
+    finally:
+        stop.set()
+        scraper.join(timeout=5.0)
+        os.environ.pop("LLMT_METRICS_PORT", None)
+    return {
+        "sec_per_step_exporter": round(sec_exporter, 4),
+        "exporter_scrapes": scrapes["ok"],
+        "exporter_scrape_series": scrapes["last"].count("# TYPE"),
+    }
+
+
 def stage_decode() -> dict:
     """Decode-path gauge (docs/inference.md): a TINY-model generate run —
     the gauge tracks the decode program's dispatch/step overhead trend, not
@@ -559,6 +618,7 @@ _STAGE_FNS = {
     "train": stage_train,
     "health": stage_health,
     "trace": stage_trace,
+    "exporter": stage_exporter,
     "decode": stage_decode,
     "serve": stage_serve,
 }
@@ -588,6 +648,7 @@ def _stage_timeout(stage: str) -> float:
         "train": run_timeout,
         "health": env("BENCH_HEALTH_TIMEOUT", run_timeout),
         "trace": env("BENCH_TRACE_TIMEOUT", run_timeout),
+        "exporter": env("BENCH_EXPORTER_TIMEOUT", run_timeout),
         "decode": env("BENCH_DECODE_TIMEOUT", 600),
         "serve": env("BENCH_SERVE_TIMEOUT", 600),
     }[stage]
@@ -598,6 +659,8 @@ def _stage_enabled(stage: str) -> bool:
         return os.environ.get("BENCH_HEALTH", "1") != "0"
     if stage == "trace":
         return os.environ.get("BENCH_TRACE", "1") != "0"
+    if stage == "exporter":
+        return os.environ.get("BENCH_EXPORTER", "1") != "0"
     if stage == "decode":
         return os.environ.get("BENCH_DECODE", "1") != "0"
     if stage == "serve":
@@ -737,6 +800,16 @@ def summarize(results: dict) -> dict:
         summary["trace_overhead_pct"] = round(100.0 * overhead, 2)
     else:
         summary["trace_overhead_pct"] = None
+    # step-time cost of the live-telemetry exporter under a steady scrape
+    # (docs/observability.md#live-telemetry) vs unexported
+    exporter = results.get("exporter", {})
+    if ok("train") and ok("exporter") and train.get("sec_per_step"):
+        overhead = (exporter["sec_per_step_exporter"] - train["sec_per_step"]) \
+            / train["sec_per_step"]
+        summary["exporter_overhead_pct"] = round(100.0 * overhead, 2)
+        summary["exporter_scrapes"] = exporter.get("exporter_scrapes")
+    else:
+        summary["exporter_overhead_pct"] = None
     decode = results.get("decode", {})
     summary["prefill_time_s"] = decode.get("prefill_time_s")
     summary["decode_tokens_per_sec"] = decode.get("decode_tokens_per_sec")
@@ -801,7 +874,26 @@ def main() -> int:
     parser.add_argument("--dry", action="store_true",
                         help="CPU dry run of the full stage/subprocess/"
                              "partial-JSON plumbing with the tiny proxy")
+    parser.add_argument("--check-regression", action="store_true",
+                        help="no bench run: parse the committed BENCH_r*.json "
+                             "history, print the trend table, and exit "
+                             "nonzero when the newest same-backend round "
+                             "regressed MFU / decode tokens-per-sec / serve "
+                             "TTFT beyond BENCH_REGRESSION_TOLERANCE_PCT "
+                             "(docs/performance.md#perf-ledger)")
+    parser.add_argument("--bench-dir", default=".",
+                        help="directory holding BENCH_r*.json rounds "
+                             "(--check-regression only; default: cwd)")
+    parser.add_argument("--tolerance-pct", type=float, default=None,
+                        help="regression tolerance override "
+                             "(default: BENCH_REGRESSION_TOLERANCE_PCT or 40)")
     args = parser.parse_args()
+    if args.check_regression:
+        # jax-free by contract, like the whole bench parent: the regression
+        # gate must run on any machine the repo is checked out on
+        from llm_training_tpu.telemetry.perf_ledger import ledger_main
+
+        return ledger_main(args.bench_dir, tolerance_pct=args.tolerance_pct)
     if args.dry and not args.stage:
         os.environ["JAX_PLATFORMS"] = "cpu"
     if args.stage:

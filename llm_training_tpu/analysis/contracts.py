@@ -51,6 +51,21 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "module level, and the trace/report/export paths must run anywhere "
         "the run dir is mounted — tracing can never pull a backend"
     ),
+    "llm_training_tpu/telemetry/exporter.py": (
+        "scrape handler threads must never own device work: a /metrics or "
+        "/healthz request that triggers a jax call can block behind the "
+        "exact wedged dispatch the probe exists to report"
+    ),
+    "llm_training_tpu/telemetry/slo.py": (
+        "the SLO monitor is fed from the serve loop and read from the "
+        "exporter's scrape thread; breach evaluation must never pay a "
+        "backend import or a wedged device stalls the alert that reports it"
+    ),
+    "llm_training_tpu/telemetry/perf_ledger.py": (
+        "the bench PARENT (itself jax-free) imports the regression ledger; "
+        "the --check-regression gate must run on any machine the repo is "
+        "checked out on, backend or not"
+    ),
     # the lint gate itself: precommit runs it before any backend exists and
     # it must stay millisecond-cheap
     "llm_training_tpu/analysis/__init__.py": (
@@ -135,7 +150,19 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
     },
     "llm_training_tpu/telemetry/goodput.py": {
         "GoodputLedger": "the hang watchdog reads current_phase from its "
-        "poll thread while the train loop brackets phases",
+        "poll thread while the train loop brackets phases — and the "
+        "metrics exporter's scrape threads render summary()/current_phase "
+        "per /metrics///statusz request",
+    },
+    "llm_training_tpu/telemetry/exporter.py": {
+        "MetricsExporter": "the HTTP server's per-request handler threads "
+        "render scrapes while the owning loop starts/stops the exporter "
+        "and mutates the scrape counters",
+    },
+    "llm_training_tpu/telemetry/slo.py": {
+        "SLOMonitor": "the serve loop / train loop observe requests and "
+        "steps while the exporter's scrape threads read last_alert() and "
+        "breach counts",
     },
     "llm_training_tpu/serve/journal.py": {
         "RequestJournal": "the serve CLI journals deliveries from its "
@@ -167,8 +194,13 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
 # watchdog locks wrap policy decisions and sort first.
 LOCK_ORDER = (
     "chaos",     # resilience/chaos.py Chaos._lock + _active_lock
+    "exporter",  # telemetry/exporter.py MetricsExporter._lock (scrape
+                 # counters only; handlers compose responses WITHOUT
+                 # holding it while calling other subsystems)
     "watchdog",  # resilience/watchdog.py HangWatchdog._lock
     "goodput",   # telemetry/goodput.py GoodputLedger._lock
+    "slo",       # telemetry/slo.py SLOMonitor._lock (window state only;
+                 # breach side effects emit after release)
     "journal",   # serve/journal.py RequestJournal._lock
     "trace",     # telemetry/trace.py TraceRecorder._lock + _current_lock
     "registry",  # telemetry/registry.py TelemetryRegistry._lock (leaf)

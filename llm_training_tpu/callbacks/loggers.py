@@ -23,7 +23,7 @@ logger = logging.getLogger(__name__)
 TELEMETRY_PREFIXES = (
     "goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/",
     "health/", "nan_guard/", "resilience/", "decode/", "eval/", "serve/",
-    "elastic/", "flash/", "trace/",
+    "elastic/", "flash/", "trace/", "slo/", "exporter/",
 )
 TELEMETRY_KEYS = ("compile_time_s",)
 

@@ -1,5 +1,5 @@
 """Console entry: fit / validate / generate / serve / evaluate / report /
-trace / supervise.
+trace / watch / supervise.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -338,6 +338,35 @@ def _run_serve(args, config: dict) -> int:
             primary_source="engine_step",
         ).start()
 
+    # live telemetry (docs/observability.md#live-telemetry): the SLO
+    # monitor (LLMT_SLO_* targets; fed per done event below) and the
+    # /metrics//statusz//healthz exporter (LLMT_METRICS_PORT; 0 = off —
+    # the supervisor's env passthrough keeps the port across relaunches,
+    # so scrapes survive a drain/replay boundary). The exporter's live
+    # gauges come from engine.live_stats(): queue depth, in-flight rows,
+    # rolling TTFT/TPOT — the answer to "is this server healthy NOW"
+    # rather than the end-of-run stats record.
+    from llm_training_tpu.telemetry import get_registry
+    from llm_training_tpu.telemetry.exporter import start_exporter
+    from llm_training_tpu.telemetry.slo import build_slo_monitor
+
+    # flight dumps are run-dir artifacts: process 0 only, like the journal
+    slo = build_slo_monitor(
+        registry=get_registry(), run_dir=run_dir if primary else None
+    )
+    exporter = start_exporter(
+        registry=get_registry(),
+        watchdog=watchdog,
+        slo=slo,
+        extra_fn=engine.live_stats,
+        status_fn=lambda: {
+            "engine step": engine._step_index,
+            "queue depth": len(engine.scheduler.waiting),
+            "running": len(engine.scheduler.running),
+            "completed": len(engine.scheduler.completed),
+        },
+    )
+
     # request journal (docs/serving.md#resilience): a relaunch replays
     # accepted-but-unfinished work so no accepted request is silently
     # lost. The previous journal is rotated into a durable backup that
@@ -426,6 +455,15 @@ def _run_serve(args, config: dict) -> int:
     def emit(events):
         for event in events:
             print(json.dumps(event), flush=True)
+            if slo is not None and event.get("type") == "done":
+                # every terminal feeds the SLO windows: full completions
+                # carry their latency numbers, everything else burns the
+                # error-rate budget
+                slo.observe_request(
+                    ttft_ms=event.get("ttft_ms"),
+                    tpot_ms=event.get("tpot_ms"),
+                    ok=event.get("stop_reason") in ("eos", "max_tokens"),
+                )
 
     def reload_from_checkpoint(request: dict) -> None:
         """{"type": "reload", "ckpt_path"?}: restore (newest checkpoint
@@ -569,6 +607,11 @@ def _run_serve(args, config: dict) -> int:
         engine.journal.close()
         if journal_path is not None:
             journal_path.unlink(missing_ok=True)
+    if exporter is not None:
+        # LAST, after the stats line and the telemetry merge: the loadgen's
+        # final cross-check scrape fires the moment the last terminal lands
+        # on stdout, and the exporter must still be answering then
+        exporter.stop()
     uninstall_chaos()
     shutdown.uninstall()
     return rc
@@ -813,6 +856,23 @@ def main(argv: list[str] | None = None) -> int:
         help="json emits every section as one machine-readable object "
         "(schema_version-pinned — for CI trend tracking)",
     )
+    watch = sub.add_parser(
+        "watch",
+        help="poll a live run's /statusz (the LLMT_METRICS_PORT exporter) "
+        "and print each snapshot (docs/observability.md#live-telemetry)",
+    )
+    watch.add_argument(
+        "--port", type=int, default=None,
+        help="exporter port (default: LLMT_METRICS_PORT)",
+    )
+    watch.add_argument("--host", default="127.0.0.1")
+    watch.add_argument(
+        "--interval-s", type=float, default=2.0, help="poll cadence",
+    )
+    watch.add_argument(
+        "--once", action="store_true",
+        help="one snapshot then exit (exit 2 when unreachable)",
+    )
     trace = sub.add_parser(
         "trace",
         help="export a run's trace.jsonl as Chrome-trace JSON viewable in "
@@ -893,6 +953,16 @@ def main(argv: list[str] | None = None) -> int:
         from llm_training_tpu.telemetry.trace import trace_main
 
         return trace_main(args.source, out=args.out)
+    if args.command == "watch":
+        # stdlib-only: the watcher polls a running process's exporter and
+        # must never pay a backend import (or it could not watch a wedged
+        # run from the same machine)
+        from llm_training_tpu.telemetry.exporter import watch_main
+
+        return watch_main(
+            port=args.port, host=args.host,
+            interval_s=args.interval_s, once=args.once,
+        )
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import

@@ -90,6 +90,12 @@ class ChaosConfig(BaseModel):
     # at startup — the error-chunk boundary must answer each and keep
     # serving
     serve_malformed_flood: int = Field(0, ge=0)
+    # SLO-breach injection (docs/observability.md#slo): sleep this long at
+    # EVERY optimizer-step boundary from `slow_step_from` on — a sustained
+    # slow regime, exactly what the multi-window burn-rate alert needs to
+    # see (a one-shot stall is the watchdog's test, not the SLO's)
+    slow_step_s: float = Field(0.0, ge=0)
+    slow_step_from: int = Field(0, ge=0)
 
     def any_active(self) -> bool:
         return bool(
@@ -104,6 +110,7 @@ class ChaosConfig(BaseModel):
             or self.serve_stall_step is not None
             or self.serve_sigterm_step is not None
             or self.serve_malformed_flood > 0
+            or self.slow_step_s > 0
         )
 
 
@@ -115,7 +122,9 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
     LLMT_CHAOS_SIGTERM_STEP / LLMT_CHAOS_SIGKILL_STEP / LLMT_CHAOS_NAN_STEP
     / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SERVE_STALL_STEP /
     LLMT_CHAOS_SERVE_SIGTERM_STEP / LLMT_CHAOS_SERVE_MALFORMED_FLOOD /
-    LLMT_CHAOS_SEED (ints)."""
+    LLMT_CHAOS_SLOW_STEP_FROM / LLMT_CHAOS_SEED (ints) /
+    LLMT_CHAOS_SLOW_STEP_S (float, seconds of injected dead time per
+    optimizer step — the SLO-breach hook)."""
     update: dict = {}
     # env names are spelled out as literals (not derived from the field
     # names) so the env-doc-drift lint rule can statically match each one
@@ -133,6 +142,8 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
         ("serve_stall_step", "LLMT_CHAOS_SERVE_STALL_STEP", int),
         ("serve_sigterm_step", "LLMT_CHAOS_SERVE_SIGTERM_STEP", int),
         ("serve_malformed_flood", "LLMT_CHAOS_SERVE_MALFORMED_FLOOD", int),
+        ("slow_step_s", "LLMT_CHAOS_SLOW_STEP_S", float),
+        ("slow_step_from", "LLMT_CHAOS_SLOW_STEP_FROM", int),
         ("seed", "LLMT_CHAOS_SEED", int),
     ):
         raw = os.environ.get(env_name)
@@ -284,6 +295,35 @@ class Chaos:
             '{"id": "flood", "prompt": [1], "max_new_tokens": "junk"}',
         )
         return [shapes[i % len(shapes)] for i in range(n)]
+
+    def maybe_slow_step(self, step: int, sleep=None) -> bool:
+        """Inject `slow_step_s` of dead time at this optimizer-step
+        boundary (every step >= `slow_step_from` while armed — a sustained
+        regression, not a one-shot stall). The SLO monitor's step-cadence
+        target sees the inflated interval and must burn through its budget
+        (the precommit exporter-smoke gate asserts the breach). Returns
+        True when the sleep fired."""
+        if self.config.slow_step_s <= 0 or step < self.config.slow_step_from:
+            return False
+        # the regime is ONE injection, not one per step: a 10k-step soak
+        # must not bury real one-shot chaos events under 10k warning lines
+        # and a 10k-high injections counter
+        with self._lock:
+            first = ("slow_step",) not in self._fired
+            if first:
+                self._fired.add(("slow_step",))
+        if first:
+            self._count()
+            logger.warning(
+                "chaos: slowing every step from %d on by %.2fs",
+                step, self.config.slow_step_s,
+            )
+        else:
+            logger.debug(
+                "chaos: slowing step %d by %.2fs", step, self.config.slow_step_s
+            )
+        (sleep or time.sleep)(self.config.slow_step_s)
+        return True
 
     def maybe_poison_metrics(
         self, step: int, metrics: dict, fresh_start: bool = True

@@ -95,6 +95,17 @@ class HangWatchdog:
             if source == self.primary_source:
                 self._dumped = False
 
+    def beat_age(self, source: str | None = None) -> float | None:
+        """Seconds since the last beat from `source` (default the primary
+        source), or None before any beat. Read by the metrics exporter's
+        /healthz (telemetry/exporter.py): the probe turns red on a stale
+        primary beat BEFORE this watchdog's own timeout aborts."""
+        if source is None:
+            source = self.primary_source
+        with self._lock:
+            last = self._beats.get(source)
+        return None if last is None else self._clock() - last
+
     # ------------------------------------------------------------ polling
 
     def _run(self) -> None:

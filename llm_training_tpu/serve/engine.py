@@ -67,6 +67,11 @@ from llm_training_tpu.telemetry.trace import get_tracer
 
 logger = logging.getLogger(__name__)
 
+# newest terminals live_stats() scans for its rolling TTFT/TPOT
+# percentiles: bounds the per-scrape cost on a long-lived server whose
+# completed list grows without bound
+_LIVE_WINDOW = 512
+
 
 class ServeConfig(BaseModel):
     """Serving knobs (docs/serving.md#knobs)."""
@@ -196,6 +201,12 @@ class ServingEngine:
         # instead of silently losing one the journal claims was delivered
         self._unretired: list[ServeRequest] = []
         self.replayed_requests = 0
+        # protocol-truth terminal counters (bumped in _done_event, the one
+        # place every terminal passes): live_stats reads them so a scrape
+        # never pays O(full completion history) — and they match the
+        # client-side census by construction
+        self._done_full = 0
+        self._done_failed = 0
 
     # ------------------------------------------------------------ programs
 
@@ -607,6 +618,10 @@ class ServingEngine:
         return events
 
     def _done_event(self, request: ServeRequest) -> dict:
+        if request.stop_reason in ("eos", "max_tokens"):
+            self._done_full += 1
+        else:
+            self._done_failed += 1
         if self.journal is not None:
             self._unretired.append(request)
         event = {
@@ -654,14 +669,16 @@ class ServingEngine:
 
     # --------------------------------------------------------------- stats
 
-    def stats(self) -> dict[str, float]:
-        """Engine/latency summary, published as `serve/*` gauges (merged
-        into telemetry.jsonl by the CLI; `report` renders `== Serving ==`)."""
-        from llm_training_tpu.telemetry import get_registry
-
+    def _completed_latencies(self) -> tuple[list, list, list[float], list[float]]:
+        """(all terminals, full completions, ttft_ms, tpot_ms) over the
+        requests finished so far — the ONE filter + latency math both
+        `stats()` and `live_stats()` render, so the scraped live
+        percentiles can never disagree with the end-of-run record. Pure
+        host reads (list snapshot under the GIL) — safe from the
+        exporter's scrape threads."""
+        completed_all = list(self.scheduler.completed)
         completed = [
-            r for r in self.scheduler.completed
-            if r.stop_reason in ("eos", "max_tokens")
+            r for r in completed_all if r.stop_reason in ("eos", "max_tokens")
         ]
         ttft = [
             1000.0 * (r.first_token_s - r.arrival_s)
@@ -672,14 +689,64 @@ class ServingEngine:
             for r in completed
             if r.last_token_s is not None and len(r.generated) > 1
         ]
+        return completed_all, completed, ttft, tpot
+
+    def live_stats(self) -> dict[str, float]:
+        """Scrape-time gauges for the live-telemetry exporter
+        (docs/observability.md#live-telemetry): queue depth, in-flight
+        rows, and rolling completion/latency numbers. The latency scan is
+        bounded to the newest `_LIVE_WINDOW` terminals — on a long-lived
+        server `scheduler.completed` grows without bound, and a 2 Hz
+        Prometheus scrape must not pay O(full request history) per scrape
+        (rolling percentiles over recent completions are also the more
+        honest live signal). Counts stay exact (len() is O(1); the
+        failed tally rides the schedulers' terminal counters). Called
+        from the exporter's handler threads — read-only over host state,
+        never a jax call, so a scrape can never perturb or block the
+        decode loop."""
+        recent = self.scheduler.completed[-_LIVE_WINDOW:]
+        completed = [
+            r for r in recent if r.stop_reason in ("eos", "max_tokens")
+        ]
+        ttft = [
+            1000.0 * (r.first_token_s - r.arrival_s)
+            for r in completed if r.first_token_s is not None
+        ]
+        tpot = [
+            1000.0 * (r.last_token_s - r.first_token_s) / (len(r.generated) - 1)
+            for r in completed
+            if r.last_token_s is not None and len(r.generated) > 1
+        ]
+        out = {
+            "serve/queue_depth": float(len(self.scheduler.waiting)),
+            "serve/running": float(len(self.scheduler.running)),
+            "serve/engine_steps": float(self._step_index),
+            "serve/requests_completed": float(self._done_full),
+            "serve/requests_failed": float(self._done_failed),
+            "serve/tokens_generated": float(self.tokens_generated),
+            "serve/weights_generation": float(self.weights_generation),
+            "decode/cache_blocks_in_use": float(self.allocator.blocks_in_use),
+        }
+        if ttft:
+            out["serve/ttft_p50_ms"] = float(np.percentile(ttft, 50))
+            out["serve/ttft_p99_ms"] = float(np.percentile(ttft, 99))
+        if tpot:
+            out["serve/tpot_p50_ms"] = float(np.percentile(tpot, 50))
+            out["serve/tpot_p99_ms"] = float(np.percentile(tpot, 99))
+        return out
+
+    def stats(self) -> dict[str, float]:
+        """Engine/latency summary, published as `serve/*` gauges (merged
+        into telemetry.jsonl by the CLI; `report` renders `== Serving ==`)."""
+        from llm_training_tpu.telemetry import get_registry
+
+        completed_all, completed, ttft, tpot = self._completed_latencies()
         wall = (time.perf_counter() - self._t0) if self._t0 is not None else 0.0
         n_chips = max(1, jax.device_count())
         tps = self.tokens_generated / wall if wall > 0 else 0.0
         stats = {
             "serve/requests_completed": float(len(completed)),
-            "serve/requests_failed": float(
-                len(self.scheduler.completed) - len(completed)
-            ),
+            "serve/requests_failed": float(len(completed_all) - len(completed)),
             "serve/requests_evicted": float(self.scheduler.evictions),
             "serve/shed_total": float(self.scheduler.shed_total),
             "serve/deadline_total": float(self.scheduler.deadline_total),

@@ -22,7 +22,17 @@ from llm_training_tpu.telemetry.anomaly import (
     top_layers,
 )
 from llm_training_tpu.telemetry.device import compiled_cost_gauges, hbm_gauges
+from llm_training_tpu.telemetry.exporter import (
+    MetricsExporter,
+    resolve_metrics_port,
+    start_exporter,
+)
 from llm_training_tpu.telemetry.goodput import PHASES, GoodputLedger
+from llm_training_tpu.telemetry.slo import (
+    SLOMonitor,
+    build_slo_monitor,
+    slo_config_from_env,
+)
 from llm_training_tpu.telemetry.registry import (
     TelemetryRegistry,
     get_registry,
@@ -57,9 +67,12 @@ __all__ = [
     "EmaZScore",
     "GoodputLedger",
     "HealthConfig",
+    "MetricsExporter",
+    "SLOMonitor",
     "TelemetryRegistry",
     "TraceRecorder",
     "build_param_groups",
+    "build_slo_monitor",
     "compiled_cost_gauges",
     "dump_anomaly",
     "get_registry",
@@ -68,8 +81,11 @@ __all__ = [
     "layer_health_metrics",
     "moe_router_health",
     "offending_layers",
+    "resolve_metrics_port",
     "resolve_run_dir",
     "set_registry",
     "set_tracer",
+    "slo_config_from_env",
+    "start_exporter",
     "top_layers",
 ]

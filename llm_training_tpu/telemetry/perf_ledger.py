@@ -1,0 +1,249 @@
+"""Perf-regression ledger over the committed BENCH rounds
+(docs/performance.md#perf-ledger).
+
+The repo's `BENCH_r*.json` files are the performance trajectory — but a
+board of seven JSON blobs is a trajectory nobody machine-reads. This
+module parses the history (both record shapes: a raw bench.py summary and
+the driver wrapper `{n, cmd, rc, tail, parsed}`), renders a trend table,
+and implements `bench.py --check-regression`: compare the newest round
+against the previous round on the SAME backend+model (TPU rounds never
+gate CPU rounds and vice versa — the numbers differ by orders of
+magnitude) and exit nonzero when a headline metric moved the wrong way by
+more than the tolerance:
+
+- `value` (MFU) and `decode_tokens_per_sec`: lower is worse;
+- `serve_ttft_p50_ms`: higher is worse (p50, not p99 — at bench-scale
+  request counts p99 is one sample).
+
+Tolerance defaults to 40% (`BENCH_REGRESSION_TOLERANCE_PCT`): bench
+rounds on a shared container carry real run-to-run noise — PR 11 measured
+±30% swings under concurrent load, and the r06→r07 pair (both honest,
+quiet-container rounds) differ 25% on MFU purely from machine day-to-day —
+and the ledger exists to catch step-function regressions (a dead fast
+path, a serialized decode: 2-10x, not 1.3x), not slow-container days.
+TPU rounds are far tighter (r01→r02 repeated within 0.3%), so tighten the
+tolerance via env when gating hardware rounds. Jax-free and stdlib-only,
+like every file the bench PARENT may import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+
+ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+TOLERANCE_ENV = "BENCH_REGRESSION_TOLERANCE_PCT"
+DEFAULT_TOLERANCE_PCT = 40.0
+
+# (record key, human label, direction: -1 lower-is-worse / +1 higher-is-worse)
+REGRESSION_METRICS = (
+    ("value", "mfu", -1),
+    ("decode_tokens_per_sec", "decode tokens/s", -1),
+    ("serve_ttft_p50_ms", "serve ttft p50 ms", +1),
+)
+
+# the trend table's columns (key, header, format)
+_TREND_COLUMNS = (
+    ("value", "mfu", "{:.4f}"),
+    ("tokens_per_sec_per_chip", "tok/s/chip", "{:,.1f}"),
+    ("decode_tokens_per_sec", "decode t/s", "{:,.1f}"),
+    ("serve_ttft_p50_ms", "ttft p50", "{:,.2f}"),
+    ("health_overhead_pct", "health %", "{:.2f}"),
+    ("trace_overhead_pct", "trace %", "{:.2f}"),
+    ("exporter_overhead_pct", "exporter %", "{:.2f}"),
+)
+
+
+def resolve_tolerance_pct(explicit: float | None = None) -> float:
+    if explicit is not None:
+        return float(explicit)
+    raw = os.environ.get(TOLERANCE_ENV)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return DEFAULT_TOLERANCE_PCT
+
+
+def normalize_record(record: dict) -> dict:
+    """Unwrap the driver's `{n, cmd, rc, tail, parsed}` shape to the raw
+    bench summary; a crashed round (parsed null/non-dict) normalizes to an
+    honest `{"value": None, "error": ...}` record."""
+    if "parsed" in record:
+        parsed = record.get("parsed")
+        if not isinstance(parsed, dict):
+            return {
+                "value": None,
+                "error": f"bench crashed before emitting a record "
+                         f"(rc {record.get('rc')})",
+            }
+        return parsed
+    return record
+
+
+def load_history(root: str | Path) -> list[dict]:
+    """Every `BENCH_rNN.json` under `root`, sorted by round number, each
+    normalized and tagged with `round`/`file`. Unreadable files become
+    error rounds rather than disappearing from the trend."""
+    root = Path(root)
+    rounds: list[tuple[int, dict]] = []
+    if not root.is_dir():
+        return []
+    for path in root.iterdir():
+        match = ROUND_RE.match(path.name)
+        if not match:
+            continue
+        n = int(match.group(1))
+        try:
+            record = json.loads(path.read_text())
+            if not isinstance(record, dict):
+                raise ValueError("not a JSON object")
+            record = normalize_record(record)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            record = {"value": None, "error": f"unreadable round: {e}"}
+        record = dict(record)
+        record["round"] = n
+        record["file"] = path.name
+        rounds.append((n, record))
+    return [record for _, record in sorted(rounds, key=lambda item: item[0])]
+
+
+def trend_table(history: list[dict]) -> str:
+    """Human trend table over the rounds (one line per round; absent
+    metrics render as '-')."""
+    header = f"{'round':<6} {'backend':<8} {'model':<10}"
+    for _, title, _fmt in _TREND_COLUMNS:
+        header += f" {title:>11}"
+    lines = [header]
+    for record in history:
+        line = (
+            f"r{record['round']:02d}    "
+            f"{str(record.get('backend') or '?'):<8} "
+            f"{str(record.get('model') or '?'):<10}"
+        )
+        for key, _title, fmt in _TREND_COLUMNS:
+            value = record.get(key)
+            try:
+                cell = fmt.format(float(value)) if value is not None else "-"
+            except (TypeError, ValueError):
+                cell = "-"
+            line += f" {cell:>11}"
+        if record.get("error"):
+            line += f"  [{record['error']}]"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def _comparable(record: dict) -> bool:
+    return record.get("value") is not None
+
+
+def find_comparison(history: list[dict]) -> tuple[dict, dict] | None:
+    """(previous, newest): the newest round with a headline value and the
+    most recent earlier round on the same backend+model. None when the
+    history holds no such pair — that is 'nothing to compare', not a
+    failure."""
+    usable = [r for r in history if _comparable(r)]
+    if len(usable) < 2:
+        return None
+    newest = usable[-1]
+    for record in reversed(usable[:-1]):
+        if (
+            record.get("backend") == newest.get("backend")
+            and record.get("model") == newest.get("model")
+        ):
+            return record, newest
+    return None
+
+
+def check_regression(
+    history: list[dict], tolerance_pct: float | None = None
+) -> dict:
+    """The `--check-regression` verdict: {status, findings, checked,
+    baseline, candidate, tolerance_pct}. `status` is "ok" (no regression
+    or nothing comparable) or "regression"."""
+    tolerance_pct = resolve_tolerance_pct(tolerance_pct)
+    # the round being COMMITTED is the newest by number; one that crashed
+    # before reporting a headline is itself a gate failure — silently
+    # comparing the two previous healthy rounds would green-light exactly
+    # the broken round the gate exists to catch
+    if history and not _comparable(history[-1]):
+        newest = history[-1]
+        return {
+            "status": "regression",
+            "findings": [
+                f"newest round {newest['file']} has no headline value "
+                f"({newest.get('error', 'no value recorded')}) — a round "
+                "too broken to report MFU must not pass the perf gate"
+            ],
+            "checked": [],
+            "candidate": newest["file"],
+            "tolerance_pct": tolerance_pct,
+        }
+    pair = find_comparison(history)
+    if pair is None:
+        return {
+            "status": "ok",
+            "findings": [],
+            "checked": [],
+            "note": (
+                "no same-backend round pair with headline values — "
+                "nothing to compare"
+            ),
+            "tolerance_pct": tolerance_pct,
+        }
+    baseline, candidate = pair
+    findings: list[str] = []
+    checked: list[dict] = []
+    for key, label, direction in REGRESSION_METRICS:
+        try:
+            old = float(baseline[key])
+            new = float(candidate[key])
+        except (KeyError, TypeError, ValueError):
+            continue  # metric absent on one side: skipped, not failed
+        if old == 0:
+            continue
+        delta_pct = 100.0 * (new - old) / abs(old)
+        regressed = direction * delta_pct > tolerance_pct
+        checked.append({
+            "metric": key,
+            "label": label,
+            "baseline": old,
+            "candidate": new,
+            "delta_pct": round(delta_pct, 2),
+            "regressed": regressed,
+        })
+        if regressed:
+            findings.append(
+                f"{label}: r{baseline['round']:02d} {old:g} -> "
+                f"r{candidate['round']:02d} {new:g} "
+                f"({delta_pct:+.1f}%, tolerance {tolerance_pct:g}%)"
+            )
+    return {
+        "status": "regression" if findings else "ok",
+        "findings": findings,
+        "checked": checked,
+        "baseline": baseline["file"],
+        "candidate": candidate["file"],
+        "tolerance_pct": tolerance_pct,
+    }
+
+
+def ledger_main(
+    root: str | Path = ".", tolerance_pct: float | None = None
+) -> int:
+    """`bench.py --check-regression [--bench-dir DIR]` entry: print the
+    trend table + the verdict JSON (last line, machine-readable like every
+    bench record); exit 0 ok / 3 regression / 2 empty history."""
+    history = load_history(root)
+    if not history:
+        print(f"perf-ledger: no BENCH_r*.json rounds under {root}")
+        return 2
+    print(trend_table(history))
+    verdict = check_regression(history, tolerance_pct)
+    print(json.dumps({"stage": "regression_check", **verdict}))
+    return 3 if verdict["status"] == "regression" else 0

@@ -104,17 +104,35 @@ class TelemetryRegistry:
             return self._timers.setdefault(name, Timer(self._lock, self._clock))
 
     def snapshot(self) -> dict[str, float]:
+        # one flatten implementation: telemetry.jsonl (this path) and the
+        # /metrics scrape (snapshot_with_kinds) can never disagree on key
+        # scheme or skip rules
+        return self.snapshot_with_kinds()[0]
+
+    def snapshot_with_kinds(self) -> tuple[dict[str, float], dict[str, str]]:
+        """(values, kinds) under ONE lock hold — the /metrics scrape path
+        (telemetry/exporter.py). Because the flatten happens inside the
+        same critical section every mutation uses, a scrape landing
+        mid-write can never observe a torn metric: a Timer's `_s`/`_n`
+        pair always moves together (tests/test_interleave.py pins the
+        window). Kinds map to Prometheus types: counters and timer
+        accumulators are 'counter', everything else 'gauge'."""
         with self._lock:
-            out: dict[str, float] = {}
+            values: dict[str, float] = {}
+            kinds: dict[str, str] = {}
             for name, counter in self._counters.items():
-                out[name] = counter.value
+                values[name] = counter._value
+                kinds[name] = "counter"
             for name, gauge in self._gauges.items():
-                if gauge.value is not None:
-                    out[name] = gauge.value
+                if gauge._value is not None:
+                    values[name] = gauge._value
+                    kinds[name] = "gauge"
             for name, timer in self._timers.items():
-                out[name + "_s"] = timer.total_s
-                out[name + "_n"] = float(timer.count)
-            return out
+                values[name + "_s"] = timer.total_s
+                values[name + "_n"] = float(timer.count)
+                kinds[name + "_s"] = "counter"
+                kinds[name + "_n"] = "counter"
+            return values, kinds
 
 
 # ---------------------------------------------------------------- current

@@ -702,6 +702,54 @@ def _trace_section(summary: dict | None) -> list[str]:
     return lines
 
 
+def _slo_section(telemetry: dict) -> list[str]:
+    """SLO targets vs reality (`slo/*` gauges from telemetry/slo.py —
+    docs/observability.md#slo): per-target line (target, worst observed,
+    breach count) plus the totals line with the last breach's step /
+    request ordinal. Omitted entirely when the run armed no SLO config —
+    no slo/ keys, no section."""
+    numeric: dict[str, float] = {}
+    for key, value in telemetry.items():
+        if not key.startswith("slo/"):
+            continue
+        try:
+            numeric[key] = float(value)
+        except (TypeError, ValueError):
+            continue
+    if not numeric:
+        return []
+    lines = ["", "== SLO =="]
+    targets = sorted(
+        key[len("slo/"):-len("/target")]
+        for key in numeric if key.endswith("/target")
+    )
+    for name in targets:
+        line = f"{name}: target {numeric[f'slo/{name}/target']:g}"
+        worst = numeric.get(f"slo/{name}/worst")
+        if worst is not None:
+            line += f"  worst {worst:g}"
+        breaches = numeric.get(f"slo/{name}/breaches", 0.0)
+        line += f"  breaches {int(breaches)}"
+        burn = numeric.get(f"slo/{name}/burn_fast")
+        if burn is not None:
+            line += f"  (burn {burn:.1f}x fast"
+            slow = numeric.get(f"slo/{name}/burn_slow")
+            if slow is not None:
+                line += f" / {slow:.1f}x slow"
+            line += ")"
+        lines.append(line)
+    total = numeric.get("slo/breaches_total", 0.0)
+    line = f"breaches: {int(total)} total"
+    last_step = numeric.get("slo/last_breach_step")
+    if last_step is not None:
+        line += f"  last at step {int(last_step)}"
+    last_request = numeric.get("slo/last_breach_request_n")
+    if last_request is not None:
+        line += f"  last at request #{int(last_request)}"
+    lines.append(line)
+    return lines
+
+
 def _counter_section(title: str, rows: list[tuple[str, str]], telemetry: dict) -> list[str]:
     """An event-counter section: one `label: count` line per nonzero
     counter, the whole section omitted when nothing fired — a clean run's
@@ -904,6 +952,7 @@ def render_report(
     ))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
+    lines.extend(_slo_section(telemetry))
     lines.extend(_trace_section(_trace_summary(run_dir)))
     lines.extend(_elastic_section(
         telemetry_records,
@@ -1043,6 +1092,9 @@ def render_report_data(
         "audit": audit_data,
         "inference": _numeric_subset(telemetry, ("decode/", "eval/")),
         "serving": _numeric_subset(telemetry, ("serve/",)),
+        # null when the run armed no SLO config — the structured twin of
+        # the text section's absent-config omission
+        "slo": _numeric_subset(telemetry, ("slo/",)),
         "elastic": elastic,
         "trace": _trace_summary(run_dir),
         "recovery": _numeric_subset(telemetry, ("resilience/",)),

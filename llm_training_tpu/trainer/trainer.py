@@ -218,6 +218,11 @@ class Trainer:
         # (fit then raises PreemptionInterrupt after the emergency save)
         self._shutdown: GracefulShutdown | None = None
         self._watchdog: HangWatchdog | None = None
+        # live telemetry (built per fit, both optional): the /metrics //
+        # statusz//healthz exporter (LLMT_METRICS_PORT) and the SLO
+        # burn-rate monitor (LLMT_SLO_*) — docs/observability.md
+        self._exporter = None
+        self._slo = None
         self._preempted = False
         # rollback-and-skip recovery (resilience/recovery.py): built per fit
         # when cfg.resilience.recovery is set; the save path persists its
@@ -674,6 +679,35 @@ class Trainer:
                 registry=self.telemetry,
                 action=resil.watchdog_action,
             ).start()
+        # SLO monitor (docs/observability.md#slo): armed only when
+        # LLMT_SLO_* targets are set — otherwise zero cost. The step loop
+        # feeds it optimizer-step intervals and goodput; breaches bump
+        # slo/* counters and flight-dump the trace ring into the run dir —
+        # process 0 only, like every run-dir artifact (N hosts breaching
+        # together would clobber one dump file)
+        from llm_training_tpu.telemetry.slo import build_slo_monitor
+
+        self._slo = build_slo_monitor(
+            registry=self.telemetry,
+            run_dir=run_dir if jax.process_index() == 0 else None,
+        )
+        # live-telemetry exporter (docs/observability.md#live-telemetry):
+        # /metrics (registry + ledger), /statusz (phase, step, segment),
+        # /healthz (red on a stale watchdog beat). LLMT_METRICS_PORT=0/unset
+        # disables; a port collision degrades to a warning, never a crash.
+        from llm_training_tpu.resilience.elastic import segment_attempt
+        from llm_training_tpu.telemetry.exporter import start_exporter
+
+        self._exporter = start_exporter(
+            registry=self.telemetry,
+            ledger=self.ledger,
+            watchdog=self._watchdog,
+            slo=self._slo,
+            status_fn=lambda: {
+                "step": self.last_step,
+                "segment": segment_attempt(),
+            },
+        )
         # trace sink (docs/observability.md#tracing): lifecycle events land
         # in <run_dir>/trace.jsonl; per-step spans only with
         # LLMT_TRACE_TRAIN=1. Process 0 only — run-dir artifacts follow the
@@ -686,6 +720,10 @@ class Trainer:
             with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
                 return self._fit_inner(objective, datamodule, resume_step, state)
         finally:
+            if self._exporter is not None:
+                self._exporter.stop()
+                self._exporter = None
+            self._slo = None
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
@@ -1010,6 +1048,10 @@ class Trainer:
             start_step0 = seg_start // cfg.accumulate_grad_batches
             first_process_step = start_step0 + 1
             window_time, window_step = time.perf_counter(), start_step0
+            # SLO step-cadence anchor (host-observed optimizer-step
+            # intervals); reset per segment so a resume's restore/compile
+            # never bills as one giant slow step
+            slo_step_t: float | None = None
             try:
                 # constructed inside the try so an exception anywhere after
                 # the worker thread starts still reaches prefetcher.close()
@@ -1121,6 +1163,13 @@ class Trainer:
                         continue
                     step = (micro + 1) // cfg.accumulate_grad_batches
                     self.last_step = step
+                    if self._slo is not None:
+                        now_step = time.perf_counter()
+                        if slo_step_t is not None:
+                            self._slo.observe_step(
+                                now_step - slo_step_t, step=step
+                            )
+                        slo_step_t = now_step
                     # fresh (non-donated) device arrays; callbacks that need wall-
                     # clock accuracy can jax.block_until_ready(trainer.last_metrics)
                     self.last_metrics = metrics
@@ -1178,6 +1227,12 @@ class Trainer:
                         # persist the goodput breakdown, device gauges, and
                         # registry snapshot (compile_time_s, data/*, checkpoint/*)
                         metrics.update(self.ledger.summary())
+                        if self._slo is not None:
+                            # before the snapshot below, so this log step's
+                            # record carries the fresh slo/* burn gauges
+                            self._slo.observe_goodput(
+                                float(metrics["goodput/goodput_pct"]), step=step
+                            )
                         metrics.update(hbm_gauges())
                         metrics.update(self.telemetry.snapshot())
                         logger.info(
@@ -1229,6 +1284,10 @@ class Trainer:
                     # or a SIGKILL — the hard death only `supervise` survives
                     chaos = get_chaos()
                     if chaos is not None:
+                        # slow-step first: the injected dead time lands in
+                        # the NEXT boundary's SLO interval like a real
+                        # sustained regression would
+                        chaos.maybe_slow_step(step)
                         chaos.maybe_sigterm(step)
                         chaos.maybe_sigkill(step, fresh_start)
 

@@ -121,13 +121,36 @@ grep -q "perplexity" "${SMOKE_ROOT}/report_infer.log"
 # a request while another was mid-decode); then the merged serve/* gauges
 # must render as report's == Serving == section
 echo "== precommit: serve smoke (continuous-batching loadgen -> report) =="
+# --metrics-port: the loadgen scrapes the child's /metrics exporter
+# throughout and cross-checks serve/requests_completed + queue-depth
+# gauges against its own client census at the all-terminal moment —
+# exporter/engine drift exits nonzero (docs/observability.md#live-telemetry).
+# Ports are OS-assigned free ones (bind-then-release), never hardcoded: a
+# stale holder on a fixed port would fail a healthy commit — or worse,
+# answer scrapes for the wrong process
+free_port() {
+    python -c 'from llm_training_tpu.telemetry.exporter import find_free_port; print(find_free_port())'
+}
+SERVE_METRICS_PORT=$(free_port)
 JAX_PLATFORMS=cpu python scripts/serve_loadgen.py \
     --config config/examples/smoke/cpu-smoke.yaml \
     --requests 4 --max-new-tokens 16 \
+    --metrics-port "${SERVE_METRICS_PORT}" \
     --out "${SMOKE_ROOT}/serve_loadgen.json" \
     "run_root=${SMOKE_ROOT}" --max-batch 2 --max-model-len 64 \
     --prefill-chunk 4 --eos-token-id -1 \
     | tee "${SMOKE_ROOT}/serve_smoke.log"
+python - "${SMOKE_ROOT}/serve_loadgen.json" <<'EOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+scrape = doc["scrape"]
+assert scrape["scrapes_ok"] >= 1, scrape
+assert not scrape["parse_errors"], scrape["parse_errors"]
+final = scrape["final"]
+assert final["llmt_serve_requests_completed"] == doc["completed"], (final, doc)
+assert "llmt_serve_ttft_p50_ms" in final and "llmt_serve_tpot_p50_ms" in final
+print("serve scrape cross-check: OK —", scrape["scrapes_ok"], "scrapes")
+EOF
 JAX_PLATFORMS=cpu python -m llm_training_tpu report "${SMOKE_ROOT}/smoke/cpu-smoke" \
     | tee "${SMOKE_ROOT}/report_serve.log"
 grep -q "== Serving ==" "${SMOKE_ROOT}/report_serve.log"
@@ -166,10 +189,15 @@ assert doc["error_chunks"] >= 2, f"malformed flood unanswered: {doc}"
 print("serve drain: OK —", int(doc["engine"]["serve/replayed_requests"]),
       "replayed,", doc["terminal_reasons"])
 EOF
+# --metrics-port on the stall leg: while the chaos stall wedges the
+# engine, /healthz must flip 503 BEFORE the 5s watchdog SIGABRTs — the
+# scraper records the red window (docs/observability.md#live-telemetry)
+STALL_METRICS_PORT=$(free_port)
 JAX_PLATFORMS=cpu LLMT_CHAOS_SERVE_STALL_STEP=4 \
     python scripts/serve_loadgen.py \
     --config config/examples/smoke/cpu-smoke.yaml \
     --requests 3 --max-new-tokens 12 --supervised \
+    --metrics-port "${STALL_METRICS_PORT}" \
     --out "${SMOKE_ROOT}/serve_stall.json" \
     "run_root=${SMOKE_ROOT}" --max-batch 2 --max-model-len 64 \
     --prefill-chunk 4 --eos-token-id -1 --drain-timeout-s 0 \
@@ -182,7 +210,10 @@ import json, sys
 doc = json.load(open(sys.argv[1]))
 assert not doc["errors"], doc["errors"]
 assert doc["engine"]["serve/replayed_requests"] >= 1, doc["engine"]
-print("serve stall: OK —", doc["terminal_reasons"])
+assert doc["scrape"]["unhealthy_observed"], (
+    "healthz never flipped red during the stall: %s" % doc["scrape"])
+print("serve stall: OK —", doc["terminal_reasons"],
+      "| healthz flipped red before the watchdog fired")
 EOF
 
 # trace gate (docs/observability.md#tracing): the fit (train track) and the
@@ -216,13 +247,30 @@ python - "${SMOKE_ROOT}/report.json" <<'EOF'
 import json, sys
 doc = json.load(open(sys.argv[1]))
 assert doc["schema_version"] == 1, doc.get("schema_version")
-for key in ("training", "goodput", "serving", "trace", "telemetry"):
+for key in ("training", "goodput", "serving", "slo", "trace", "telemetry"):
     assert key in doc, f"report json missing {key!r}"
 assert doc["goodput"]["goodput/total_s"] > 0
 assert doc["trace"]["events"] > 0 and doc["trace"]["requests_completed"] > 0
 assert doc["serving"]["serve/requests_completed"] > 0
 print("report json: OK", doc["trace"]["events"], "trace events")
 EOF
+
+# exporter-smoke gate (docs/observability.md#live-telemetry): a cpu-smoke
+# fit with the exporter armed is scraped MID-FIT (/metrics must be
+# parse-valid Prometheus with goodput + slo series, /healthz 200 for a
+# slow-but-alive fit), while the slow-step chaos hook injects a sustained
+# slow regime the SLO burn-rate monitor must page on — asserting the
+# breach counter in telemetry.jsonl, a trace-flight-slo-*.jsonl ring
+# dump, and report's == SLO == section
+echo "== precommit: exporter smoke (live scrape + chaos SLO breach) =="
+python scripts/exporter_smoke.py "${SMOKE_ROOT}/exporter-smoke"
+
+# perf-regression ledger gate (docs/performance.md#perf-ledger): the
+# committed BENCH_r*.json history must parse and gate clean — a newly
+# committed round that regressed same-backend MFU / decode rate / TTFT
+# beyond tolerance fails the commit here, not on the next TPU round
+echo "== precommit: perf ledger (BENCH round regression check) =="
+python bench.py --check-regression
 
 # NaN-provenance + auto-recovery gates: a forced non-finite micro-fit must
 # name the offending layer path in the NonFiniteLossError AND write an
@@ -277,9 +325,10 @@ grep -q "bench record: bench_dry.json" "${SMOKE_ROOT}/report_perf.log"
 # summary stays parseable (the r04/r05 failure mode, made survivable)
 echo "== precommit: bench chaos wedge (degrade-not-die) =="
 rc=0
-# BENCH_TRACE=0: the short RUN_TIMEOUT that kills the wedged train stage
-# would also fuse a legitimate trace-stage fit
+# BENCH_TRACE=0 / BENCH_EXPORTER=0: the short RUN_TIMEOUT that kills the
+# wedged train stage would also fuse the legitimate A/B-fit stages
 BENCH_CHAOS_WEDGE=train BENCH_RUN_TIMEOUT=15 BENCH_HEALTH=0 BENCH_TRACE=0 \
+    BENCH_EXPORTER=0 \
     python bench.py --dry | tee "${SMOKE_ROOT}/bench_wedge.log" || rc=$?
 test "$rc" -eq 1  # train (the headline) failed -> documented exit 1
 python - "${SMOKE_ROOT}/bench_wedge.log" <<'EOF'

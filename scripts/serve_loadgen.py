@@ -17,6 +17,12 @@ the real JSONL stdin/stdout protocol. Two arrival modes:
 latency budget (mixed traffic: some requests carry deadlines, some don't),
 and `--malformed N` interleaves N junk lines the server must answer with
 `{"type": "error"}` chunks while everything well-formed still terminates.
+`--metrics-port N` additionally scrapes the child's live-telemetry
+exporter (/metrics + /healthz, docs/observability.md#live-telemetry)
+throughout the run: every scrape must parse as Prometheus text, and at
+the moment every request has its terminal the final scrape's
+`serve/requests_completed` and queue-depth gauges must MATCH this
+driver's client-side census — exporter/engine drift is a failure.
 
 The terminal contract this driver enforces (exit nonzero on violation) is
 the serving tier's resilience acceptance: every submitted request must end
@@ -52,12 +58,122 @@ import subprocess
 import sys
 import threading
 import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# the ONE strict scrape parser, shared with the precommit exporter smoke
+# and the unit tests so format drift fails identically everywhere; the
+# telemetry package surface is jax-free at import time by contract, so
+# this parent stays backend-free
+from llm_training_tpu.telemetry.exporter import parse_prometheus_text  # noqa: E402
 
 # the terminal states the protocol may end a request in — anything else
 # (or anything twice, or nothing at all) is a dropped/duplicated stream
 TERMINAL_REASONS = (
     "eos", "max_tokens", "deadline", "overloaded", "rejected", "capacity"
 )
+
+
+class ExporterScraper:
+    """Polls the serve child's /metrics + /healthz during the run
+    (docs/observability.md#live-telemetry). Connection failures are
+    expected (child starting up / relaunching) and only counted; a scrape
+    that ANSWERS but fails to parse is a recorded error. `scrape_final()`
+    is called synchronously the moment every request has its terminal —
+    at that instant the engine is quiescent (nothing queued or running),
+    so the gauge cross-check against the client census is exact."""
+
+    def __init__(self, port: int, interval_s: float = 0.2):
+        import urllib.request as _request
+
+        self._request = _request
+        self.base = f"http://127.0.0.1:{port}"
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        self.ok = 0  # guarded by: _lock
+        self.failed = 0  # guarded by: _lock
+        self.parse_errors: list[str] = []  # guarded by: _lock
+        self.unhealthy_observed = False  # guarded by: _lock
+        self.max_queue_depth = 0.0  # guarded by: _lock
+        self.final: dict[str, float] | None = None  # guarded by: _lock
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> "ExporterScraper":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _get(self, path: str):
+        return self._request.urlopen(self.base + path, timeout=2.0)
+
+    def scrape_once(self) -> dict[str, float] | None:
+        """One scrape (network I/O outside the lock; called from both the
+        poll thread and the main thread's final-census moment)."""
+        try:
+            with self._get("/metrics") as resp:
+                body = resp.read().decode("utf-8", "replace")
+        except OSError:
+            with self._lock:
+                self.failed += 1  # child starting/relaunching: expected
+            return None
+        try:
+            metrics = parse_prometheus_text(body)
+        except ValueError as e:
+            with self._lock:
+                self.parse_errors.append(str(e))
+            return None
+        with self._lock:
+            self.ok += 1
+            self.max_queue_depth = max(
+                self.max_queue_depth, metrics.get("llmt_serve_queue_depth", 0.0)
+            )
+        return metrics
+
+    def _check_health(self) -> None:
+        try:
+            with self._get("/healthz"):
+                pass  # 200
+        except OSError as e:
+            if getattr(e, "code", None) == 503:
+                with self._lock:
+                    self.unhealthy_observed = True
+            # anything else: child down/starting — not a health verdict
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.scrape_once()
+            self._check_health()
+
+    def scrape_final(self) -> None:
+        # bounded retry: the child races from its last terminal through
+        # stats/telemetry-write to exporter.stop(), and losing that race
+        # must not turn a healthy run into a spurious census failure — the
+        # engine is quiescent, so a slightly later scrape reads the same
+        # gauges
+        metrics = None
+        for _ in range(10):
+            metrics = self.scrape_once()
+            if metrics is not None:
+                break
+            time.sleep(0.1)
+        with self._lock:
+            self.final = metrics
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {
+                "scrapes_ok": self.ok,
+                "scrapes_failed": self.failed,
+                "parse_errors": list(self.parse_errors),
+                "unhealthy_observed": self.unhealthy_observed,
+                "max_queue_depth": self.max_queue_depth,
+                "final": dict(self.final) if self.final else None,
+            }
 
 
 def percentile(values: list[float], q: float) -> float:
@@ -151,6 +267,15 @@ def main() -> int:
         "--idle-timeout-s", type=float, default=600.0,
         help="kill the child when no stdout line lands for this long",
     )
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="scrape the serve child's /metrics + /healthz exporter "
+        "(docs/observability.md#live-telemetry) on this port during the "
+        "run and cross-check serve/requests_completed + queue-depth "
+        "gauges against the client-side census (exporter/engine drift is "
+        "a failure). The child must run with LLMT_METRICS_PORT set to the "
+        "same port; 0 = no scraping",
+    )
     parser.add_argument("--out", default=None, help="also write the summary JSON here")
     parser.add_argument(
         "serve_args", nargs="*",
@@ -162,9 +287,19 @@ def main() -> int:
     args.serve_args += passthrough
 
     requests = build_requests(args)
+    child_env = None
+    if args.metrics_port:
+        # the child reads LLMT_METRICS_PORT itself; setting it here keeps
+        # one flag driving both sides (and supervise's env passthrough
+        # carries it across relaunches)
+        child_env = {**os.environ, "LLMT_METRICS_PORT": str(args.metrics_port)}
     child = subprocess.Popen(
         build_child_argv(args),
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, bufsize=1,
+        env=child_env,
+    )
+    scraper = (
+        ExporterScraper(args.metrics_port).start() if args.metrics_port else None
     )
 
     submit_s: dict[str, float] = {}
@@ -248,6 +383,13 @@ def main() -> int:
                 # request that never streams wedges the run until the idle
                 # timeout
                 first_token_seen.set()
+                if scraper is not None and all(
+                    r["id"] in done for r in requests
+                ):
+                    # every request just went terminal: the engine is
+                    # quiescent NOW (nothing queued or running), so this
+                    # synchronous scrape is the exact-census moment
+                    scraper.scrape_final()
             elif kind == "stats":
                 stats = event["stats"]  # last record wins across relaunches
             elif kind == "error":
@@ -297,6 +439,50 @@ def main() -> int:
             "--max-new-tokens or check --max-batch > 1"
         )
 
+    # --- exporter cross-check (--metrics-port): the live gauges must agree
+    # with this driver's own census — scraped-vs-client drift means the
+    # exporter (or the engine state it renders) is lying to the fleet
+    scrape_summary = None
+    if scraper is not None:
+        scraper.stop()
+        scrape_summary = scraper.summary()
+        if scrape_summary["parse_errors"]:
+            failures.append(
+                "scrape parse errors (exporter format drift?): "
+                f"{scrape_summary['parse_errors'][:3]}"
+            )
+        if scrape_summary["scrapes_ok"] == 0:
+            failures.append(
+                "--metrics-port set but /metrics was never scrapeable"
+            )
+        final = scrape_summary["final"]
+        if final is None:
+            failures.append(
+                "no parse-valid scrape at the all-terminal moment"
+            )
+        else:
+            for gauge in ("llmt_serve_queue_depth", "llmt_serve_running"):
+                if final.get(gauge, 0.0) != 0.0:
+                    failures.append(
+                        f"engine not quiescent at the final scrape: "
+                        f"{gauge} = {final[gauge]}"
+                    )
+            if not args.supervised:
+                # a supervised run's relaunched engine only counts its own
+                # segment's completions; the strict census equality is an
+                # unsupervised-run contract
+                client_completed = sum(
+                    1 for event in done.values()
+                    if event.get("stop_reason") in ("eos", "max_tokens")
+                )
+                scraped = final.get("llmt_serve_requests_completed")
+                if scraped != float(client_completed):
+                    failures.append(
+                        f"exporter/engine drift: scraped "
+                        f"requests_completed {scraped} != client census "
+                        f"{client_completed}"
+                    )
+
     ttft = [
         1000.0 * (first_token_s[r] - submit_s[r]) for r in first_token_s
     ]
@@ -317,6 +503,8 @@ def main() -> int:
         "errors": failures,
         "engine": stats,
     }
+    if scrape_summary is not None:
+        summary["scrape"] = scrape_summary
     if ttft:
         summary["client_ttft_p50_ms"] = round(percentile(ttft, 50), 3)
         summary["client_ttft_p99_ms"] = round(percentile(ttft, 99), 3)

@@ -406,3 +406,102 @@ def test_watchdog_dump_paths_guarded_against_poll_thread(tmp_path):
         # the reader saw either nothing (scheduled first) or the full path
         assert len(seen["paths"]) in (0, 1)
         assert len(watchdog.dump_paths) == 1
+
+
+# ------------------------------------ exporter: snapshot-under-scrape window
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_registry_snapshot_never_observes_a_torn_metric_under_scrape(seed):
+    """The /metrics scrape path (telemetry/exporter.py) takes ONE
+    registry snapshot while writer threads publish — the ISSUE's 'scrape
+    mid-write must never observe a torn counter' window. A Timer's
+    add() mutates its `total_s`/`count` pair inside one critical section
+    and snapshot_with_kinds() flattens inside the same one, so under
+    EVERY schedule each snapshot sees the pair move together: probe_s ==
+    probe_n always (each add() contributes exactly 1.0s and 1 count). A
+    snapshot taken between the two field writes would break the
+    equality — this test fails against that shape."""
+    from llm_training_tpu.telemetry.registry import TelemetryRegistry
+
+    run = Interleaver(seed=seed)
+    with instrumented_locks(run):
+        registry = TelemetryRegistry()
+    registry._lock.rename("registry")
+    # metric objects created OUTSIDE the scheduled threads (plain-lock
+    # semantics for setup), mutated inside them
+    timer = registry.timer("exporter/probe")
+    counter = registry.counter("exporter/events")
+    snapshots = []
+
+    def writer():
+        for n in range(4):
+            sched_point(f"write:{n}")
+            timer.add(1.0)
+            counter.inc()
+
+    def scraper():
+        for n in range(5):
+            sched_point(f"scrape:{n}")
+            values, kinds = registry.snapshot_with_kinds()
+            snapshots.append(values)
+            assert kinds.get("exporter/events") == "counter"
+
+    run.thread(writer, name="writer")
+    run.thread(scraper, name="scrape")
+    run.run()
+    assert snapshots
+    for values in snapshots:
+        assert values.get("exporter/probe_s", 0.0) == values.get(
+            "exporter/probe_n", 0.0
+        ), values
+        # counters are monotone floats committed whole
+        assert values.get("exporter/events", 0.0) in (0.0, 1.0, 2.0, 3.0, 4.0)
+    final, _ = registry.snapshot_with_kinds()
+    assert final["exporter/probe_n"] == 4.0 and final["exporter/events"] == 4.0
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_slo_observe_vs_scrape_read_obeys_lock_order(seed, tmp_path):
+    """The serve loop observing requests (slo lock -> breach emission into
+    registry/trace AFTER release) racing the exporter's statusz read
+    (last_alert) — no deadlock under any schedule, and every recorded
+    acquisition edge is consistent with contracts.LOCK_ORDER."""
+    from llm_training_tpu.telemetry.registry import TelemetryRegistry
+    from llm_training_tpu.telemetry.slo import SLOMonitor, specs_from_config
+
+    run = Interleaver(seed=seed)
+    t = {"now": 0.0}
+    with instrumented_locks(run):
+        registry = TelemetryRegistry()
+        monitor = SLOMonitor(
+            specs_from_config({"serve": {"ttft_p99_ms": 10.0}}),
+            registry=registry, clock=lambda: t["now"],
+            fast_window_s=10.0, slow_window_s=60.0, fast_burn=2.0,
+            slow_burn=2.0, min_events=2, cooldown_s=100.0,
+        )
+    registry._lock.rename("registry")
+    monitor._lock.rename("slo")
+    alerts = []
+
+    def serve_loop():
+        for n in range(4):
+            sched_point(f"observe:{n}")
+            t["now"] += 1.0
+            monitor.observe_request(ttft_ms=100.0, ok=True)
+
+    def scrape():
+        for n in range(4):
+            sched_point(f"statusz:{n}")
+            alerts.append(monitor.last_alert())
+            registry.snapshot_with_kinds()
+
+    run.thread(serve_loop, name="serve")
+    run.thread(scrape, name="scrape")
+    run.run()
+    run.assert_lock_order()
+    # the breach fired and a later statusz read could see it whole
+    assert monitor.breach_count() == 1
+    seen = [a for a in alerts if a is not None]
+    for alert in seen:
+        assert alert["key"] == "serve/ttft_p99_ms" and "burn_fast" in alert

@@ -326,10 +326,13 @@ def test_report_json_schema(tmp_path, monkeypatch):
     assert doc["schema_version"] == REPORT_SCHEMA_VERSION == 1
     for key in (
         "run_dir", "world", "training", "goodput", "device_memory",
-        "health", "perf", "audit", "inference", "serving", "elastic",
-        "trace", "recovery", "flash", "telemetry",
+        "health", "perf", "audit", "inference", "serving", "slo",
+        "elastic", "trace", "recovery", "flash", "telemetry",
     ):
         assert key in doc, key
+    # no SLO config armed in the fixture -> null block, like the omitted
+    # text section (tests/test_exporter.py pins the armed shape)
+    assert doc["slo"] is None
     assert doc["training"]["records"] == 2
     assert doc["training"]["loss_last"] == pytest.approx(1.8)
     assert doc["goodput"]["goodput/goodput_pct"] == 80.0
