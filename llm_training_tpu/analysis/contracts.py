@@ -61,6 +61,11 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "exporter's scrape thread; breach evaluation must never pay a "
         "backend import or a wedged device stalls the alert that reports it"
     ),
+    "llm_training_tpu/telemetry/fleet.py": (
+        "the fleet aggregator is a scrape PARENT like the loadgen: it "
+        "must keep sweeping while replicas own backends, and the fleet "
+        "CLI must run on operator machines that have none"
+    ),
     "llm_training_tpu/telemetry/perf_ledger.py": (
         "the bench PARENT (itself jax-free) imports the regression ledger; "
         "the --check-regression gate must run on any machine the repo is "
@@ -164,6 +169,11 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
         "steps while the exporter's scrape threads read last_alert() and "
         "breach counts",
     },
+    "llm_training_tpu/telemetry/fleet.py": {
+        "FleetAggregator": "the background sweep loop publishes snapshots "
+        "while the federation server's per-request handler threads render "
+        "them and the owner starts/stops the aggregator",
+    },
     "llm_training_tpu/serve/journal.py": {
         "RequestJournal": "the serve CLI journals deliveries from its "
         "stdin reader thread while the engine journals progress from the "
@@ -194,6 +204,9 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
 # watchdog locks wrap policy decisions and sort first.
 LOCK_ORDER = (
     "chaos",     # resilience/chaos.py Chaos._lock + _active_lock
+    "fleet",     # telemetry/fleet.py FleetAggregator._lock (snapshot swap
+                 # only; sweeps compose — scrapes, rollups, the SLO feed —
+                 # entirely outside it, so no edge into slo/registry)
     "exporter",  # telemetry/exporter.py MetricsExporter._lock (scrape
                  # counters only; handlers compose responses WITHOUT
                  # holding it while calling other subsystems)

@@ -1,5 +1,5 @@
 """Console entry: fit / validate / generate / serve / evaluate / report /
-trace / watch / supervise.
+trace / watch / fleet / supervise.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -358,6 +358,7 @@ def _run_serve(args, config: dict) -> int:
         registry=get_registry(),
         watchdog=watchdog,
         slo=slo,
+        role="serve",
         extra_fn=engine.live_stats,
         status_fn=lambda: {
             "engine step": engine._step_index,
@@ -879,13 +880,57 @@ def main(argv: list[str] | None = None) -> int:
         "Perfetto (docs/observability.md#tracing)",
     )
     trace.add_argument(
-        "source",
+        "source", nargs="?", default=None,
         help="run directory holding trace.jsonl, or a trace/flight-dump "
         "jsonl file directly",
     )
     trace.add_argument(
         "--out", default=None,
         help="output path (default: trace-export.json next to the source)",
+    )
+    trace.add_argument(
+        "--merge", nargs="+", default=None, metavar="DIR",
+        help="instead of one source: wall-align N run dirs (via their "
+        "clock_anchor events) into ONE Perfetto file with per-replica "
+        "tracks (docs/observability.md#fleet)",
+    )
+    fleet = sub.add_parser(
+        "fleet",
+        help="sweep a fleet of replicas (LLMT_FLEET_DIR cards or static "
+        "--targets) and render rollups + health verdict; optionally "
+        "re-export federation /metrics (docs/observability.md#fleet)",
+    )
+    fleet.add_argument(
+        "--dir", default=None,
+        help="discovery directory holding replica-*.json cards "
+        "(default: LLMT_FLEET_DIR)",
+    )
+    fleet.add_argument(
+        "--targets", default="",
+        help="static host:port,host:port replica list (skips discovery)",
+    )
+    fleet.add_argument(
+        "--interval-s", type=float, default=None,
+        help="sweep cadence (default: LLMT_FLEET_SCRAPE_S, else 2s)",
+    )
+    fleet.add_argument(
+        "--port", type=int, default=None,
+        help="also serve the aggregator's /metrics //fleetz //healthz "
+        "federation endpoint on this port",
+    )
+    fleet.add_argument(
+        "--once", action="store_true",
+        help="one sweep then exit (exit 2, naming the searched paths, "
+        "when no replicas are found)",
+    )
+    fleet.add_argument(
+        "--json", action="store_true",
+        help="emit the raw snapshot JSON instead of the fleetz one-pager",
+    )
+    fleet.add_argument(
+        "--out", default=None,
+        help="also write the snapshot JSON here (a run dir's fleet.json "
+        "is what `report --format json` surfaces as its fleet block)",
     )
     supervise = sub.add_parser(
         "supervise",
@@ -952,7 +997,17 @@ def main(argv: list[str] | None = None) -> int:
         # stdlib-only like report: exports run anywhere the dir is mounted
         from llm_training_tpu.telemetry.trace import trace_main
 
-        return trace_main(args.source, out=args.out)
+        return trace_main(args.source, out=args.out, merge=args.merge)
+    if args.command == "fleet":
+        # stdlib-only: the aggregator is a scrape parent — it must run on
+        # operator machines with no backend while replicas own theirs
+        from llm_training_tpu.telemetry.fleet import fleet_main
+
+        return fleet_main(
+            fleet_dir=args.dir, targets=args.targets,
+            interval_s=args.interval_s, port=args.port,
+            once=args.once, as_json=args.json, out=args.out,
+        )
     if args.command == "watch":
         # stdlib-only: the watcher polls a running process's exporter and
         # must never pay a backend import (or it could not watch a wedged

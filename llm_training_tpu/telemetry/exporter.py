@@ -107,13 +107,25 @@ def _prom_value(value: float) -> str:
     return repr(value)
 
 
-def parse_prometheus_text(text: str) -> dict[str, float]:
-    """Strict inverse of `render_prometheus` (no labels — this exporter
-    emits none): {key_name: value}. Raises ValueError on ANY malformed
-    line, so scrape validators (the loadgen cross-check, the precommit
-    exporter smoke, the unit tests) all fail loudly — and identically —
-    on format drift. Stdlib-only like the rest of this module; both
-    jax-free script parents import it."""
+# strict label block: `{key="value",...}` — no spaces, no escapes, no
+# trailing comma; exactly what the fleet federation endpoint emits
+_LABELS_RE = re.compile(
+    r"\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"\\]*\")*\}"
+)
+
+
+def parse_prometheus_text(text: str, labels: bool = False) -> dict[str, float]:
+    """Strict inverse of `render_prometheus`: {key_name: value}. Raises
+    ValueError on ANY malformed line, so scrape validators (the loadgen
+    cross-check, the precommit smokes, the unit tests) all fail loudly —
+    and identically — on format drift. Stdlib-only like the rest of this
+    module; the jax-free script parents import it.
+
+    Per-process exporters emit no labels, so the default rejects them.
+    `labels=True` (the fleet aggregator's federation output) accepts a
+    strict `name{key="value",...}` block and keys the result by the FULL
+    labeled name — distinct replicas stay distinct samples."""
     metrics: dict[str, float] = {}
     for line in text.splitlines():
         if not line.strip():
@@ -126,7 +138,11 @@ def parse_prometheus_text(text: str) -> dict[str, float]:
         if len(parts) != 2:
             raise ValueError(f"bad sample line: {line!r}")
         name, raw = parts
-        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", name):
+        bare, brace, label_block = name.partition("{")
+        if brace:
+            if not labels or not _LABELS_RE.fullmatch(brace + label_block):
+                raise ValueError(f"bad label block: {name!r}")
+        if not re.fullmatch(r"[a-zA-Z_:][a-zA-Z0-9_:]*", bare):
             raise ValueError(f"bad metric name: {name!r}")
         try:
             metrics[name] = float(raw)
@@ -135,6 +151,22 @@ def parse_prometheus_text(text: str) -> dict[str, float]:
     if not metrics:
         raise ValueError("scrape held no samples")
     return metrics
+
+
+def parse_prometheus_kinds(text: str) -> dict[str, str]:
+    """{metric_name: 'counter'|'gauge'} from the `# TYPE` lines — the
+    fleet aggregator needs kinds to roll up correctly (counters sum,
+    gauges spread min/mean/max). Same strictness posture: a malformed
+    TYPE line raises."""
+    kinds: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line.startswith("# TYPE "):
+            continue
+        parts = line.split()
+        if len(parts) != 4 or parts[3] not in ("counter", "gauge"):
+            raise ValueError(f"bad TYPE line: {line!r}")
+        kinds[parts[2]] = parts[3]
+    return kinds
 
 
 def render_prometheus(
@@ -187,6 +219,7 @@ class MetricsExporter:
         status_fn=None,
         stale_after_s: float | None = None,
         host: str = "",
+        role: str = "train",
         clock=time.monotonic,
     ):
         self.requested_port = int(port)
@@ -197,6 +230,9 @@ class MetricsExporter:
         self.extra_fn = extra_fn
         self.status_fn = status_fn
         self.host = host
+        # fleet discovery role (train|serve|bench) stamped on the replica
+        # card when LLMT_FLEET_DIR is armed (docs/observability.md#fleet)
+        self.role = role
         self._clock = clock
         # /healthz turns red at HALF the watchdog window by default: early
         # enough that a scraper sees the wedge before the SIGABRT
@@ -210,6 +246,7 @@ class MetricsExporter:
         self.port: int | None = None  # bound port; guarded by: _lock
         self._scrapes = 0  # guarded by: _lock
         self._errors = 0  # guarded by: _lock
+        self._card_path = None  # fleet discovery card; guarded by: _lock
 
     # ----------------------------------------------------------- lifecycle
 
@@ -243,6 +280,21 @@ class MetricsExporter:
             "metrics exporter listening on port %d "
             "(/metrics /statusz /healthz)", self.port,
         )
+        # fleet discovery (docs/observability.md#fleet): an armed exporter
+        # announces itself by card so an aggregator can find the fleet
+        # without static config. Lazy import — fleet imports THIS module
+        # at module level; both stay jax-free either way.
+        from llm_training_tpu.telemetry.fleet import (
+            resolve_fleet_dir,
+            write_replica_card,
+        )
+
+        fleet_dir = resolve_fleet_dir()
+        card = None
+        if fleet_dir is not None:
+            card = write_replica_card(fleet_dir, port=self.port, role=self.role)
+        with self._lock:
+            self._card_path = card
         return True
 
     def stop(self) -> None:
@@ -252,6 +304,13 @@ class MetricsExporter:
         with self._lock:
             server, self._server = self._server, None
             thread, self._thread = self._thread, None
+            card, self._card_path = self._card_path, None
+        if card is not None:
+            # clean stop removes the discovery card; a SIGKILL cannot, and
+            # the aggregator's stale-pid check is what covers that hole
+            from llm_training_tpu.telemetry.fleet import remove_replica_card
+
+            remove_replica_card(card)
         if server is not None:
             server.shutdown()
             server.server_close()

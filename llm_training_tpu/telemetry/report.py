@@ -655,6 +655,66 @@ def _trace_summary(run_dir: Path) -> dict | None:
     return summarize_trace(read_trace_events(path))
 
 
+def _fleet_summary(run_dir: Path) -> dict | None:
+    """The fleet snapshot a `fleet --out <run_dir>/fleet.json` sweep left
+    behind (docs/observability.md#fleet), shaped for trend tracking:
+    verdict + per-replica health + rollups, without the per-replica
+    metric bulk. None when the run never swept a fleet; a
+    present-but-unparseable file returns an honest error record."""
+    path = run_dir / "fleet.json"
+    if not path.is_file():
+        return None
+    try:
+        snapshot = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return {"error": f"{path.name} unparseable"}
+    if not isinstance(snapshot, dict):
+        return {"error": f"{path.name} is not a snapshot object"}
+    replicas = {}
+    for rid, entry in (snapshot.get("replicas") or {}).items():
+        if isinstance(entry, dict):
+            replicas[rid] = {
+                key: entry.get(key)
+                for key in ("role", "healthy", "stale", "error", "attempt")
+            }
+    return {
+        "verdict": snapshot.get("verdict"),
+        "sweeps": snapshot.get("sweeps"),
+        "replicas": replicas,
+        "red": snapshot.get("red"),
+        "stale_cards": snapshot.get("stale_cards"),
+        "rollup": snapshot.get("rollup"),
+    }
+
+
+def _fleet_section(summary: dict | None) -> list[str]:
+    """`== Fleet ==`: the persisted sweep's verdict, red/stale names, and
+    the serve rollups. Omitted when the run has no fleet.json."""
+    if summary is None:
+        return []
+    lines = ["", "== Fleet =="]
+    if summary.get("error"):
+        lines.append(f"  {summary['error']}")
+        return lines
+    replicas = summary.get("replicas") or {}
+    lines.append(
+        f"  verdict: {str(summary.get('verdict', '?')).upper()} "
+        f"({len(replicas)} replica(s))"
+    )
+    for rid in summary.get("red") or []:
+        entry = replicas.get(rid) or {}
+        lines.append(f"  red: {rid} — {entry.get('error') or 'unhealthy'}")
+    for rid in summary.get("stale_cards") or []:
+        lines.append(f"  stale card: {rid}")
+    rollup = summary.get("rollup") or {}
+    for key in sorted(rollup):
+        if key.startswith("llmt_fleet_serve_") and not key.endswith(
+            ("_min", "_mean", "_max")
+        ):
+            lines.append(f"  {key} = {float(rollup[key]):.3f}")
+    return lines
+
+
 def _trace_section(summary: dict | None) -> list[str]:
     """`== Trace ==`: per-phase span aggregates and the top-k slowest
     requests with their queue/prefill/decode breakdowns. Omitted when the
@@ -954,6 +1014,7 @@ def render_report(
     lines.extend(_serving_section(telemetry))
     lines.extend(_slo_section(telemetry))
     lines.extend(_trace_section(_trace_summary(run_dir)))
+    lines.extend(_fleet_section(_fleet_summary(run_dir)))
     lines.extend(_elastic_section(
         telemetry_records,
         _read_supervisor_events(
@@ -1097,6 +1158,8 @@ def render_report_data(
         "slo": _numeric_subset(telemetry, ("slo/",)),
         "elastic": elastic,
         "trace": _trace_summary(run_dir),
+        # null when no `fleet --out` sweep was persisted into the run dir
+        "fleet": _fleet_summary(run_dir),
         "recovery": _numeric_subset(telemetry, ("resilience/",)),
         "flash": _numeric_subset(telemetry, ("flash/",)),
         "telemetry": telemetry,

@@ -67,6 +67,29 @@ def _env_flag(name: str, default: bool) -> bool:
     return raw != "0"
 
 
+def clock_anchor(clock=time.perf_counter) -> dict:
+    """One wall↔monotonic anchor pair: trace timestamps are monotonic
+    process time (unalignable across processes on their own), so each
+    sink/flight-dump leads with this sample. `wall_s ≈ wall(mono_s)`
+    within `err_s` — the wall read is bracketed by two monotonic reads
+    and the half-width bounds the pairing error, which is exactly the
+    |skew| bound `trace --merge` alignment inherits (test-pinned)."""
+    m0 = clock()
+    wall = time.time()
+    m1 = clock()
+    try:
+        attempt = int(os.environ.get("LLMT_SUPERVISOR_ATTEMPT") or 0)
+    except ValueError:
+        attempt = 0
+    return {
+        "wall_s": wall,
+        "mono_s": 0.5 * (m0 + m1),
+        "err_s": max(0.0, 0.5 * (m1 - m0)),
+        "pid": os.getpid(),
+        "attempt": attempt,
+    }
+
+
 class TraceRecorder:
     """Bounded ring of span/instant events + an optional jsonl sink.
 
@@ -127,7 +150,13 @@ class TraceRecorder:
                 return False
             self._sink_path = path
             self._unflushed = 0
-            return True
+        # one-time wall↔monotonic anchor so cross-process merges can align
+        # this file (docs/observability.md#fleet); emitted OUTSIDE the
+        # attach lock — instant() takes it again
+        anchor = clock_anchor(self.clock)
+        self.instant("meta", "clock_anchor", ts=anchor["mono_s"], **anchor)
+        self.flush()
+        return True
 
     def detach_sink(self) -> None:
         with self._lock:
@@ -237,7 +266,15 @@ class TraceRecorder:
             run_dir = Path(run_dir)
             run_dir.mkdir(parents=True, exist_ok=True)
             path = run_dir / f"trace-flight-{tag}.jsonl"
+            # lead with a fresh anchor: flight dumps are exactly the files
+            # that get merged across replicas post-mortem
+            anchor = clock_anchor(self.clock)
+            anchor_event = {
+                "ts": anchor["mono_s"], "ph": "i", "cat": "meta",
+                "name": "clock_anchor", "args": anchor,
+            }
             with open(path, "w") as f:
+                f.write(json.dumps(anchor_event) + "\n")
                 for event in events:
                     f.write(json.dumps(event) + "\n")
             with self._lock:
@@ -333,28 +370,43 @@ _ENGINE_TID = 1
 _REQUEST_TID_BASE = 10
 
 
-def to_chrome_trace(events: list[dict]) -> dict:
+def to_chrome_trace(
+    events: list[dict],
+    ts_offset_s: float = 0.0,
+    pid_base: int = 0,
+    label: str | None = None,
+) -> dict:
     """Chrome-trace-format JSON (the Perfetto/about:tracing schema):
     serving requests become one track each (tid per request id, named),
     engine steps one track, trainer phases one track, resilience events
     their own track. Timestamps convert to microseconds (the format's
-    unit); they are monotonic process time, so Perfetto shows a relative
-    timeline."""
+    unit); by default they are monotonic process time, so Perfetto shows
+    a relative timeline.
+
+    The merge hooks: `ts_offset_s` shifts every timestamp (wall-aligned
+    callers pre-rebase and pass 0), `pid_base` namespaces this source's
+    process ids so merged replicas never collide, and `label` prefixes
+    every process_name (`replica-0/serve`). `cat == "meta"` events
+    (clock anchors) steer alignment but never render."""
     out: list[dict] = []
     request_tids: dict[str, int] = {}
+    prefix = f"{label}/" if label else ""
     for name, pid in _PIDS.items():
-        out.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
-                    "args": {"name": name}})
-    out.append({"ph": "M", "pid": _PIDS["serve"], "tid": _ENGINE_TID,
+        out.append({"ph": "M", "pid": pid_base + pid, "tid": 0,
+                    "name": "process_name", "args": {"name": prefix + name}})
+    out.append({"ph": "M", "pid": pid_base + _PIDS["serve"],
+                "tid": _ENGINE_TID,
                 "name": "thread_name", "args": {"name": "engine"}})
-    out.append({"ph": "M", "pid": _PIDS["train"], "tid": 1,
+    out.append({"ph": "M", "pid": pid_base + _PIDS["train"], "tid": 1,
                 "name": "thread_name", "args": {"name": "trainer phases"}})
-    out.append({"ph": "M", "pid": _PIDS["resilience"], "tid": 1,
+    out.append({"ph": "M", "pid": pid_base + _PIDS["resilience"], "tid": 1,
                 "name": "thread_name", "args": {"name": "events"}})
     for event in events:
         try:
             cat = str(event.get("cat", "other"))
-            pid = _PIDS.get(cat, 9)
+            if cat == "meta":
+                continue
+            pid = pid_base + _PIDS.get(cat, 9)
             args = event.get("args") or {}
             request_id = args.get("request_id")
             if cat == "serve" and request_id is not None:
@@ -373,7 +425,7 @@ def to_chrome_trace(events: list[dict]) -> dict:
                 "name": str(event.get("name", "?")),
                 "cat": cat,
                 "ph": "X" if event.get("ph") == "X" else "i",
-                "ts": float(event["ts"]) * 1e6,
+                "ts": (float(event["ts"]) + ts_offset_s) * 1e6,
                 "pid": pid,
                 "tid": tid,
             }
@@ -387,6 +439,121 @@ def to_chrome_trace(events: list[dict]) -> dict:
         except (TypeError, ValueError, KeyError):
             continue  # one malformed record must not sink the export
     return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# ------------------------------------------------------------------ merge
+
+
+def _extract_anchors(events: list[dict]) -> list[tuple[float, float, float]]:
+    """Sorted `(mono_s, wall_offset_s, err_s)` triples from the file's
+    `clock_anchor` meta events; `ts + wall_offset_s` is wall time."""
+    anchors: list[tuple[float, float, float]] = []
+    for event in events:
+        if event.get("cat") != "meta" or event.get("name") != "clock_anchor":
+            continue
+        args = event.get("args") or {}
+        try:
+            mono = float(args["mono_s"])
+            wall = float(args["wall_s"])
+            err = float(args.get("err_s", 0.0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        anchors.append((mono, wall - mono, err))
+    anchors.sort()
+    return anchors
+
+
+def wall_align(events: list[dict]) -> tuple[list[dict], float] | None:
+    """Rebase every event's monotonic `ts` to wall seconds, SEGMENT-WISE:
+    each event uses the nearest preceding anchor (a supervised relaunch
+    appends a fresh anchor to the same trace.jsonl, and its events must
+    align by the new process's clock pair, not the dead one's). Events
+    before the first anchor use the first. Returns `(aligned, max_err_s)`
+    — the per-file contribution to the merge skew bound — or None when
+    the file holds no anchor at all (pre-anchor traces cannot merge)."""
+    import bisect
+
+    anchors = _extract_anchors(events)
+    if not anchors:
+        return None
+    monos = [a[0] for a in anchors]
+    aligned: list[dict] = []
+    for event in events:
+        if event.get("cat") == "meta":
+            continue
+        try:
+            ts = float(event["ts"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        i = max(0, bisect.bisect_right(monos, ts) - 1)
+        rebased = dict(event)
+        rebased["ts"] = ts + anchors[i][1]
+        aligned.append(rebased)
+    return aligned, max(a[2] for a in anchors)
+
+
+def merge_traces(sources: list[str | Path]) -> tuple[dict, dict]:
+    """Merge N runs' traces into ONE wall-aligned Chrome-trace document:
+    per-source events rebase monotonic→wall via their anchors, the global
+    earliest event becomes t=0, and each source gets its own pid
+    namespace + label so two replicas' request tracks render side by
+    side. Raises ValueError (naming every offending path) on a missing
+    trace file or an anchorless one. The cross-replica |skew| is bounded
+    by the SUM of the two worst anchor half-widths — `info['skew_bound_s']`,
+    pinned by the round-trip test."""
+    resolved: list[tuple[Path, Path]] = []
+    missing: list[str] = []
+    for source in sources:
+        path = resolve_trace_file(source)
+        if path is None:
+            missing.append(
+                f"{source} (searched {source} and "
+                f"{Path(source) / 'trace.jsonl'})"
+            )
+        else:
+            resolved.append((Path(source), path))
+    if missing:
+        raise ValueError("no trace file for: " + "; ".join(missing))
+    aligned_all: list[tuple[str, list[dict]]] = []
+    labels_seen: set[str] = set()
+    errs: list[float] = []
+    for index, (src, path) in enumerate(resolved):
+        events = read_trace_events(path)
+        if not events:
+            raise ValueError(f"{path} holds no parseable events")
+        aligned = wall_align(events)
+        if aligned is None:
+            raise ValueError(
+                f"{path} holds no clock_anchor meta event — cannot "
+                "wall-align (anchors are emitted at sink attach; re-record "
+                "with the current tracer)"
+            )
+        events_wall, err = aligned
+        if not events_wall:
+            raise ValueError(f"{path} holds only meta events")
+        label = src.name if src.is_dir() else (src.parent.name or src.stem)
+        if label in labels_seen:
+            label = f"{label}#{index}"
+        labels_seen.add(label)
+        aligned_all.append((label, events_wall))
+        errs.append(err)
+    t0 = min(e["ts"] for _, evs in aligned_all for e in evs)
+    merged: list[dict] = []
+    for index, (label, evs) in enumerate(aligned_all):
+        rebased = [dict(e, ts=e["ts"] - t0) for e in evs]
+        document = to_chrome_trace(
+            rebased, pid_base=(index + 1) * 100, label=label
+        )
+        merged.extend(document["traceEvents"])
+    worst_pair = sorted(errs, reverse=True)[:2]
+    info = {
+        "sources": [str(path) for _, path in resolved],
+        "labels": [label for label, _ in aligned_all],
+        "events": sum(len(evs) for _, evs in aligned_all),
+        "t0_wall_s": t0,
+        "skew_bound_s": sum(worst_pair),
+    }
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}, info
 
 
 # ---------------------------------------------------------------- summary
@@ -490,16 +657,47 @@ def summarize_trace(events: list[dict], top_k: int = 3) -> dict:
 # -------------------------------------------------------------------- CLI
 
 
-def trace_main(source: str, out: str | None = None) -> int:
+def trace_main(
+    source: str | None = None,
+    out: str | None = None,
+    merge: list[str] | None = None,
+) -> int:
     """`llm-training-tpu trace <run_dir|trace.jsonl> [--out file]`: export
     the trace sink as Chrome-trace JSON for Perfetto (ui.perfetto.dev →
-    Open trace file). Exit 2 when no trace file is reachable."""
+    Open trace file). `--merge <dir>...` instead wall-aligns N runs into
+    one file (per-replica pid namespaces — docs/observability.md#fleet).
+    Exit 2 — naming every path searched — when no trace file is
+    reachable."""
     import sys
 
+    if merge:
+        try:
+            document, info = merge_traces(list(merge))
+        except ValueError as e:
+            print(f"trace: {e}", file=sys.stderr)
+            return 2
+        first = Path(merge[0])
+        out_path = Path(out) if out else (
+            first / "trace-merged.json" if first.is_dir()
+            else first.with_name("trace-merged.json")
+        )
+        out_path.write_text(json.dumps(document))
+        print(
+            f"trace: merged {info['events']} events from "
+            f"{len(info['sources'])} source(s) "
+            f"({', '.join(info['labels'])}) -> {out_path} "
+            f"(|skew| <= {1e3 * info['skew_bound_s']:.3f}ms)"
+        )
+        print("open in Perfetto: https://ui.perfetto.dev (Open trace file)")
+        return 0
+    if source is None:
+        print("trace: need a source (or --merge <dir>...)", file=sys.stderr)
+        return 2
     path = resolve_trace_file(source)
     if path is None:
         print(
-            f"trace: no trace.jsonl under {source} — run with tracing "
+            f"trace: no trace file found — searched {source} and "
+            f"{Path(source) / 'trace.jsonl'} — run with tracing "
             "enabled first (docs/observability.md#tracing)",
             file=sys.stderr,
         )

@@ -265,6 +265,16 @@ EOF
 echo "== precommit: exporter smoke (live scrape + chaos SLO breach) =="
 python scripts/exporter_smoke.py "${SMOKE_ROOT}/exporter-smoke"
 
+# fleet-smoke gate (docs/observability.md#fleet): two serve replicas under
+# one discovery dir — the aggregator census must equal the summed client
+# censuses with terminals exactly-once fleet-wide; `trace --merge` must
+# render both replicas' request tracks in ONE wall-aligned Perfetto file;
+# and a SIGKILLed replica must flip the fleet verdict red within one
+# scrape interval with /fleetz naming its stale card
+echo "== precommit: fleet smoke (2-replica census + kill-flip + trace merge) =="
+python scripts/fleet_smoke.py "${SMOKE_ROOT}/fleet-smoke" \
+    "${SMOKE_ROOT}/smoke/cpu-smoke"
+
 # perf-regression ledger gate (docs/performance.md#perf-ledger): the
 # committed BENCH_r*.json history must parse and gate clean — a newly
 # committed round that regressed same-backend MFU / decode rate / TTFT

@@ -220,6 +220,268 @@ def build_child_argv(args) -> list[str]:
     ]
 
 
+class ReplicaDriver:
+    """One serve child of the multi-replica loadgen (`--replicas N`):
+    owns the child process, a feeder thread (this replica's share of the
+    traffic), and a reader thread folding protocol chunks into the
+    per-replica census. The feeder deliberately does NOT close stdin —
+    the fleet census must sweep live exporters AFTER every terminal, so
+    the children idle until `finish()` releases them."""
+
+    def __init__(self, index: int, args, requests: list[dict], env: dict,
+                 run_root: str):
+        self.index = index
+        self.args = args
+        self.requests = requests
+        self.child = subprocess.Popen(
+            [
+                sys.executable, "-m", "llm_training_tpu", "serve",
+                "--config", args.config, *args.serve_args,
+                f"run_root={run_root}",
+            ],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            bufsize=1, env=env,
+        )
+        self._lock = threading.Lock()
+        self.done: dict[str, dict] = {}  # guarded by: _lock
+        self.done_counts: dict[str, int] = {}  # guarded by: _lock
+        self.chunks: dict[str, int] = {}  # guarded by: _lock
+        self.stats: dict[str, float] = {}  # guarded by: _lock
+        self.error_chunks = 0  # guarded by: _lock
+        self.all_terminal = threading.Event()
+        self.first_token_seen = threading.Event()
+        self._feeder = threading.Thread(target=self._feed, daemon=True)
+        self._reader = threading.Thread(target=self._read, daemon=True)
+
+    def start(self) -> "ReplicaDriver":
+        self._reader.start()
+        self._feeder.start()
+        return self
+
+    def _send(self, request: dict) -> None:
+        self.child.stdin.write(json.dumps(request) + "\n")
+        self.child.stdin.flush()
+
+    def _feed(self) -> None:
+        try:
+            self._send(self.requests[0])
+            if self.args.arrival == "overlap":
+                self.first_token_seen.wait()
+            for n, request in enumerate(self.requests[1:]):
+                if n and self.args.arrival == "overlap":
+                    time.sleep(self.args.arrival_gap_s)
+                self._send(request)
+        except (BrokenPipeError, OSError):
+            pass  # child died; the terminal audit reports the hole
+
+    def _read(self) -> None:
+        expected = {r["id"] for r in self.requests}
+        for line in self.child.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # interleaved logging, not a protocol chunk
+            kind = event.get("type")
+            if kind == "token":
+                rid = event["id"]
+                with self._lock:
+                    self.chunks[rid] = self.chunks.get(rid, 0) + 1
+                self.first_token_seen.set()
+            elif kind == "done":
+                rid = event["id"]
+                with self._lock:
+                    self.done[rid] = event
+                    self.done_counts[rid] = self.done_counts.get(rid, 0) + 1
+                    terminal = expected <= set(self.done)
+                self.first_token_seen.set()
+                if terminal:
+                    self.all_terminal.set()
+            elif kind == "stats":
+                with self._lock:
+                    self.stats = event["stats"]
+            elif kind == "error":
+                with self._lock:
+                    self.error_chunks += 1
+                self.first_token_seen.set()
+        # stdout EOF: the child is gone. Unblock the census waiter NOW —
+        # the rc audit and the exactly-once terminal audit report the
+        # holes; hanging out the idle timeout helps nobody.
+        self.first_token_seen.set()
+        self.all_terminal.set()
+
+    def finish(self) -> int:
+        """Release the idling child (close stdin), collect its exit."""
+        self.first_token_seen.set()  # unwedge the feeder on a dead child
+        try:
+            self.child.stdin.close()
+        except OSError:
+            pass
+        try:
+            rc = self.child.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            self.child.kill()
+            rc = self.child.wait()
+        self._reader.join(timeout=10.0)
+        self._feeder.join(timeout=10.0)
+        return rc
+
+    def census(self) -> dict:
+        with self._lock:
+            reasons: dict[str, int] = {}
+            for event in self.done.values():
+                reason = str(event.get("stop_reason"))
+                reasons[reason] = reasons.get(reason, 0) + 1
+            return {
+                "replica": self.index,
+                "requests": len(self.requests),
+                "completed": reasons.get("eos", 0) + reasons.get("max_tokens", 0),
+                "terminal_reasons": reasons,
+                "streamed_chunks": sum(self.chunks.values()),
+                "error_chunks": self.error_chunks,
+                "done_counts": dict(self.done_counts),
+                "engine": dict(self.stats),
+            }
+
+
+def run_multi(args) -> int:
+    """`--replicas N`: split the traffic round-robin across N serve
+    children (each with its own run_root, metrics port, and fleet card)
+    and assert the FLEET census at the all-terminal moment: the
+    aggregator's rollup must equal the sum of the per-replica client
+    censuses, terminals exactly-once fleet-wide, verdict green."""
+    from llm_training_tpu.telemetry.exporter import find_free_port
+    from llm_training_tpu.telemetry.fleet import FleetAggregator
+
+    if args.supervised or args.malformed:
+        print(
+            "--replicas composes with neither --supervised nor "
+            "--malformed (drive those single-replica)", file=sys.stderr,
+        )
+        return 2
+    if not args.replica_run_root:
+        print("--replicas needs --replica-run-root", file=sys.stderr)
+        return 2
+    requests = build_requests(args)
+    if len(requests) < args.replicas:
+        print(
+            f"--requests {len(requests)} < --replicas {args.replicas}",
+            file=sys.stderr,
+        )
+        return 2
+    fleet_dir = args.fleet_dir or os.environ.get("LLMT_FLEET_DIR")
+    drivers: list[ReplicaDriver] = []
+    ports: list[int] = []
+    for index in range(args.replicas):
+        port = find_free_port()
+        env = {**os.environ, "LLMT_METRICS_PORT": str(port)}
+        if fleet_dir:
+            env["LLMT_FLEET_DIR"] = str(fleet_dir)
+        drivers.append(ReplicaDriver(
+            index, args, requests[index::args.replicas], env,
+            str(Path(args.replica_run_root) / f"replica-{index}"),
+        ))
+        ports.append(port)
+    for driver in drivers:
+        driver.start()
+
+    failures: list[str] = []
+    deadline = time.monotonic() + args.idle_timeout_s
+    for driver in drivers:
+        remaining = max(0.0, deadline - time.monotonic())
+        if not driver.all_terminal.wait(remaining):
+            failures.append(
+                f"replica-{driver.index}: not every request terminal "
+                f"within {args.idle_timeout_s}s"
+            )
+
+    # --- THE fleet census moment: every engine quiescent (all terminals
+    # in), every exporter still armed (stdin held open) — one sweep must
+    # see the whole fleet green and its rollup equal the client truth
+    fleet_snapshot = None
+    if not failures:
+        aggregator = FleetAggregator(
+            fleet_dir=fleet_dir,
+            targets="" if fleet_dir else ",".join(
+                f"127.0.0.1:{port}" for port in ports
+            ),
+        )
+        fleet_snapshot = aggregator.sweep()
+        if len(fleet_snapshot["replicas"]) != args.replicas:
+            failures.append(
+                f"fleet census: {len(fleet_snapshot['replicas'])} "
+                f"replica(s) visible, want {args.replicas} "
+                f"(dir={fleet_dir!r})"
+            )
+        if fleet_snapshot["verdict"] != "green":
+            failures.append(
+                f"fleet verdict {fleet_snapshot['verdict']!r} at the "
+                f"census moment (red={fleet_snapshot['red']}, "
+                f"stale={fleet_snapshot['stale_cards']})"
+            )
+        rollup = fleet_snapshot["rollup"]
+        client_completed = sum(d.census()["completed"] for d in drivers)
+        scraped = rollup.get("llmt_fleet_serve_requests_completed")
+        if scraped != float(client_completed):
+            failures.append(
+                f"fleet census drift: rollup requests_completed "
+                f"{scraped} != summed client censuses {client_completed}"
+            )
+        for gauge in (
+            "llmt_fleet_serve_queue_depth", "llmt_fleet_serve_running"
+        ):
+            if rollup.get(gauge, 0.0) != 0.0:
+                failures.append(
+                    f"fleet not quiescent at census: {gauge} = "
+                    f"{rollup[gauge]}"
+                )
+
+    rcs = [driver.finish() for driver in drivers]
+    for index, rc in enumerate(rcs):
+        if rc != 0:
+            failures.append(f"replica-{index}: serve exited {rc}")
+
+    # --- exactly-once terminals FLEET-WIDE: each request was routed to
+    # one replica and must have exactly one done chunk anywhere
+    fleet_done: dict[str, int] = {}
+    per_replica = [driver.census() for driver in drivers]
+    for census in per_replica:
+        for rid, count in census.pop("done_counts").items():
+            fleet_done[rid] = fleet_done.get(rid, 0) + count
+    for request in requests:
+        count = fleet_done.get(request["id"], 0)
+        if count != 1:
+            failures.append(
+                f"{request['id']}: {count} terminal(s) fleet-wide — "
+                "want exactly one"
+            )
+
+    summary = {
+        "replicas": args.replicas,
+        "requests": len(requests),
+        "completed": sum(c["completed"] for c in per_replica),
+        "per_replica": per_replica,
+        "fleet": {
+            "verdict": fleet_snapshot["verdict"],
+            "red": fleet_snapshot["red"],
+            "stale_cards": fleet_snapshot["stale_cards"],
+            "rollup": {
+                key: value
+                for key, value in fleet_snapshot["rollup"].items()
+                if key.startswith(("llmt_fleet_serve_", "llmt_fleet_replicas"))
+            },
+        } if fleet_snapshot else None,
+        "errors": failures,
+    }
+    print(json.dumps(summary))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f)
+    return 1 if failures else 0
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--config", required=True)
@@ -276,6 +538,24 @@ def main() -> int:
         "a failure). The child must run with LLMT_METRICS_PORT set to the "
         "same port; 0 = no scraping",
     )
+    parser.add_argument(
+        "--replicas", type=int, default=1,
+        help="multi-replica mode (docs/observability.md#fleet): split the "
+        "traffic round-robin across N serve children and assert the FLEET "
+        "census (aggregator rollup == summed per-replica client censuses, "
+        "terminals exactly-once fleet-wide)",
+    )
+    parser.add_argument(
+        "--replica-run-root", default=None,
+        help="base directory for per-replica run roots "
+        "(<base>/replica-<i>; required with --replicas > 1)",
+    )
+    parser.add_argument(
+        "--fleet-dir", default=None,
+        help="discovery directory for the fleet census (sets "
+        "LLMT_FLEET_DIR for the children; default: inherit the env; "
+        "unset = census by static --targets over the child ports)",
+    )
     parser.add_argument("--out", default=None, help="also write the summary JSON here")
     parser.add_argument(
         "serve_args", nargs="*",
@@ -285,6 +565,9 @@ def main() -> int:
     # unknown flags (e.g. --max-batch) pass through to the serve child
     args, passthrough = parser.parse_known_args()
     args.serve_args += passthrough
+
+    if args.replicas > 1:
+        return run_multi(args)
 
     requests = build_requests(args)
     child_env = None

@@ -135,6 +135,52 @@ def test_parse_prometheus_text_rejects_malformed_lines():
             parse_prometheus_text(bad)
 
 
+def test_parse_prometheus_text_labeled_series_are_opt_in():
+    """The fleet federation surface re-renders per-replica series with a
+    `{replica=...,role=...}` label block: `labels=True` accepts exactly
+    that strict shape (keyed by the FULL labeled name); the default
+    parser keeps rejecting, so child-exporter scrapes stay label-free."""
+    body = (
+        '# TYPE llmt_serve_queue_depth gauge\n'
+        'llmt_serve_queue_depth{replica="serve-0-42",role="serve"} 3.0\n'
+        'llmt_fleet_replicas 1.0\n'
+    )
+    parsed = parse_prometheus_text(body, labels=True)
+    assert parsed[
+        'llmt_serve_queue_depth{replica="serve-0-42",role="serve"}'
+    ] == 3.0
+    assert parsed["llmt_fleet_replicas"] == 1.0
+    with pytest.raises(ValueError):
+        parse_prometheus_text(body)  # labels stay opt-in
+    for bad in (
+        'llmt_x{replica=serve-0-42} 1.0\n',      # unquoted value
+        'llmt_x{replica="a" role="b"} 1.0\n',    # missing comma
+        'llmt_x{replica="a",} 1.0\n',            # trailing comma
+        'llmt_x{} 1.0\n',                        # empty block
+        'llmt_x{replica="a"\n',                  # unterminated, no value
+    ):
+        with pytest.raises(ValueError):
+            parse_prometheus_text(bad, labels=True)
+
+
+def test_parse_prometheus_kinds():
+    registry = TelemetryRegistry()
+    registry.counter("exporter/scrapes").inc()
+    registry.gauge("serve/queue_depth").set(1.0)
+    from llm_training_tpu.telemetry.exporter import parse_prometheus_kinds
+
+    snapshot, kinds = registry.snapshot_with_kinds()
+    body = render_prometheus(snapshot, kinds=kinds)
+    parsed_kinds = parse_prometheus_kinds(body)
+    assert parsed_kinds["llmt_exporter_scrapes"] == "counter"
+    assert parsed_kinds["llmt_serve_queue_depth"] == "gauge"
+    # same strictness posture as the sample parser: drift raises
+    for bad in ("# TYPE llmt_x histogram\n", "# TYPE too many words here\n"):
+        with pytest.raises(ValueError):
+            parse_prometheus_kinds(bad)
+    assert parse_prometheus_kinds("llmt_x 1.0\n") == {}  # no TYPE lines: fine
+
+
 def test_render_prometheus_handles_non_finite_and_junk():
     text = render_prometheus(
         {"a/nan": float("nan"), "a/inf": float("inf"), "a/ok": 1.0,

@@ -302,20 +302,25 @@ def test_flight_dump_racing_sink_writer_is_consistent(tmp_path, seed):
 
     recorder.detach_sink()
     counts = recorder.counts()
-    assert counts["recorded"] == 8
-    assert counts["written"] == 8
+    # attach_sink emits one clock_anchor meta event on top of the 8 payloads
+    assert counts["recorded"] == 9
+    assert counts["written"] == 9
     assert counts["flight_dumps"] == 2
     sink_events = [
         json.loads(line)
         for line in (sink_dir / "trace.jsonl").read_text().splitlines()
     ]
-    assert [e["name"] for e in sink_events] == [f"event-{n}" for n in range(8)]
+    assert sink_events[0]["cat"] == "meta"
+    assert sink_events[0]["name"] == "clock_anchor"
+    assert [e["name"] for e in sink_events[1:]] == [
+        f"event-{n}" for n in range(8)
+    ]
     for round_ in range(2):
         dump = sink_dir / f"trace-flight-seed{seed}-{round_}.jsonl"
-        names = [
-            json.loads(line)["name"]
-            for line in dump.read_text().splitlines()
-        ]
+        lines = [json.loads(line) for line in dump.read_text().splitlines()]
+        # every dump leads with a fresh alignment anchor
+        assert lines[0]["name"] == "clock_anchor"
+        names = [e["name"] for e in lines if e["name"] != "clock_anchor"]
         # a dump is a consistent prefix of the recorded sequence — never a
         # torn view with holes
         assert names == [f"event-{n}" for n in range(len(names))]

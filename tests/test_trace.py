@@ -11,13 +11,16 @@ import pytest
 
 from llm_training_tpu.telemetry.trace import (
     TraceRecorder,
+    clock_anchor,
     get_tracer,
+    merge_traces,
     read_trace_events,
     resolve_trace_file,
     set_tracer,
     summarize_trace,
     to_chrome_trace,
     trace_main,
+    wall_align,
 )
 
 
@@ -66,9 +69,12 @@ def test_sink_writes_only_sampled_events(tmp_path, tracer):
     tracer.instant("serve", "submit", write=False, request_id="b")
     tracer.detach_sink()
     written = read_trace_events(path)
-    assert [e["args"]["request_id"] for e in written] == ["a"]
+    # attaching always writes the clock anchor first — the wall/monotonic
+    # pair `trace --merge` aligns replicas on — then sampled events only
+    assert written[0]["cat"] == "meta" and written[0]["name"] == "clock_anchor"
+    assert [e["args"]["request_id"] for e in written[1:]] == ["a"]
     counts = tracer.counts()
-    assert counts["recorded"] == 2 and counts["written"] == 1
+    assert counts["recorded"] == 3 and counts["written"] == 2
 
 
 def test_request_sampling_every_nth():
@@ -119,7 +125,9 @@ def test_flight_dump_writes_ring(tmp_path, tracer):
     path = tracer.flight_dump(tmp_path, "hang-test")
     assert path is not None and path.name == "trace-flight-hang-test.jsonl"
     events = read_trace_events(path)
-    assert [e["args"]["step"] for e in events] == list(range(5))
+    # a flight dump is mergeable too: its head line is a fresh clock anchor
+    assert events[0]["cat"] == "meta" and events[0]["name"] == "clock_anchor"
+    assert [e["args"]["step"] for e in events[1:]] == list(range(5))
     assert tracer.counts()["flight_dumps"] == 1
 
 
@@ -327,9 +335,12 @@ def test_report_json_schema(tmp_path, monkeypatch):
     for key in (
         "run_dir", "world", "training", "goodput", "device_memory",
         "health", "perf", "audit", "inference", "serving", "slo",
-        "elastic", "trace", "recovery", "flash", "telemetry",
+        "elastic", "trace", "recovery", "flash", "telemetry", "fleet",
     ):
         assert key in doc, key
+    # no fleet.json snapshot in the fixture -> null block
+    # (tests/test_fleet.py pins the populated shape)
+    assert doc["fleet"] is None
     # no SLO config armed in the fixture -> null block, like the omitted
     # text section (tests/test_exporter.py pins the armed shape)
     assert doc["slo"] is None
@@ -394,7 +405,8 @@ def test_watchdog_dump_flushes_flight_recorder(tmp_path, tracer):
     flights = list(tmp_path.glob("trace-flight-hang-*.jsonl"))
     assert len(flights) == 1
     events = read_trace_events(flights[0])
-    assert [e["args"]["step"] for e in events[:2]] == [41, 42]
+    assert events[0]["name"] == "clock_anchor"
+    assert [e["args"]["step"] for e in events[1:3]] == [41, 42]
 
 
 def test_anomaly_dump_flushes_flight_recorder(tmp_path, tracer):
@@ -405,7 +417,9 @@ def test_anomaly_dump_flushes_flight_recorder(tmp_path, tracer):
     assert path is not None
     flight = tmp_path / "trace-flight-anomaly-7.jsonl"
     assert flight.is_file()
-    assert read_trace_events(flight)[0]["args"]["step"] == 7
+    events = read_trace_events(flight)
+    assert events[0]["name"] == "clock_anchor"
+    assert events[1]["args"]["step"] == 7
 
 
 def test_flight_dumps_export_to_chrome(tmp_path, tracer):
@@ -418,3 +432,161 @@ def test_flight_dumps_export_to_chrome(tmp_path, tracer):
     assert any(
         e.get("args", {}).get("request_id") == "r9" for e in doc["traceEvents"]
     )
+
+
+# ----------------------------------------- cross-replica merge (#fleet)
+
+
+def _anchor_line(mono_s, wall_s, err_s=0.0, attempt=0, pid=1):
+    return {"ts": mono_s, "ph": "i", "cat": "meta", "name": "clock_anchor",
+            "args": {"mono_s": mono_s, "wall_s": wall_s, "err_s": err_s,
+                     "pid": pid, "attempt": attempt}}
+
+
+def _serve_span(ts, rid, dur=0.5, name="decode"):
+    return {"ts": ts, "dur": dur, "ph": "X", "cat": "serve", "name": name,
+            "args": {"request_id": rid}}
+
+
+def _write_trace(run_dir, lines):
+    run_dir.mkdir(parents=True, exist_ok=True)
+    path = run_dir / "trace.jsonl"
+    path.write_text("".join(json.dumps(line) + "\n" for line in lines))
+    return path
+
+
+def test_clock_anchor_pairs_wall_and_monotonic(monkeypatch):
+    anchor = clock_anchor(clock=lambda: 5.0)
+    assert anchor["mono_s"] == 5.0 and anchor["err_s"] == 0.0
+    assert anchor["pid"] > 0 and anchor["attempt"] == 0
+    import time as _time
+    live = clock_anchor()
+    assert abs(live["wall_s"] - _time.time()) < 5.0
+    assert live["err_s"] >= 0.0
+    monkeypatch.setenv("LLMT_SUPERVISOR_ATTEMPT", "3")
+    assert clock_anchor()["attempt"] == 3
+    monkeypatch.setenv("LLMT_SUPERVISOR_ATTEMPT", "banana")
+    assert clock_anchor()["attempt"] == 0  # malformed degrades, never raises
+
+
+def test_attach_sink_leads_with_anchor_and_round_trips(tmp_path, tracer):
+    """The satellite round-trip: the anchor the sink writes is the anchor
+    wall_align reads back, so |aligned - wall| <= err_s by construction."""
+    path = tmp_path / "trace.jsonl"
+    assert tracer.attach_sink(path)
+    tracer.instant("serve", "submit", write=True, request_id="r0")
+    tracer.detach_sink()
+    events = read_trace_events(path)
+    anchor = events[0]["args"]
+    aligned, max_err = wall_align(events)
+    assert len(aligned) == 1  # the meta event steers, never renders
+    want_wall = events[1]["ts"] + (anchor["wall_s"] - anchor["mono_s"])
+    assert aligned[0]["ts"] == pytest.approx(want_wall, abs=1e-9)
+    assert max_err == anchor["err_s"] >= 0.0
+
+
+def test_wall_align_is_segment_wise():
+    """A supervised relaunch appends a fresh anchor mid-file: events after
+    it must align by the NEW pair, events before it by the old one."""
+    events = [
+        _anchor_line(10.0, 1000.0, attempt=0),
+        _serve_span(11.0, "a"),        # old segment: wall 1001
+        _anchor_line(3.0, 2000.0, attempt=1),  # relaunch: clock restarted
+        _serve_span(4.0, "b"),         # new segment: wall 2001
+    ]
+    # the relaunch anchor has the SMALLER mono — nearest-preceding must
+    # key on mono order, not file order
+    aligned, _ = wall_align(events)
+    by_rid = {e["args"]["request_id"]: e["ts"] for e in aligned}
+    assert by_rid["a"] == pytest.approx(1001.0)
+    assert by_rid["b"] == pytest.approx(2001.0)
+
+
+def test_wall_align_returns_none_without_anchor():
+    assert wall_align([_serve_span(1.0, "a")]) is None
+
+
+def test_to_chrome_trace_merge_hooks():
+    events = [_anchor_line(0.0, 50.0), _serve_span(1.0, "r1")]
+    doc = to_chrome_trace(events, pid_base=300, label="replica-3")
+    names = [e for e in doc["traceEvents"] if e.get("name") == "process_name"]
+    assert all(e["args"]["name"].startswith("replica-3/") for e in names)
+    assert all(e["pid"] >= 300 for e in doc["traceEvents"])
+    assert not any(e.get("cat") == "meta" for e in doc["traceEvents"])
+
+
+def test_merge_traces_aligns_and_namespaces(tmp_path):
+    """Two replicas with wildly different monotonic bases but overlapping
+    wall time merge into one timeline: same-wall-instant events land at
+    the same merged ts, each under its own pid namespace and label."""
+    a = _write_trace(tmp_path / "replica-0", [
+        _anchor_line(100.0, 5000.0, err_s=0.002),
+        _serve_span(101.0, "req-0"),   # wall 5001 -> merged t=0
+        _serve_span(103.0, "req-1"),   # wall 5003
+    ])
+    _write_trace(tmp_path / "replica-1", [
+        _anchor_line(7.0, 4994.0, err_s=0.003),
+        _serve_span(14.0, "req-2"),    # wall 5001 too — same instant
+    ])
+    document, info = merge_traces(
+        [tmp_path / "replica-0", tmp_path / "replica-1"]
+    )
+    assert info["labels"] == ["replica-0", "replica-1"]
+    assert info["events"] == 3 and info["t0_wall_s"] == pytest.approx(5001.0)
+    # the skew bound is the SUM of the two worst per-file anchor errors
+    assert info["skew_bound_s"] == pytest.approx(0.005)
+    spans = {e["args"]["request_id"]: e for e in document["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["req-0"]["ts"] == pytest.approx(0.0)
+    assert spans["req-2"]["ts"] == pytest.approx(0.0)       # wall-aligned
+    assert spans["req-1"]["ts"] == pytest.approx(2e6)       # +2s in µs
+    assert spans["req-0"]["pid"] != spans["req-2"]["pid"]   # pid namespaces
+    assert str(a) in info["sources"][0]
+
+
+def test_merge_traces_dedupes_labels_and_rejects_bad_sources(tmp_path):
+    _write_trace(tmp_path / "a" / "run", [
+        _anchor_line(0.0, 100.0), _serve_span(1.0, "x")])
+    _write_trace(tmp_path / "b" / "run", [
+        _anchor_line(0.0, 100.0), _serve_span(1.0, "y")])
+    _, info = merge_traces([tmp_path / "a" / "run", tmp_path / "b" / "run"])
+    assert info["labels"] == ["run", "run#1"]
+
+    missing = tmp_path / "nope"
+    with pytest.raises(ValueError) as excinfo:
+        merge_traces([missing])
+    # exit-2 contract: the error names EVERY searched path
+    assert str(missing) in str(excinfo.value)
+    assert str(missing / "trace.jsonl") in str(excinfo.value)
+
+    anchorless = tmp_path / "old"
+    _write_trace(anchorless, [_serve_span(1.0, "z")])
+    with pytest.raises(ValueError, match="clock_anchor"):
+        merge_traces([anchorless])
+
+
+def test_trace_cli_merge_and_exit_2_paths(tmp_path, capsys):
+    _write_trace(tmp_path / "r0", [
+        _anchor_line(0.0, 100.0, err_s=0.001), _serve_span(1.0, "req-0")])
+    _write_trace(tmp_path / "r1", [
+        _anchor_line(50.0, 100.5, err_s=0.001), _serve_span(51.0, "req-1")])
+    assert trace_main(merge=[str(tmp_path / "r0"), str(tmp_path / "r1")]) == 0
+    out = capsys.readouterr().out
+    assert "merged" in out and "|skew| <=" in out
+    # default out lands in the FIRST source dir
+    merged = json.loads((tmp_path / "r0" / "trace-merged.json").read_text())
+    rids = {e.get("args", {}).get("request_id") for e in merged["traceEvents"]}
+    assert {"req-0", "req-1"} <= rids
+
+    assert trace_main(merge=[str(tmp_path / "gone")]) == 2
+    err = capsys.readouterr().err
+    assert str(tmp_path / "gone") in err
+    assert str(tmp_path / "gone" / "trace.jsonl") in err
+
+    assert trace_main() == 2  # no source, no --merge
+    assert "--merge" in capsys.readouterr().err
+
+    assert trace_main(str(tmp_path / "void")) == 2
+    err = capsys.readouterr().err
+    assert str(tmp_path / "void") in err
+    assert str(tmp_path / "void" / "trace.jsonl") in err
