@@ -436,6 +436,14 @@ def stage_train() -> dict:
             * trainer.config.accumulate_grad_batches * max(1, n_dev)
             if "xla/flops_per_step" in snapshot else None
         ),
+        # static collective-payload share of the compiled step's bytes
+        # (attr/ gauges from the HLO walk, docs/observability.md#device-plane)
+        # — tracked round over round so a sharding regression that trades
+        # FLOPs for traffic shows up even when MFU barely moves
+        "comm_fraction": (
+            round(snapshot["attr/comm_fraction"], 4)
+            if "attr/comm_fraction" in snapshot else None
+        ),
     }
 
 
@@ -773,7 +781,8 @@ def summarize(results: dict) -> dict:
     if ok("train"):
         for key in ("tokens_per_sec_per_chip", "sec_per_step", "n_params", "model",
                     "n_devices", "backend", "goodput_pct", "compile_time_s",
-                    "xla_flops_per_step", "blocks", "block_sources"):
+                    "xla_flops_per_step", "comm_fraction", "blocks",
+                    "block_sources"):
             if key in train:
                 summary[key] = train[key]
     elif "train" in results:

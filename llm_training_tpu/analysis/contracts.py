@@ -169,6 +169,15 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
         "steps while the exporter's scrape threads read last_alert() and "
         "breach counts",
     },
+    "llm_training_tpu/telemetry/profiling.py": {
+        "ProfileTrigger": "the request surface is called from the SLO "
+        "breach path, the watchdog poll thread, /profilez handler "
+        "threads, and the serve stdin path while the owning loop polls "
+        "capture transitions",
+        "get_profile_trigger": "breach paths and handler threads resolve "
+        "the process trigger through this module global",
+        "set_profile_trigger": "same global as get_profile_trigger",
+    },
     "llm_training_tpu/telemetry/fleet.py": {
         "FleetAggregator": "the background sweep loop publishes snapshots "
         "while the federation server's per-request handler threads render "
@@ -214,6 +223,10 @@ LOCK_ORDER = (
     "goodput",   # telemetry/goodput.py GoodputLedger._lock
     "slo",       # telemetry/slo.py SLOMonitor._lock (window state only;
                  # breach side effects emit after release)
+    "profiling", # telemetry/profiling.py ProfileTrigger._lock +
+                 # _current_lock (admission state only; counter/tracer
+                 # side effects and jax.profiler calls all happen after
+                 # release, so no edge into trace/registry)
     "journal",   # serve/journal.py RequestJournal._lock
     "trace",     # telemetry/trace.py TraceRecorder._lock + _current_lock
     "registry",  # telemetry/registry.py TelemetryRegistry._lock (leaf)

@@ -23,7 +23,8 @@ logger = logging.getLogger(__name__)
 TELEMETRY_PREFIXES = (
     "goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/",
     "health/", "nan_guard/", "resilience/", "decode/", "eval/", "serve/",
-    "elastic/", "flash/", "trace/", "slo/", "exporter/",
+    "elastic/", "flash/", "trace/", "slo/", "exporter/", "attr/",
+    "profile/", "hbm_timeline/",
 )
 TELEMETRY_KEYS = ("compile_time_s",)
 

@@ -4,6 +4,14 @@ TPU-native addition with no reference analogue (SURVEY.md §5.1: the
 reference has no profiler integration). Captures an XLA/TensorBoard trace
 for steps [start_step, start_step + num_steps) — the standard workflow for
 finding HBM-bound ops and collective stalls.
+
+When the fit owns a `ProfileTrigger` (telemetry/profiling.py), this
+callback goes passive: the trainer reads `profile_window()` at fit start,
+schedules the window on the trigger (same budget accounting, artifacts
+inside the run dir by default), and marks the callback `_absorbed` — one
+owner for jax.profiler.start/stop_trace, so a breach-fired capture can
+never nest inside a config-window capture. The standalone path below is
+kept for direct use outside a trainer fit (bench stages, tests).
 """
 
 from __future__ import annotations
@@ -15,11 +23,17 @@ from pydantic import BaseModel, ConfigDict
 
 logger = logging.getLogger(__name__)
 
+# standalone fallback only; inside a fit the ProfileTrigger resolves an
+# unset trace_dir to <run_dir>/profile-window-<start> instead
+DEFAULT_TRACE_DIR = "runs/profile"
+
 
 class ProfilerCallbackConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
-    trace_dir: str = "runs/profile"
+    # None = let the owner pick (ProfileTrigger: inside the run dir;
+    # standalone: DEFAULT_TRACE_DIR)
+    trace_dir: str | None = None
     start_step: int = 5  # past compile/warmup
     num_steps: int = 3
 
@@ -29,8 +43,17 @@ class ProfilerCallback:
         self.config = config or ProfilerCallbackConfig()
         self._active = False
         self._stop_step: int | None = None
+        # set by the trainer when the window was handed to a ProfileTrigger
+        self._absorbed = False
+
+    def profile_window(self) -> tuple[int, int, str | None]:
+        """The configured capture window, for a ProfileTrigger to adopt."""
+        cfg = self.config
+        return cfg.start_step, cfg.num_steps, cfg.trace_dir
 
     def on_train_step(self, trainer, step) -> None:
+        if self._absorbed:
+            return
         cfg = self.config
         if not self._active and cfg.start_step <= step < cfg.start_step + cfg.num_steps:
             # explicit stop boundary, clamped to the fit's last step: when
@@ -50,6 +73,10 @@ class ProfilerCallback:
                     "not tracing", cfg.start_step, cfg.start_step + cfg.num_steps, step,
                 )
                 return
+            if cfg.trace_dir is None:
+                # write the resolved dir back so callers (and tests) read
+                # the actual capture location off the config afterwards
+                cfg.trace_dir = DEFAULT_TRACE_DIR
             self._stop_step = stop_step
             jax.profiler.start_trace(cfg.trace_dir)
             self._active = True

@@ -354,10 +354,22 @@ def _run_serve(args, config: dict) -> int:
     slo = build_slo_monitor(
         registry=get_registry(), run_dir=run_dir if primary else None
     )
+    # device-profile trigger (docs/observability.md#profiling): armed by
+    # SLO breaches, the watchdog, `{"type": "profile"}` control lines, and
+    # /profilez; only this serve loop's poll() below touches jax.profiler
+    from llm_training_tpu.telemetry.profiling import (
+        build_profile_trigger,
+        set_profile_trigger,
+    )
+
+    profile_trigger = build_profile_trigger(
+        registry=get_registry(), run_dir=run_dir if primary else None
+    )
     exporter = start_exporter(
         registry=get_registry(),
         watchdog=watchdog,
         slo=slo,
+        profile=profile_trigger,
         role="serve",
         extra_fn=engine.live_stats,
         status_fn=lambda: {
@@ -499,6 +511,16 @@ def _run_serve(args, config: dict) -> int:
         if item is _EOF:
             return False
         record, error = item
+        if error is None and record.get("type") == "profile":
+            # {"type": "profile", "tag"?}: arm a device-profile capture
+            # over the next engine steps. The ack chunk reports whether
+            # the trigger accepted (budget/cooldown/busy refusals answer
+            # accepted=false with the reason) — the capture itself starts
+            # at the next poll in the serve loop below.
+            tag = str(record.get("tag") or f"serve-{engine._step_index}")
+            result = profile_trigger.request(tag, source="serve")
+            print(json.dumps({"type": "profile", **result}), flush=True)
+            return True
         if error is None and record.get("type") == "reload":
             reload_from_checkpoint(record)
             return True
@@ -565,6 +587,7 @@ def _run_serve(args, config: dict) -> int:
         # in flight: drain whatever arrived, never stall the batch
         flush_delivered()
         emit(engine.step())
+        profile_trigger.poll(engine._step_index)
         if watchdog is not None:
             watchdog.beat(step=engine._step_index)
 
@@ -583,6 +606,7 @@ def _run_serve(args, config: dict) -> int:
             if engine.scheduler.idle or _time.monotonic() >= deadline:
                 break
             emit(engine.step())
+            profile_trigger.poll(engine._step_index)
             if watchdog is not None:
                 watchdog.beat(step=engine._step_index)
         _time.sleep(0.05)  # let a mid-read reader line land in the queue
@@ -591,6 +615,10 @@ def _run_serve(args, config: dict) -> int:
         rc = RESUMABLE_EXIT_CODE
 
     stats = engine.stats()
+    # closes any dangling capture and unpublishes the process-wide trigger
+    # (a later fit in this process builds its own)
+    profile_trigger.teardown()
+    set_profile_trigger(None)
     if watchdog is not None:
         watchdog.stop()
     if trace_attached:
@@ -874,6 +902,23 @@ def main(argv: list[str] | None = None) -> int:
         "--once", action="store_true",
         help="one snapshot then exit (exit 2 when unreachable)",
     )
+    profile = sub.add_parser(
+        "profile",
+        help="arm a device-profile capture on a live run via its exporter's "
+        "/profilez endpoint (docs/observability.md#profiling); exit 0 when "
+        "armed, 3 when the trigger refused (budget/cooldown/busy), 2 when "
+        "unreachable",
+    )
+    profile.add_argument(
+        "--port", type=int, default=None,
+        help="exporter port (default: LLMT_METRICS_PORT)",
+    )
+    profile.add_argument("--host", default="127.0.0.1")
+    profile.add_argument(
+        "--tag", default=None,
+        help="artifact tag (profile-<tag>/ in the run dir; default: a "
+        "profilez-<n> serial)",
+    )
     trace = sub.add_parser(
         "trace",
         help="export a run's trace.jsonl as Chrome-trace JSON viewable in "
@@ -1018,6 +1063,12 @@ def main(argv: list[str] | None = None) -> int:
             port=args.port, host=args.host,
             interval_s=args.interval_s, once=args.once,
         )
+    if args.command == "profile":
+        # stdlib-only: one GET against the live run's /profilez — the run
+        # process owns jax.profiler; this side only arms the trigger
+        from llm_training_tpu.telemetry.exporter import profile_main
+
+        return profile_main(port=args.port, host=args.host, tag=args.tag)
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import

@@ -168,6 +168,17 @@ class HangWatchdog:
         from llm_training_tpu.telemetry.trace import get_tracer
 
         get_tracer().flight_dump(self.run_dir, f"hang-{stamp}")
+        # arm a device profile under the matching tag — request side only:
+        # this runs on the watchdog's poll thread, which must never touch
+        # jax (a capture call would block behind the wedged dispatch being
+        # reported, and with action='abort' SIGABRT follows immediately).
+        # The capture materializes only if the owning loop limps through
+        # another step; the armed request is still the honest marker.
+        from llm_training_tpu.telemetry.profiling import get_profile_trigger
+
+        trigger = get_profile_trigger()
+        if trigger is not None:
+            trigger.request(f"hang-{stamp}", source="watchdog")
         logger.error(
             "watchdog: no %s progress for %.1fs — thread stacks "
             "dumped to %s", self.primary_source, stalled_s, path,

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import time
 from typing import Any, Sequence
 
@@ -207,6 +208,13 @@ class ServingEngine:
         # client-side census by construction
         self._done_full = 0
         self._done_failed = 0
+        # one-shot decode-step attribution (LLMT_PROFILE_ATTR_DECODE=1,
+        # docs/observability.md#device-plane): the first real decode batch
+        # supplies the concrete avals needed to AOT-lower the step for
+        # cost/HLO analysis; off by default — it pays one extra XLA compile
+        self._decode_attr_done = not bool(
+            os.environ.get("LLMT_PROFILE_ATTR_DECODE")
+        )
 
     # ------------------------------------------------------------ programs
 
@@ -607,15 +615,50 @@ class ServingEngine:
             tokens[request.slot] = request.generated[-1]
             lengths[request.slot] = request.cache_len
             tables[request.slot] = self._table_row(request)
-        self._pool_k, self._pool_v, out = self._decode_jit(
+        step_args = (
             self.variables, jnp.asarray(tokens), self._pool_k, self._pool_v,
             jnp.asarray(tables), jnp.asarray(lengths), self._next_rng(),
         )
+        if not self._decode_attr_done:
+            # before the donating call below: lowering only reads avals,
+            # while the jit consumes the pool buffers
+            self._decode_attr_done = True
+            self._publish_decode_attribution(step_args)
+        self._pool_k, self._pool_v, out = self._decode_jit(*step_args)
         host = np.asarray(jax.device_get(out))
         for request in survivors:
             request.cache_len += 1
             self._emit_token(request, int(host[request.slot]), events)
         return events
+
+    def _publish_decode_attribution(self, step_args) -> None:
+        """AOT-lower the decode step against the first real batch's avals
+        and publish its compute/comm split as attr/decode/* gauges
+        (docs/observability.md#device-plane). The lowering pays one extra
+        XLA compile — why LLMT_PROFILE_ATTR_DECODE gates this off by
+        default; any failure degrades to a warning, never a dropped step."""
+        try:
+            from llm_training_tpu.telemetry.device import (
+                compiled_attribution_gauges,
+            )
+            from llm_training_tpu.telemetry.registry import get_registry
+
+            with self._ctx():
+                compiled = self._decode_jit.lower(*step_args).compile()
+            mesh_axes = None
+            if self.mesh is not None:
+                mesh_axes = dict(
+                    zip(self.mesh.axis_names, self.mesh.devices.shape)
+                )
+            registry = get_registry()
+            for name, value in compiled_attribution_gauges(
+                compiled, mesh_axes
+            ).items():
+                registry.gauge(
+                    "attr/decode/" + name.removeprefix("attr/")
+                ).set(value)
+        except Exception as e:  # noqa: BLE001 — attribution is best-effort
+            logger.warning("decode-step attribution unavailable: %s", e)
 
     def _done_event(self, request: ServeRequest) -> dict:
         if request.stop_reason in ("eos", "max_tokens"):

@@ -21,13 +21,24 @@ from llm_training_tpu.telemetry.anomaly import (
     resolve_run_dir,
     top_layers,
 )
-from llm_training_tpu.telemetry.device import compiled_cost_gauges, hbm_gauges
+from llm_training_tpu.telemetry.device import (
+    HBMTimeline,
+    compiled_attribution_gauges,
+    compiled_cost_gauges,
+    hbm_gauges,
+)
 from llm_training_tpu.telemetry.exporter import (
     MetricsExporter,
     resolve_metrics_port,
     start_exporter,
 )
 from llm_training_tpu.telemetry.goodput import PHASES, GoodputLedger
+from llm_training_tpu.telemetry.profiling import (
+    ProfileTrigger,
+    build_profile_trigger,
+    get_profile_trigger,
+    set_profile_trigger,
+)
 from llm_training_tpu.telemetry.slo import (
     SLOMonitor,
     build_slo_monitor,
@@ -66,18 +77,24 @@ __all__ = [
     "PHASES",
     "EmaZScore",
     "GoodputLedger",
+    "HBMTimeline",
     "HealthConfig",
     "MetricsExporter",
+    "ProfileTrigger",
     "SLOMonitor",
     "TelemetryRegistry",
     "TraceRecorder",
     "build_param_groups",
+    "build_profile_trigger",
     "build_slo_monitor",
+    "compiled_attribution_gauges",
     "compiled_cost_gauges",
     "dump_anomaly",
+    "get_profile_trigger",
     "get_registry",
     "get_tracer",
     "hbm_gauges",
+    "set_profile_trigger",
     "layer_health_metrics",
     "moe_router_health",
     "offending_layers",

@@ -180,6 +180,15 @@ def dump_anomaly(
         from llm_training_tpu.telemetry.trace import get_tracer
 
         get_tracer().flight_dump(run_dir, f"anomaly-{step}")
+        # matching-tag device profile: if the guard lets the run continue
+        # (warn/rollback paths), the next steps get captured under the
+        # same `anomaly-<step>` name as this host-side dump; on an abort
+        # the armed request simply never gets polled
+        from llm_training_tpu.telemetry.profiling import get_profile_trigger
+
+        trigger = get_profile_trigger()
+        if trigger is not None:
+            trigger.request(f"anomaly-{step}", source="anomaly")
         return path
     except Exception:
         logger.exception("anomaly dump failed (step %d, reason %s)", step, reason)

@@ -48,6 +48,7 @@ import os
 import re
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 logger = logging.getLogger(__name__)
@@ -215,6 +216,7 @@ class MetricsExporter:
         ledger=None,
         watchdog=None,
         slo=None,
+        profile=None,
         extra_fn=None,
         status_fn=None,
         stale_after_s: float | None = None,
@@ -227,6 +229,10 @@ class MetricsExporter:
         self.ledger = ledger
         self.watchdog = watchdog
         self.slo = slo
+        # a ProfileTrigger's jax-free REQUEST surface: /profilez arms a
+        # capture window for the owning loop; the handler thread itself
+        # never touches the device (docs/observability.md#profiling)
+        self.profile = profile
         self.extra_fn = extra_fn
         self.status_fn = status_fn
         self.host = host
@@ -425,6 +431,26 @@ class MetricsExporter:
         lines.append("")
         return "\n".join(lines)
 
+    def render_profilez(self, query: str = "") -> tuple[int, str]:
+        """(status, json body) for /profilez: arm an on-demand device
+        profile through the trigger's jax-free request surface. `?tag=`
+        names the capture (sanitized into the artifact name); the default
+        tag counts requests so repeated pokes stay distinguishable. A
+        suppressed request (budget/cooldown/busy) answers 429 — the
+        refusal IS the budget working, not a server error."""
+        trigger = self.profile
+        if trigger is None:
+            return 404, json.dumps(
+                {"error": "no profile trigger armed on this process"}
+            ) + "\n"
+        params = urllib.parse.parse_qs(query)
+        tag = params.get("tag", [None])[0]
+        if not tag:
+            tag = f"profilez-{trigger.status()['requested'] + 1}"
+        result = trigger.request(tag, source="profilez")
+        body = {**result, "status": trigger.status()}
+        return (200 if result["accepted"] else 429), json.dumps(body) + "\n"
+
     def _note_error(self) -> None:
         with self._lock:
             self._errors += 1
@@ -436,9 +462,10 @@ class MetricsExporter:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    """Routes /metrics, /statusz, /healthz; anything else is 404. Runs on
-    the server's per-request daemon threads — all content comes from
-    MetricsExporter methods that never touch jax."""
+    """Routes /metrics, /statusz, /healthz, /profilez; anything else is
+    404. Runs on the server's per-request daemon threads — all content
+    comes from MetricsExporter methods that never touch jax (/profilez
+    only ARMS a capture; the owning loop performs it)."""
 
     server_version = "llmt-exporter/1"
 
@@ -452,7 +479,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         exporter: MetricsExporter = self.server.exporter  # type: ignore[attr-defined]
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
             if path == "/metrics":
                 self._send(
@@ -469,6 +496,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(
                     200, "text/plain; charset=utf-8", exporter.render_statusz()
                 )
+            elif path == "/profilez":
+                code, body = exporter.render_profilez(query)
+                self._send(code, "application/json", body)
             else:
                 self._send(404, "text/plain", "not found\n")
         except BrokenPipeError:
@@ -496,6 +526,51 @@ def start_exporter(port: int | None = None, **sources) -> MetricsExporter | None
         return None
     exporter = MetricsExporter(port, **sources)
     return exporter if exporter.start() else None
+
+
+# ------------------------------------------------------------------ profile
+
+
+def profile_main(
+    port: int | None = None,
+    host: str = "127.0.0.1",
+    tag: str | None = None,
+    timeout_s: float = 5.0,
+) -> int:
+    """`llm-training-tpu profile [--port N] [--tag T]`: fire a live run's
+    `/profilez` endpoint so the owning loop captures a device profile over
+    its next steps (docs/observability.md#profiling). Stdlib-only like
+    `watch` — runs from any operator machine. Exit 0 when the capture was
+    armed, 3 when the trigger suppressed it (budget/cooldown/busy — the
+    response says which), 2 when the exporter is unreachable."""
+    import sys
+    import urllib.error
+    import urllib.request
+
+    if port is None:
+        port = resolve_metrics_port()
+    if not port:
+        print(
+            "profile: no port — pass --port or set LLMT_METRICS_PORT "
+            "(the run must export; docs/observability.md#profiling)",
+            file=sys.stderr,
+        )
+        return 2
+    url = f"http://{host}:{port}/profilez"
+    if tag:
+        url += "?" + urllib.parse.urlencode({"tag": tag})
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            body = resp.read().decode("utf-8", "replace")
+            code = resp.status
+    except urllib.error.HTTPError as e:  # 429 (suppressed) / 404 carry JSON
+        body = e.read().decode("utf-8", "replace")
+        code = e.code
+    except (urllib.error.URLError, OSError) as e:
+        print(f"profile: {url} unreachable ({e})", file=sys.stderr)
+        return 2
+    print(body.rstrip("\n"), flush=True)
+    return 0 if code == 200 else 3
 
 
 # -------------------------------------------------------------------- watch

@@ -810,6 +810,123 @@ def _slo_section(telemetry: dict) -> list[str]:
     return lines
 
 
+def _profile_manifests(run_dir: Path) -> list[dict]:
+    """Capture manifests (`profile-<tag>.json`, written by the
+    ProfileTrigger next to each trace dir). A torn/unreadable manifest
+    keeps its slot with an `error` field — the capture HAPPENED even if
+    the record of it is damaged, and the report must say so."""
+    entries: list[dict] = []
+    for path in sorted(run_dir.glob("profile-*.json")):
+        try:
+            record = json.loads(path.read_text())
+            if not isinstance(record, dict):
+                raise ValueError("manifest must be a JSON object")
+            record["file"] = path.name
+            entries.append(record)
+        except (OSError, ValueError) as e:
+            entries.append({
+                "file": path.name,
+                "error": f"unreadable manifest ({type(e).__name__})",
+            })
+    return entries
+
+
+def _profiling_summary(run_dir: Path, telemetry: dict) -> dict | None:
+    """The structured `profiling` block (docs/observability.md#profiling):
+    trigger counters, capture manifests, and the compiled-program
+    compute/comm attribution gauges. None when the run recorded none of
+    them — a run that never armed the trigger stays unchanged."""
+    counters = _numeric_subset(telemetry, ("profile/", "hbm_timeline/"))
+    attribution = _numeric_subset(telemetry, ("attr/",))
+    captures = _profile_manifests(run_dir)
+    if not counters and not attribution and not captures:
+        return None
+    return {
+        "counters": counters or {},
+        "attribution": attribution,
+        "captures": captures,
+    }
+
+
+def _profiling_section(summary: dict | None) -> list[str]:
+    """`== Profiling ==`: trigger activity (captures vs suppressions —
+    the suppressed count is the budget/cooldown doing its job), one line
+    per capture manifest, the static compute/comm attribution split, and
+    the HBM timeline tally. Omitted when the run profiled nothing."""
+    if summary is None:
+        return []
+    try:
+        lines = ["", "== Profiling =="]
+        counters = summary["counters"]
+        requested = int(counters.get("profile/requested", 0.0))
+        captures = int(counters.get("profile/captures", 0.0))
+        suppressed = int(counters.get("profile/suppressed", 0.0))
+        if requested or captures or suppressed:
+            lines.append(
+                f"captures: {captures} (requested {requested}, "
+                f"suppressed {suppressed})"
+            )
+        errors = counters.get("profile/errors")
+        if errors:
+            lines.append(f"capture errors: {int(errors)}")
+        for record in summary["captures"]:
+            name = str(record.get("file", "?"))
+            try:
+                if record.get("error"):
+                    lines.append(f"{name}: {record['error']}")
+                    continue
+                line = (
+                    f"{name}: steps {int(record['start_step'])}"
+                    f"..{int(record['stop_step'])}"
+                )
+                if record.get("duration_s") is not None:
+                    line += f", {float(record['duration_s']):.2f}s"
+                if record.get("source"):
+                    line += f" ({record['source']})"
+                lines.append(line)
+            except (KeyError, TypeError, ValueError):
+                # honest per-capture degrade: a torn manifest costs its
+                # own line, never the section
+                lines.append(f"{name}: unreadable manifest — malformed fields")
+        attribution = summary["attribution"]
+        if attribution:
+            frac = attribution.get("attr/comm_fraction")
+            if frac is not None:
+                lines.append(
+                    f"comm fraction: {100.0 * frac:.1f}% of bytes accessed"
+                )
+            flops = attribution.get("attr/flops_per_step")
+            if flops is not None:
+                lines.append(f"flops/step: {flops:.3g}")
+            cbytes = attribution.get("attr/collective_bytes_per_step")
+            if cbytes is not None:
+                ops = int(attribution.get("attr/collective_ops", 0.0))
+                lines.append(
+                    f"collective bytes/step: {cbytes:,.0f} ({ops} op(s))"
+                )
+            for key in sorted(attribution):
+                if key.startswith("attr/mesh/") and attribution[key]:
+                    axis = key[len("attr/mesh/"):].rsplit("/", 1)[0]
+                    lines.append(f"  mesh {axis}: {attribution[key]:,.0f} B")
+            decode_frac = attribution.get("attr/decode/comm_fraction")
+            if decode_frac is not None:
+                lines.append(
+                    f"decode comm fraction: {100.0 * decode_frac:.1f}%"
+                )
+        records = counters.get("hbm_timeline/records")
+        if records:
+            line = f"hbm timeline: {int(records)} record(s)"
+            highwater = counters.get("hbm_timeline/highwater_events")
+            if highwater:
+                line += f", {int(highwater)} high-water crossing(s)"
+            if counters.get("hbm_timeline/truncated"):
+                line += " (truncated at cap)"
+            lines.append(line)
+        return lines
+    except (KeyError, TypeError, ValueError):
+        return ["", "== Profiling ==", "unreadable profiling data — malformed fields"]
+
+
 def _counter_section(title: str, rows: list[tuple[str, str]], telemetry: dict) -> list[str]:
     """An event-counter section: one `label: count` line per nonzero
     counter, the whole section omitted when nothing fired — a clean run's
@@ -1013,6 +1130,7 @@ def render_report(
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
     lines.extend(_slo_section(telemetry))
+    lines.extend(_profiling_section(_profiling_summary(run_dir, telemetry)))
     lines.extend(_trace_section(_trace_summary(run_dir)))
     lines.extend(_fleet_section(_fleet_summary(run_dir)))
     lines.extend(_elastic_section(
@@ -1156,6 +1274,9 @@ def render_report_data(
         # null when the run armed no SLO config — the structured twin of
         # the text section's absent-config omission
         "slo": _numeric_subset(telemetry, ("slo/",)),
+        # null when the run profiled nothing (no trigger counters, no
+        # capture manifests, no attr/ gauges)
+        "profiling": _profiling_summary(run_dir, telemetry),
         "elastic": elastic,
         "trace": _trace_summary(run_dir),
         # null when no `fleet --out` sweep was persisted into the run dir

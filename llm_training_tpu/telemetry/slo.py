@@ -392,6 +392,18 @@ class SLOMonitor:
         if self.run_dir is not None and alert["n"] <= MAX_FLIGHT_DUMPS_PER_TARGET:
             tag = "slo-" + key.replace("/", "-") + f"-{alert['n']}"
             tracer.flight_dump(self.run_dir, tag)
+            # arm a device-profile capture with the SAME tag, so the host
+            # ring dump and the device trace of one breach correlate by
+            # name (docs/observability.md#profiling). Request-side only —
+            # still jax-free; the owning loop performs the capture, and
+            # the trigger's own budget/cooldown (not ours) decides
+            from llm_training_tpu.telemetry.profiling import (
+                get_profile_trigger,
+            )
+
+            trigger = get_profile_trigger()
+            if trigger is not None:
+                trigger.request(tag, source="slo")
 
     # ------------------------------------------------------------- queries
 

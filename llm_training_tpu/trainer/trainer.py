@@ -51,14 +51,18 @@ from llm_training_tpu.resilience import (
 )
 from llm_training_tpu.telemetry import (
     GoodputLedger,
+    HBMTimeline,
     HealthConfig,
     TelemetryRegistry,
     build_param_groups,
+    build_profile_trigger,
+    compiled_attribution_gauges,
     compiled_cost_gauges,
     get_tracer,
     hbm_gauges,
     layer_health_metrics,
     resolve_run_dir,
+    set_profile_trigger,
     set_registry,
 )
 from llm_training_tpu.trainer.state import TrainState
@@ -223,6 +227,8 @@ class Trainer:
         # burn-rate monitor (LLMT_SLO_*) — docs/observability.md
         self._exporter = None
         self._slo = None
+        self._profile_trigger = None
+        self._hbm_timeline = None
         self._preempted = False
         # rollback-and-skip recovery (resilience/recovery.py): built per fit
         # when cfg.resilience.recovery is set; the save path persists its
@@ -691,6 +697,36 @@ class Trainer:
             registry=self.telemetry,
             run_dir=run_dir if jax.process_index() == 0 else None,
         )
+        # device-profile trigger (docs/observability.md#profiling): the
+        # request surface is jax-free and process-wide — SLO breaches,
+        # watchdog dumps, anomaly dumps, /profilez, and the `profile` CLI
+        # all arm captures through it; only this loop's poll() below
+        # touches jax.profiler. Process 0 only for the artifact root —
+        # captures are run-dir artifacts like flight dumps.
+        self._profile_trigger = build_profile_trigger(
+            registry=self.telemetry,
+            run_dir=run_dir if jax.process_index() == 0 else None,
+        )
+        # absorb ProfilerCallback step windows into the trigger: the
+        # config window becomes a scheduled capture (same budget, same
+        # artifact naming) and the callback goes passive — one owner for
+        # jax.profiler.start/stop_trace, so an SLO-fired capture can never
+        # nest inside a config-window capture (jax raises on nesting)
+        for cb in self.callbacks:
+            window = getattr(cb, "profile_window", None)
+            if callable(window):
+                start_step, num_steps, trace_dir = window()
+                self._profile_trigger.schedule(
+                    start_step, num_steps,
+                    trace_dir=trace_dir, max_steps=cfg.max_steps,
+                )
+                cb._absorbed = True
+        # per-device HBM timeline (docs/observability.md#device-plane):
+        # sampled on log steps into <run_dir>/hbm.jsonl + registry gauges
+        self._hbm_timeline = HBMTimeline(
+            run_dir=run_dir if jax.process_index() == 0 else None,
+            registry=self.telemetry,
+        )
         # live-telemetry exporter (docs/observability.md#live-telemetry):
         # /metrics (registry + ledger), /statusz (phase, step, segment),
         # /healthz (red on a stale watchdog beat). LLMT_METRICS_PORT=0/unset
@@ -703,6 +739,7 @@ class Trainer:
             ledger=self.ledger,
             watchdog=self._watchdog,
             slo=self._slo,
+            profile=self._profile_trigger,
             status_fn=lambda: {
                 "step": self.last_step,
                 "segment": segment_attempt(),
@@ -724,6 +761,14 @@ class Trainer:
                 self._exporter.stop()
                 self._exporter = None
             self._slo = None
+            if self._profile_trigger is not None:
+                # closes any dangling capture window (fit raised mid-trace)
+                # and unpublishes the process-wide trigger so the next fit
+                # — or a serve loop in the same process — starts clean
+                self._profile_trigger.teardown()
+                set_profile_trigger(None)
+                self._profile_trigger = None
+            self._hbm_timeline = None
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
@@ -986,6 +1031,14 @@ class Trainer:
             self.telemetry.gauge("compile_time_s").set(time.perf_counter() - t_compile)
             for name, value in compiled_cost_gauges(aot_step).items():
                 self.telemetry.gauge(name).set(value)
+            # compute/comm attribution (docs/observability.md#device-plane):
+            # walk the compiled step's HLO for collective payload bytes and
+            # split them per mesh axis — the static comm fraction that
+            # report and bench track across rounds
+            for name, value in compiled_attribution_gauges(
+                aot_step, self._mesh_axis_sizes()
+            ).items():
+                self.telemetry.gauge(name).set(value)
         step_fn = aot_step if aot_step is not None else train_step
 
         # state.step counts micro-steps (train_step invocations): resume
@@ -1170,6 +1223,12 @@ class Trainer:
                                 now_step - slo_step_t, step=step
                             )
                         slo_step_t = now_step
+                    if self._profile_trigger is not None:
+                        # AFTER the SLO observe above: a breach fired there
+                        # arms a request, and this poll starts its capture
+                        # on the very next statement — the profiled window
+                        # begins at the first step after the breach
+                        self._profile_trigger.poll(step)
                     # fresh (non-donated) device arrays; callbacks that need wall-
                     # clock accuracy can jax.block_until_ready(trainer.last_metrics)
                     self.last_metrics = metrics
@@ -1233,7 +1292,13 @@ class Trainer:
                             self._slo.observe_goodput(
                                 float(metrics["goodput/goodput_pct"]), step=step
                             )
-                        metrics.update(hbm_gauges())
+                        # per-device HBM sample: publishes the hbm/* gauges
+                        # (worst device + per-device rollup) AND appends to
+                        # the run dir's hbm.jsonl timeline in one pass
+                        if self._hbm_timeline is not None:
+                            metrics.update(self._hbm_timeline.sample(step))
+                        else:
+                            metrics.update(hbm_gauges())
                         metrics.update(self.telemetry.snapshot())
                         logger.info(
                             "step %d | loss %.4f | grad_norm %.3f | %.2f steps/s "
@@ -1360,6 +1425,14 @@ class Trainer:
                     if rollback_run_dir is not None:
                         tracer.flight_dump(
                             rollback_run_dir, f"rollback-{plan.failed_step}"
+                        )
+                    if self._profile_trigger is not None:
+                        # matching-tag device profile of the re-entered
+                        # steps: did the rollback actually clear the
+                        # device-side pathology, or does the replayed
+                        # window stall the same way?
+                        self._profile_trigger.request(
+                            f"rollback-{plan.failed_step}", source="rollback"
                         )
                     for cb in self.callbacks:
                         if hasattr(cb, "on_rollback"):

@@ -265,6 +265,16 @@ EOF
 echo "== precommit: exporter smoke (live scrape + chaos SLO breach) =="
 python scripts/exporter_smoke.py "${SMOKE_ROOT}/exporter-smoke"
 
+# profile-smoke gate (docs/observability.md#profiling): the same chaos
+# slow-step breach on a virtual fsdp=2 mesh must now ALSO fire the device
+# profile trigger — a jax.profiler capture whose profile-<tag>/ trace dir
+# + manifest carry the SAME tag as the breach's flight dump, the next
+# breach refused inside the profile cooldown (profile/suppressed), the
+# compiled step's attr/ comm-fraction gauges and the HBM timeline in
+# telemetry.jsonl, and report's == Profiling == section (text + json)
+echo "== precommit: profile smoke (triggered device capture + attribution) =="
+python scripts/profile_smoke.py "${SMOKE_ROOT}/profile-smoke"
+
 # fleet-smoke gate (docs/observability.md#fleet): two serve replicas under
 # one discovery dir — the aggregator census must equal the summed client
 # censuses with terminals exactly-once fleet-wide; `trace --merge` must

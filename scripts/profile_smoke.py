@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Precommit device-profiling smoke gate (docs/observability.md#profiling).
+
+Proves the device-plane observability layer end to end on CPU, on every
+commit:
+
+1. launches the cpu-smoke fit as a child on a virtual 2-device host
+   (`--xla_force_host_platform_device_count=2`, so the default mesh is a
+   real `fsdp=2` llama mesh with real collectives in the compiled step),
+   with a train-cadence SLO target and the slow-step chaos hook
+   injecting a sustained slow regime;
+2. after the fit exits 0, asserts the first SLO breach produced a device
+   profile capture whose artifacts (`profile-<tag>/` trace dir +
+   `profile-<tag>.json` manifest) carry the SAME tag as the breach's
+   `trace-flight-slo-*.jsonl` ring dump — the tag correlation is the
+   whole point: one breach, one host dump, one device trace;
+3. asserts the follow-up breach (SLO cooldown is shortened to re-fire
+   within the smoke; the profile cooldown keeps its 120s default) was
+   refused and recorded as `profile/suppressed` instead of a second
+   capture;
+4. asserts the compiled-step attribution gauges reached telemetry.jsonl
+   (`attr/comm_fraction` headline + nonzero collective bytes on the
+   fsdp mesh) and the HBM timeline appended `hbm.jsonl` records;
+5. asserts `report` renders the `== Profiling ==` section and
+   `report --format json` carries a non-null `profiling` block.
+
+This parent is jax-free (the child owns the backend) — graftlint holds
+the contract.
+
+Usage: python scripts/profile_smoke.py <scratch_dir>
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+
+def main() -> int:
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    scratch = Path(sys.argv[1])
+    scratch.mkdir(parents=True, exist_ok=True)
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        xla_flags = (
+            xla_flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
+    env = {
+        "JAX_PLATFORMS": "cpu",
+        # 2 virtual devices -> default mesh resolves to fsdp=2: the
+        # compiled llama step carries real all-gather/reduce-scatter
+        # traffic for the attribution walk to find
+        "XLA_FLAGS": xla_flags,
+        # the breach injection: every step past 1 drags an extra 0.6s
+        # against a 50ms cadence target (same recipe as exporter_smoke)
+        "LLMT_CHAOS_SLOW_STEP_S": "0.6",
+        "LLMT_CHAOS_SLOW_STEP_FROM": "1",
+        "LLMT_SLO_STEP_TIME_P99_S": "0.05",
+        "LLMT_SLO_MIN_SAMPLES": "3",
+        "LLMT_SLO_WINDOW_FAST_S": "30",
+        "LLMT_SLO_WINDOW_SLOW_S": "120",
+        # let the SLO monitor re-breach on the very next slow step (steps
+        # take >= 0.6s); the profile trigger's own 120s default cooldown
+        # then MUST refuse the second request -> profile/suppressed
+        "LLMT_SLO_COOLDOWN_S": "0.5",
+        # a 1-step capture window always completes inside the 6-step fit
+        "LLMT_PROFILE_STEPS": "1",
+    }
+    child_env = {**os.environ, **env}
+    child = subprocess.Popen(
+        [
+            sys.executable, "-m", "llm_training_tpu", "fit",
+            "--config", "config/examples/smoke/cpu-smoke.yaml",
+            f"run_root={scratch}",
+        ],
+        env=child_env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = child.communicate(timeout=600)
+    except subprocess.TimeoutExpired:
+        child.kill()
+        out, _ = child.communicate()
+        print(out[-2000:], file=sys.stderr)
+        print("profile smoke: fit wedged", file=sys.stderr)
+        return 1
+    if child.returncode != 0:
+        print(out[-2000:], file=sys.stderr)
+        print(f"profile smoke: fit exited {child.returncode}", file=sys.stderr)
+        return 1
+
+    run_dir = scratch / "smoke" / "cpu-smoke"
+
+    # --- one breach, one host dump, one device trace — correlated by tag
+    dumps = list(run_dir.glob("trace-flight-slo-*.jsonl"))
+    assert dumps, "SLO breach produced no trace-flight-slo-*.jsonl ring dump"
+    tags = [d.name[len("trace-flight-"):-len(".jsonl")] for d in dumps]
+    matched = [
+        (run_dir / f"trace-flight-{tag}.jsonl", run_dir / f"profile-{tag}.json")
+        for tag in tags
+        if (run_dir / f"profile-{tag}.json").exists()
+    ]
+    assert matched, (
+        f"no profile manifest matches any flight-dump tag {tags}: "
+        f"{sorted(p.name for p in run_dir.glob('profile-*'))}"
+    )
+    dump, manifest_path = matched[0]
+    manifest = json.loads(manifest_path.read_text())
+    assert manifest.get("source") == "slo", manifest
+    trace_dir = Path(manifest["trace_dir"])
+    trace_files = (
+        [p for p in trace_dir.rglob("*") if p.is_file()]
+        if trace_dir.is_dir() else []
+    )
+    assert trace_files, (
+        f"capture manifest points at an empty/missing trace dir {trace_dir}"
+    )
+
+    # --- telemetry paper trail: capture + cooldown refusal + attribution
+    records = [
+        json.loads(line)
+        for line in (run_dir / "telemetry.jsonl").read_text().splitlines()
+        if line.strip()
+    ]
+    final = records[-1]
+    prof = {k: v for k, v in final.items() if k.startswith("profile/")}
+    assert final.get("slo/breaches_total", 0) >= 2, (
+        f"need a second breach to exercise the profile cooldown: "
+        f"{ {k: v for k, v in final.items() if k.startswith('slo/')} }"
+    )
+    assert final.get("profile/captures", 0) >= 1, prof
+    assert final.get("profile/suppressed", 0) >= 1, (
+        f"the in-cooldown breach must be recorded as suppressed: {prof}"
+    )
+    assert "attr/comm_fraction" in final, sorted(final)[:30]
+    assert final.get("attr/collective_bytes_per_step", 0) > 0, (
+        "an fsdp=2 llama step must carry collective traffic: "
+        f"{ {k: v for k, v in final.items() if k.startswith('attr/')} }"
+    )
+    # log-step records carry the timeline gauges (the final flush is the
+    # plain worst-device snapshot, taken after the timeline is torn down)
+    assert max(r.get("hbm_timeline/records", 0) for r in records) >= 1, (
+        "no log step sampled through the HBM timeline"
+    )
+    assert (run_dir / "hbm.jsonl").exists(), "HBM timeline wrote no hbm.jsonl"
+
+    # --- report renders the section, json carries the block
+    report = subprocess.run(
+        [sys.executable, "-m", "llm_training_tpu", "report", str(run_dir)],
+        env=child_env, capture_output=True, text=True,
+    )
+    assert report.returncode == 0, report.stderr
+    assert "== Profiling ==" in report.stdout, report.stdout[-1500:]
+    report_json = subprocess.run(
+        [
+            sys.executable, "-m", "llm_training_tpu", "report", str(run_dir),
+            "--format", "json",
+        ],
+        env=child_env, capture_output=True, text=True,
+    )
+    assert report_json.returncode == 0, report_json.stderr
+    data = json.loads(report_json.stdout)
+    assert data.get("profiling"), "report --format json lost the profiling block"
+    assert data["profiling"]["captures"], data["profiling"]
+
+    print(
+        f"profile smoke: OK — capture {manifest_path.name} "
+        f"({len(trace_files)} trace file(s)) tagged to {dump.name}, "
+        f"suppressed {int(final['profile/suppressed'])}, comm fraction "
+        f"{final['attr/comm_fraction']:.3f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
