@@ -38,6 +38,15 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "must be readable by supervisors and tests that never touch a "
         "backend"
     ),
+    "llm_training_tpu/serve/router.py": (
+        "the router is the fleet control plane over serve children: the "
+        "replicas own the backends, and a router that initialized jax "
+        "would hold the very devices it is supposed to route around"
+    ),
+    "scripts/router_smoke.py": (
+        "the router smoke drives the route CLI as a subprocess, exactly "
+        "like the loadgen — the children own the backend"
+    ),
     "bench.py": (
         "the bench parent orchestrates child stages; a wedged backend must "
         "cost a stage timeout, not hang the whole bench (the r05 failure)"
@@ -188,6 +197,11 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
         "stdin reader thread while the engine journals progress from the "
         "step loop (the PR 12 lost-delivery race class)",
     },
+    "llm_training_tpu/serve/router.py": {
+        "Router": "the route CLI's main loop mutates routing state while "
+        "the exporter's scrape threads render live_stats() and the "
+        "per-replica stdout reader threads feed the event queue",
+    },
     "llm_training_tpu/resilience/chaos.py": {
         "Chaos": "chaos_point fires from the prefetcher worker (data "
         "site) concurrently with trainer-thread sites",
@@ -213,6 +227,11 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
 # watchdog locks wrap policy decisions and sort first.
 LOCK_ORDER = (
     "chaos",     # resilience/chaos.py Chaos._lock + _active_lock
+    "router",    # serve/router.py Router._lock — wraps routing policy and
+                 # appends to the router's RequestJournal while held (the
+                 # assignment/terminal records must be atomic with the
+                 # routing-state transition they witness), so it must sort
+                 # before "journal"; chaos hooks fire outside it
     "fleet",     # telemetry/fleet.py FleetAggregator._lock (snapshot swap
                  # only; sweeps compose — scrapes, rollups, the SLO feed —
                  # entirely outside it, so no edge into slo/registry)

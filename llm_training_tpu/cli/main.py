@@ -1026,6 +1026,66 @@ def main(argv: list[str] | None = None) -> int:
         "including './supervisor.jsonl' — is used as given",
     )
     supervise.add_argument("overrides", nargs="*")
+    route = sub.add_parser(
+        "route",
+        help="health-aware router over N serve replicas: same JSONL "
+        "protocol as serve on stdin/stdout, least-loaded routing with "
+        "eviction on red/stale health, failover replay with exactly-once "
+        "terminals, hedged retries, and SLO-driven elasticity "
+        "(docs/serving.md#router)",
+    )
+    route.add_argument("--config", required=True)
+    route.add_argument(
+        "--ckpt-path", default=None,
+        help="checkpoint step each serve replica restores",
+    )
+    route.add_argument(
+        "--replicas", type=int, default=2,
+        help="initial AND minimum serve replica count",
+    )
+    route.add_argument(
+        "--max-replicas", type=int, default=None,
+        help="elasticity ceiling (default: --replicas, i.e. scale-out off)",
+    )
+    route.add_argument(
+        "--hedge-ttft-ms", type=float, default=0.0,
+        help="hedge a request onto a second replica when its projected "
+        "TTFT crosses this budget (deadline_ms, when set on the request, "
+        "takes precedence); 0 disables (default)",
+    )
+    route.add_argument(
+        "--scrape-interval-s", type=float, default=None,
+        help="fleet health sweep cadence (default: LLMT_FLEET_SCRAPE_S, "
+        "else 2s)",
+    )
+    route.add_argument(
+        "--idle-retire-s", type=float, default=0.0,
+        help="drain-and-retire one replica (down to --replicas) after this "
+        "long with no traffic; 0 disables (default)",
+    )
+    route.add_argument(
+        "--scale-cooldown-s", type=float, default=30.0,
+        help="minimum seconds between scale events",
+    )
+    route.add_argument(
+        "--drain-timeout-s", type=float, default=30.0,
+        help="SIGTERM grace before journaling the remainder and exiting 75",
+    )
+    route.add_argument(
+        "--replica-run-root", default=None,
+        help="parent dir for per-replica run roots (default: "
+        "<run_dir>/replicas); each replica gets run_root=<root>/rN",
+    )
+    route.add_argument(
+        "--seed-run-dir", default=None,
+        help="run dir whose checkpoints/ seeds each fresh replica "
+        "(default: the router's own run dir when it has one)",
+    )
+    route.add_argument(
+        "serve_args", nargs="*",
+        help="flags/overrides forwarded to every serve replica — pass "
+        "after `--` (e.g. -- --max-batch 2 --eos-token-id -1)",
+    )
     args = parser.parse_args(argv)
 
     if args.command == "report":
@@ -1073,6 +1133,13 @@ def main(argv: list[str] | None = None) -> int:
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import
         return _run_supervise(args)
+    if args.command == "route":
+        # the router is a jax-free control plane over serve children — the
+        # children own the backend; initializing jax here would hold the
+        # very devices the replicas need
+        from llm_training_tpu.serve.router import route_main
+
+        return route_main(args)
 
     config = load_config(args.config, args.overrides)
     logging.basicConfig(

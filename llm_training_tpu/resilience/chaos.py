@@ -90,6 +90,16 @@ class ChaosConfig(BaseModel):
     # at startup — the error-chunk boundary must answer each and keep
     # serving
     serve_malformed_flood: int = Field(0, ge=0)
+    # router-tier faults (docs/serving.md#router), consumed by the `route`
+    # CLI (the router strips LLMT_CHAOS_ROUTER_* from replica child envs so
+    # only the router itself reacts):
+    # SIGKILL the replica that produced the Nth router-forwarded token —
+    # the failover-replay leg (journal fold + resubmit at the emitted
+    # watermark, exactly-once terminals)
+    router_kill_replica_at: int | None = None
+    # accept the Nth request->replica assignment but never submit it to the
+    # replica (accept-but-never-stream) — only hedging can finish it
+    router_blackhole_at: int | None = None
     # SLO-breach injection (docs/observability.md#slo): sleep this long at
     # EVERY optimizer-step boundary from `slow_step_from` on — a sustained
     # slow regime, exactly what the multi-window burn-rate alert needs to
@@ -110,6 +120,8 @@ class ChaosConfig(BaseModel):
             or self.serve_stall_step is not None
             or self.serve_sigterm_step is not None
             or self.serve_malformed_flood > 0
+            or self.router_kill_replica_at is not None
+            or self.router_blackhole_at is not None
             or self.slow_step_s > 0
         )
 
@@ -122,6 +134,7 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
     LLMT_CHAOS_SIGTERM_STEP / LLMT_CHAOS_SIGKILL_STEP / LLMT_CHAOS_NAN_STEP
     / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SERVE_STALL_STEP /
     LLMT_CHAOS_SERVE_SIGTERM_STEP / LLMT_CHAOS_SERVE_MALFORMED_FLOOD /
+    LLMT_CHAOS_ROUTER_KILL_REPLICA / LLMT_CHAOS_ROUTER_BLACKHOLE /
     LLMT_CHAOS_SLOW_STEP_FROM / LLMT_CHAOS_SEED (ints) /
     LLMT_CHAOS_SLOW_STEP_S (float, seconds of injected dead time per
     optimizer step — the SLO-breach hook)."""
@@ -142,6 +155,8 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
         ("serve_stall_step", "LLMT_CHAOS_SERVE_STALL_STEP", int),
         ("serve_sigterm_step", "LLMT_CHAOS_SERVE_SIGTERM_STEP", int),
         ("serve_malformed_flood", "LLMT_CHAOS_SERVE_MALFORMED_FLOOD", int),
+        ("router_kill_replica_at", "LLMT_CHAOS_ROUTER_KILL_REPLICA", int),
+        ("router_blackhole_at", "LLMT_CHAOS_ROUTER_BLACKHOLE", int),
         ("slow_step_s", "LLMT_CHAOS_SLOW_STEP_S", float),
         ("slow_step_from", "LLMT_CHAOS_SLOW_STEP_FROM", int),
         ("seed", "LLMT_CHAOS_SEED", int),
@@ -295,6 +310,43 @@ class Chaos:
             '{"id": "flood", "prompt": [1], "max_new_tokens": "junk"}',
         )
         return [shapes[i % len(shapes)] for i in range(n)]
+
+    # ------------------------------------------------------- router tier
+
+    def maybe_router_kill_replica(self, n_tokens: int) -> bool:
+        """Fire once when the router's forwarded-token count reaches the
+        trigger — the router (not this harness) SIGKILLs the replica that
+        produced the token, then must fold its journal and replay every
+        in-flight leg with exactly-once terminals. No first-attempt gate:
+        the router process is unsupervised and the trigger consumes itself."""
+        trigger = self.config.router_kill_replica_at
+        if trigger is None or n_tokens < trigger:
+            return False
+        with self._lock:
+            if ("router_kill", trigger) in self._fired:
+                return False
+            self._fired.add(("router_kill", trigger))
+        self._count()
+        logger.warning(
+            "chaos: router kill-replica trigger at forwarded token %d", n_tokens
+        )
+        return True
+
+    def maybe_router_blackhole(self, n_assign: int) -> bool:
+        """Fire once at the Nth request->replica assignment: the router
+        accepts the assignment but never submits the leg, so the stream
+        never starts — only a hedge (or failover) can produce the
+        terminal."""
+        trigger = self.config.router_blackhole_at
+        if trigger is None or n_assign != trigger:
+            return False
+        with self._lock:
+            if ("router_blackhole", trigger) in self._fired:
+                return False
+            self._fired.add(("router_blackhole", trigger))
+        self._count()
+        logger.warning("chaos: blackholing router assignment %d", n_assign)
+        return True
 
     def maybe_slow_step(self, step: int, sleep=None) -> bool:
         """Inject `slow_step_s` of dead time at this optimizer-step

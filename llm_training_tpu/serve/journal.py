@@ -129,6 +129,14 @@ class RequestJournal:
                 "stop_reason": request.stop_reason,
             })
 
+    def note(self, record: dict) -> None:
+        """Append an auxiliary event record (e.g. the router's assignment /
+        hedge / scale markers). `replay_journal` ignores unknown event
+        types, so notes ride the same durable stream without affecting the
+        fold — they exist for post-mortem forensics and tests."""
+        with self._lock:
+            self._append(dict(record))
+
     def _append(self, record: dict) -> None:
         """Write one record (caller holds the lock)."""
         try:

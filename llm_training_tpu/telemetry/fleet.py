@@ -64,17 +64,28 @@ logger = logging.getLogger(__name__)
 FLEET_DIR_ENV = "LLMT_FLEET_DIR"
 SCRAPE_INTERVAL_ENV = "LLMT_FLEET_SCRAPE_S"
 CARD_SCHEMA = 1
-ROLES = ("train", "serve", "bench")
+ROLES = ("train", "serve", "bench", "router")
 
 # serve gauges that roll up as FLEET SUMS (queue depth / in-flight /
 # completed are "how much work, fleet-wide" — the census cross-check and
-# the future router's least-loaded pick read exactly these)
+# the router's least-loaded pick read exactly these)
 _SERVE_SUM_KEYS = (
     "llmt_serve_queue_depth",
     "llmt_serve_running",
     "llmt_serve_requests_completed",
     "llmt_serve_requests_failed",
     "llmt_serve_tokens_generated",
+)
+
+# router gauges that roll up the same way (the loadgen's --router census
+# cross-check reads the fleet sums after a failover)
+_ROUTER_SUM_KEYS = (
+    "llmt_router_queue_depth",
+    "llmt_router_inflight",
+    "llmt_router_requests_total",
+    "llmt_router_requests_completed",
+    "llmt_router_requests_failed",
+    "llmt_router_replays",
 )
 
 
@@ -587,7 +598,7 @@ def _rollup(entries: dict[str, dict]) -> dict[str, float]:
             rollup[f"{fleet_name}_min"] = min(values)
             rollup[f"{fleet_name}_mean"] = sum(values) / len(values)
             rollup[f"{fleet_name}_max"] = max(values)
-        if name in _SERVE_SUM_KEYS:
+        if name in _SERVE_SUM_KEYS or name in _ROUTER_SUM_KEYS:
             rollup[fleet_name] = sum(values)
     rollup["llmt_fleet_replicas"] = float(len(entries))
     rollup["llmt_fleet_replicas_live"] = float(live)

@@ -254,6 +254,86 @@ def _serving_section(telemetry: dict) -> list[str]:
     return lines
 
 
+def _router_section(telemetry: dict) -> list[str]:
+    """Router telemetry (`router/*` from the `route` CLI —
+    docs/serving.md#router): request census, failover/replay, hedging, and
+    elasticity counters, with an exactly-once verdict. Rendered only when a
+    route invocation merged its gauges into telemetry.jsonl."""
+    def num(key):
+        try:
+            return float(telemetry[key])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    total = num("router/requests_total")
+    if total is None:
+        return []
+    lines = ["", "== Router =="]
+    completed = num("router/requests_completed") or 0
+    failed = num("router/requests_failed") or 0
+    line = f"requests: {int(total)} routed, {int(completed)} completed"
+    if failed:
+        line += f", {int(failed)} failed"
+    peak = num("router/peak_inflight")
+    if peak is not None:
+        line += f" (peak in-flight {int(peak)})"
+    lines.append(line)
+    line = (
+        f"replicas: {int(num('router/replicas') or 0)} live, "
+        f"target {int(num('router/replicas_target') or 0)}"
+    )
+    evictions = num("router/evictions")
+    if evictions:
+        line += f", {int(evictions)} evictions"
+    lines.append(line)
+    parts = []
+    failovers = num("router/failovers")
+    if failovers:
+        parts.append(f"{int(failovers)} failovers")
+    replays = num("router/replays")
+    if replays:
+        parts.append(f"{int(replays)} replays")
+    recovered = num("router/recovered_tokens")
+    if recovered:
+        parts.append(f"{int(recovered)} tokens recovered from journals")
+    adoptions = num("router/leg_adoptions")
+    if adoptions:
+        parts.append(f"{int(adoptions)} leg adoptions")
+    if parts:
+        lines.append("failover: " + ", ".join(parts))
+    parts = []
+    hedges = num("router/hedges")
+    if hedges:
+        parts.append(f"{int(hedges)} hedged")
+    wins = num("router/hedge_wins")
+    if wins:
+        parts.append(f"{int(wins)} hedge wins")
+    dup = num("router/duplicate_terminals_suppressed")
+    if dup:
+        parts.append(f"{int(dup)} duplicate terminals suppressed")
+    if parts:
+        lines.append("hedging: " + ", ".join(parts))
+    parts = []
+    out = num("router/scale_out_total")
+    if out:
+        parts.append(f"{int(out)} scale-out")
+    scale_in = num("router/scale_in_total")
+    if scale_in:
+        parts.append(f"{int(scale_in)} scale-in")
+    if parts:
+        lines.append("elasticity: " + ", ".join(parts))
+    # the failover proof in one line: every routed request got exactly one
+    # terminal (completed + failed == total), or the run is called out red
+    if completed + failed == total:
+        lines.append(f"exactly-once: green ({int(total)}/{int(total)} terminals)")
+    else:
+        lines.append(
+            "exactly-once: RED "
+            f"({int(completed + failed)}/{int(total)} terminals)"
+        )
+    return lines
+
+
 def _newest_json_record(
     dirs: list[Path], patterns: tuple[str, ...]
 ) -> tuple[dict, str] | None:
@@ -975,12 +1055,15 @@ def _load_run(run_dir: Path) -> tuple[list[dict], list[dict], dict]:
     segment — the one loader both the text and JSON renderers consume, so
     segment handling can never drift between them."""
     metrics = _read_jsonl(run_dir / "metrics.jsonl")
-    if not metrics:
-        raise FileNotFoundError(
-            f"no metrics.jsonl records under {run_dir} — is this a run directory?"
-        )
-    metrics = _last_run_segment(metrics)
     telemetry_records = _last_run_segment(_read_jsonl(run_dir / "telemetry.jsonl"))
+    if not metrics and not telemetry_records:
+        raise FileNotFoundError(
+            f"no metrics.jsonl or telemetry.jsonl records under {run_dir}"
+            " — is this a run directory?"
+        )
+    # serve/router run dirs are telemetry-only (no fit loop, no
+    # metrics.jsonl): render from the telemetry ledger alone
+    metrics = _last_run_segment(metrics)
     # the ledger is cumulative, so the newest record is the run total; fall
     # back to goodput keys embedded in metrics.jsonl (older runs / W&B-only)
     telemetry = (
@@ -1129,6 +1212,7 @@ def render_report(
     ))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
+    lines.extend(_router_section(telemetry))
     lines.extend(_slo_section(telemetry))
     lines.extend(_profiling_section(_profiling_summary(run_dir, telemetry)))
     lines.extend(_trace_section(_trace_summary(run_dir)))
@@ -1271,6 +1355,8 @@ def render_report_data(
         "audit": audit_data,
         "inference": _numeric_subset(telemetry, ("decode/", "eval/")),
         "serving": _numeric_subset(telemetry, ("serve/",)),
+        # null when the run never routed (no `route` invocation)
+        "router": _numeric_subset(telemetry, ("router/",)),
         # null when the run armed no SLO config — the structured twin of
         # the text section's absent-config omission
         "slo": _numeric_subset(telemetry, ("slo/",)),

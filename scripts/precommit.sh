@@ -285,6 +285,17 @@ echo "== precommit: fleet smoke (2-replica census + kill-flip + trace merge) =="
 python scripts/fleet_smoke.py "${SMOKE_ROOT}/fleet-smoke" \
     "${SMOKE_ROOT}/smoke/cpu-smoke"
 
+# router-smoke gate (docs/serving.md#router): the fleet resilience tier —
+# two serve replicas behind the `route` CLI; a SIGKILLed replica
+# mid-stream must fail over with exactly-once terminals (>= 1
+# router/replays, report's == Router == line green) and the fleet verdict
+# green again once the replacement replica arms; a chaos-blackholed
+# submission must hedge onto the second replica and deliver exactly one
+# terminal
+echo "== precommit: router smoke (failover exactly-once + hedged blackhole) =="
+python scripts/router_smoke.py "${SMOKE_ROOT}/router-smoke" \
+    "${SMOKE_ROOT}/smoke/cpu-smoke"
+
 # perf-regression ledger gate (docs/performance.md#perf-ledger): the
 # committed BENCH_r*.json history must parse and gate clean — a newly
 # committed round that regressed same-backend MFU / decode rate / TTFT

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Precommit router-smoke gate (docs/serving.md#router).
+
+Proves the fleet resilience tier end to end on CPU, on every commit:
+
+1. **failover leg** — the loadgen's `--router` mode drives two real serve
+   replicas behind the `route` tier; `LLMT_CHAOS_ROUTER_KILL_REPLICA`
+   SIGKILLs the replica that produced the Nth forwarded token mid-stream.
+   The client census must stay exactly-once (every request exactly one
+   terminal, zero duplicates, zero losses), the router must report >= 1
+   `router/replays` and `router/failovers`, and the fleet aggregator's
+   verdict at the all-terminal moment must be GREEN again — the
+   replacement replica armed and the dead replica's card was reaped.
+   The router's run dir must then render a `report` `== Router ==`
+   section with an `exactly-once: green` verdict line.
+2. **blackhole/hedge leg** — `LLMT_CHAOS_ROUTER_BLACKHOLE` swallows one
+   request->replica submission (the leg stays open but the replica never
+   hears of it); with a hedge budget set the router must re-enqueue on a
+   second replica and deliver EXACTLY one terminal per request
+   (`router/blackholed` == 1, >= 1 hedge win, duplicate terminals only
+   ever suppressed, never emitted).
+
+This parent is jax-free (the router and its serve children own any
+backend) by the same contract as the fleet smoke.
+
+Usage: python scripts/router_smoke.py <scratch_dir> [seed_run_dir]
+
+`seed_run_dir` is an existing run dir whose `checkpoints/` seeds the
+router's run root (precommit passes its CPU-fit smoke dir so no extra
+fit is paid); standalone invocations omit it and a tiny fit runs first.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+_CONFIG = "config/examples/smoke/cpu-smoke.yaml"
+# run dirs resolve as <run_root>/<project>/<name> (cli.main's jax-free
+# mirror of the logger layout); cpu-smoke pins smoke/cpu-smoke
+_RUN_SUFFIX = Path("smoke") / "cpu-smoke"
+_SERVE_FLAGS = [
+    "--max-batch", "2", "--max-model-len", "64",
+    "--prefill-chunk", "4", "--eos-token-id", "-1",
+]
+
+
+def _seed_checkpoints(scratch: Path, seed_run_dir: str | None, env) -> Path:
+    if seed_run_dir:
+        seed = Path(seed_run_dir)
+        if (seed / "checkpoints").is_dir():
+            return seed
+        print(f"router smoke: {seed}/checkpoints absent — fitting fresh",
+              file=sys.stderr)
+    seed_root = scratch / "seed"
+    fit = subprocess.run(
+        [
+            sys.executable, "-m", "llm_training_tpu", "fit",
+            "--config", _CONFIG, f"run_root={seed_root}",
+        ],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if fit.returncode != 0:
+        print(fit.stdout[-2000:], file=sys.stderr)
+        print(fit.stderr[-2000:], file=sys.stderr)
+        raise SystemExit("router smoke: seed fit failed")
+    return seed_root / _RUN_SUFFIX
+
+
+def _loadgen(scratch: Path, leg: str, env: dict, requests: int,
+             max_new_tokens: int, extra: list[str]) -> dict:
+    """One `serve_loadgen --router` run under a fresh run root; returns the
+    summary dict (the loadgen already enforces the exactly-once census,
+    quiescent-exporter cross-check, and fleet-rollup==client-census)."""
+    out = scratch / f"{leg}.json"
+    run = subprocess.run(
+        [
+            sys.executable, "scripts/serve_loadgen.py",
+            "--config", _CONFIG,
+            "--requests", str(requests),
+            "--max-new-tokens", str(max_new_tokens),
+            "--router", "--router-replicas", "2",
+            "--fleet-dir", str(scratch / f"{leg}-fleet"),
+            "--out", str(out),
+            *extra,
+            # `--` so argparse keeps the serve flags (with their values)
+            # intact in serve_args instead of stealing one as a positional
+            "--", *_SERVE_FLAGS, f"run_root={scratch / leg}",
+        ],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    if run.returncode != 0:
+        print(run.stdout[-3000:], file=sys.stderr)
+        print(run.stderr[-3000:], file=sys.stderr)
+        raise SystemExit(f"router smoke: {leg} loadgen failed")
+    summary = json.loads(out.read_text())
+    assert not summary["errors"], (leg, summary["errors"])
+    assert summary["completed"] == requests, (leg, summary)
+    return summary
+
+
+def main() -> int:
+    if len(sys.argv) not in (2, 3):
+        print(__doc__, file=sys.stderr)
+        return 2
+    scratch = Path(sys.argv[1])
+    # a previous (crashed) invocation's cards/journals must not pollute
+    shutil.rmtree(scratch, ignore_errors=True)
+    scratch.mkdir(parents=True, exist_ok=True)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("LLMT_CHAOS_ROUTER_KILL_REPLICA", None)
+    env.pop("LLMT_CHAOS_ROUTER_BLACKHOLE", None)
+
+    seed = _seed_checkpoints(
+        scratch, sys.argv[2] if len(sys.argv) == 3 else None, env
+    )
+    for leg in ("kill", "blackhole"):
+        dst = scratch / leg / _RUN_SUFFIX
+        dst.mkdir(parents=True, exist_ok=True)
+        shutil.copytree(seed / "checkpoints", dst / "checkpoints")
+
+    # --- 1. failover: SIGKILL the producing replica at forwarded token 5
+    print("router smoke: failover leg (chaos kill mid-stream)...", flush=True)
+    summary = _loadgen(
+        scratch, "kill",
+        {**env, "LLMT_CHAOS_ROUTER_KILL_REPLICA": "5"},
+        requests=4, max_new_tokens=16, extra=[],
+    )
+    stats = summary["engine"]
+    assert stats["failovers"] >= 1.0, stats
+    assert stats["replays"] >= 1.0, (
+        f"no in-flight request replayed across the kill: {stats}"
+    )
+    assert stats["requests_completed"] == 4.0, stats
+    fleet = summary["fleet"]
+    assert fleet["verdict"] == "green", (
+        f"fleet not green after replacement replica armed: {fleet['verdict']}"
+        f" red={fleet['red']} stale={fleet['stale_cards']}"
+    )
+    assert fleet["rollup"]["llmt_fleet_router_requests_completed"] == 4.0, fleet
+    print(
+        "router smoke: failover OK —"
+        f" {int(stats['failovers'])} failover,"
+        f" {int(stats['replays'])} replay(s),"
+        f" {int(stats['recovered_tokens'])} journal-recovered token(s),"
+        " fleet green", flush=True,
+    )
+
+    # --- report renders the router section with a green exactly-once line
+    run_dir = scratch / "kill" / _RUN_SUFFIX
+    report = subprocess.run(
+        [sys.executable, "-m", "llm_training_tpu", "report", str(run_dir)],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert report.returncode == 0, report.stderr
+    assert "== Router ==" in report.stdout, report.stdout
+    assert "exactly-once: green (4/4 terminals)" in report.stdout, report.stdout
+
+    # --- 2. hedging: blackhole one submission, the hedge must deliver
+    print("router smoke: blackhole leg (hedged retry)...", flush=True)
+    summary = _loadgen(
+        scratch, "blackhole",
+        {**env, "LLMT_CHAOS_ROUTER_BLACKHOLE": "1"},
+        requests=2, max_new_tokens=8,
+        extra=["--hedge-ttft-ms", "1500"],
+    )
+    stats = summary["engine"]
+    assert stats["blackholed"] == 1.0, stats
+    assert stats["hedges"] >= 1.0, f"blackholed request never hedged: {stats}"
+    assert stats["hedge_wins"] >= 1.0, stats
+    assert stats["requests_completed"] == 2.0, stats
+    print(
+        "router smoke: hedge OK —"
+        f" {int(stats['hedges'])} hedge(s),"
+        f" {int(stats['hedge_wins'])} win(s),"
+        f" {int(stats['duplicate_terminals_suppressed'])} duplicate"
+        " terminal(s) suppressed", flush=True,
+    )
+
+    print("router smoke: OK — failover exactly-once, hedged blackhole")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,11 +41,20 @@ the engine's own `serve/*` stats record (throughput, shed/deadline/replay
 counters, pool pressure) with the client percentiles and a per-stop_reason
 terminal census, prints one JSON object, and exits nonzero on any failure.
 
+`--router` drives the `route` fleet tier instead of a bare serve child
+(docs/serving.md#router): the same exactly-once-terminal audit applies
+across a chaos-injected mid-stream replica SIGKILL
+(LLMT_CHAOS_ROUTER_KILL_REPLICA), and with `--fleet-dir` the all-terminal
+moment additionally sweeps the fleet and asserts the rollup's
+`router_requests_completed` still equals the client census after the
+failover replay.
+
 Usage:
     python scripts/serve_loadgen.py --config <yaml> [overrides...] \
         [--requests 4] [--max-new-tokens 8] [--arrival {overlap,burst}] \
         [--deadline-ms 0 --deadline-every 2] [--malformed 0] \
-        [--supervised] [--out summary.json] [-- <extra serve args>]
+        [--supervised | --router] [--out summary.json] \
+        [-- <extra serve args>]
 """
 
 from __future__ import annotations
@@ -200,10 +209,57 @@ def build_requests(args) -> list[dict]:
     return requests
 
 
+def check_misplaced_flags(
+    serve_args: list[str], passthrough: list[str], argv: list[str] | None = None
+) -> None:
+    """The PR 16 argparse watch-out, made loud: with an otherwise-empty
+    `serve_args` positional, `parse_known_args` assigns the token FOLLOWING
+    the first unknown flag to the positional — the flag's value silently
+    vanishes into serve_args while the flag itself lands in passthrough
+    (`--max-batch 2` becomes serve_args=['2'] + passthrough=['--max-batch']).
+    Any positional token that appears AFTER the first unknown flag on the
+    command line is that swallow; error loudly and demand `--`. Flags after
+    genuine positionals (the precommit idiom: `run_root=/x --max-batch 2`)
+    keep order and stay legal."""
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if "--" in argv:
+        return  # explicit separator: everything after it is intentional
+    unknown_positions = [argv.index(tok) for tok in set(passthrough) if tok in argv]
+    if not unknown_positions:
+        return
+    first_unknown = min(unknown_positions)
+    for token in serve_args:
+        try:
+            index = argv.index(token)
+        except ValueError:
+            continue
+        if index > first_unknown:
+            raise SystemExit(
+                f"error: positional {token!r} follows the unknown flag "
+                f"{argv[first_unknown]!r} — argparse would silently swallow "
+                "the flag's value into serve_args. Put child flags after "
+                "an explicit `--` separator (e.g. `-- "
+                f"{argv[first_unknown]} {token}`)."
+            )
+
+
 def build_child_argv(args) -> list[str]:
-    """The plain `serve` command, or the supervised wrapper that relaunches
-    it on exit 75 / signal deaths (drain + journal replay,
-    docs/serving.md#resilience)."""
+    """The plain `serve` command, the `route` fleet tier (`--router`), or
+    the supervised wrapper that relaunches serve on exit 75 / signal deaths
+    (drain + journal replay, docs/serving.md#resilience)."""
+    if args.router:
+        argv = [
+            sys.executable, "-m", "llm_training_tpu", "route",
+            "--config", args.config,
+            "--replicas", str(args.router_replicas),
+        ]
+        if args.router_max_replicas:
+            argv += ["--max-replicas", str(args.router_max_replicas)]
+        if args.hedge_ttft_ms:
+            argv += ["--hedge-ttft-ms", str(args.hedge_ttft_ms)]
+        if args.serve_args:
+            argv += ["--", *args.serve_args]
+        return argv
     if not args.supervised:
         return [
             sys.executable, "-m", "llm_training_tpu", "serve",
@@ -556,26 +612,59 @@ def main() -> int:
         "LLMT_FLEET_DIR for the children; default: inherit the env; "
         "unset = census by static --targets over the child ports)",
     )
+    parser.add_argument(
+        "--router", action="store_true",
+        help="drive the `route` fleet tier instead of a bare serve child "
+        "(docs/serving.md#router): same protocol audit, but the child is "
+        "the router over --router-replicas serve replicas — pair with "
+        "LLMT_CHAOS_ROUTER_* faults to prove exactly-once terminals "
+        "across a mid-stream replica kill",
+    )
+    parser.add_argument(
+        "--router-replicas", type=int, default=2,
+        help="serve replicas behind the router (--router only)",
+    )
+    parser.add_argument(
+        "--router-max-replicas", type=int, default=None,
+        help="router elasticity ceiling (--router only; default: "
+        "--router-replicas)",
+    )
+    parser.add_argument(
+        "--hedge-ttft-ms", type=float, default=0.0,
+        help="router hedge budget (--router only; 0 = hedging off)",
+    )
     parser.add_argument("--out", default=None, help="also write the summary JSON here")
     parser.add_argument(
         "serve_args", nargs="*",
         help="config overrides and extra `serve` flags (e.g. run_root=... "
         "--max-batch 2)",
     )
-    # unknown flags (e.g. --max-batch) pass through to the serve child
+    # unknown flags (e.g. --max-batch) pass through to the serve child —
+    # but a flag whose value argparse swallowed into the positional slot
+    # must error loudly, not vanish (see check_misplaced_flags)
     args, passthrough = parser.parse_known_args()
+    check_misplaced_flags(args.serve_args, passthrough)
     args.serve_args += passthrough
 
+    if args.router and (args.supervised or args.malformed or args.replicas > 1):
+        print(
+            "--router composes with none of --supervised / --malformed / "
+            "--replicas (the router owns its own fleet)", file=sys.stderr,
+        )
+        return 2
     if args.replicas > 1:
         return run_multi(args)
 
     requests = build_requests(args)
-    child_env = None
+    env_updates: dict[str, str] = {}
     if args.metrics_port:
         # the child reads LLMT_METRICS_PORT itself; setting it here keeps
         # one flag driving both sides (and supervise's env passthrough
         # carries it across relaunches)
-        child_env = {**os.environ, "LLMT_METRICS_PORT": str(args.metrics_port)}
+        env_updates["LLMT_METRICS_PORT"] = str(args.metrics_port)
+    if args.router and args.fleet_dir:
+        env_updates["LLMT_FLEET_DIR"] = str(args.fleet_dir)
+    child_env = {**os.environ, **env_updates} if env_updates else None
     child = subprocess.Popen(
         build_child_argv(args),
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, bufsize=1,
@@ -627,13 +716,36 @@ def main() -> int:
         except BrokenPipeError:
             pass  # child died; the reader loop reports it
         finally:
-            try:
-                child.stdin.close()
-            except OSError:
-                pass
+            if not args.router:
+                # router mode holds stdin open: the fleet census must sweep
+                # the router's live exporters AFTER every terminal (the
+                # reader's all-done moment closes it)
+                try:
+                    child.stdin.close()
+                except OSError:
+                    pass
 
     feeder = threading.Thread(target=feed, daemon=True)
     feeder.start()
+
+    router_fleet: dict | None = None
+    census_taken = False
+
+    def router_all_done_census() -> None:
+        """The --router census moment: every request just went terminal,
+        the router and its replicas are quiescent but still alive (stdin
+        held open) — sweep the fleet NOW, then release the router."""
+        nonlocal router_fleet
+        if scraper is not None:
+            scraper.scrape_final()
+        if args.fleet_dir:
+            from llm_training_tpu.telemetry.fleet import FleetAggregator
+
+            router_fleet = FleetAggregator(fleet_dir=args.fleet_dir).sweep()
+        try:
+            child.stdin.close()
+        except OSError:
+            pass
 
     timer = threading.Timer(args.idle_timeout_s, child.kill)
     timer.start()
@@ -666,13 +778,15 @@ def main() -> int:
                 # request that never streams wedges the run until the idle
                 # timeout
                 first_token_seen.set()
-                if scraper is not None and all(
-                    r["id"] in done for r in requests
-                ):
+                if not census_taken and all(r["id"] in done for r in requests):
                     # every request just went terminal: the engine is
                     # quiescent NOW (nothing queued or running), so this
                     # synchronous scrape is the exact-census moment
-                    scraper.scrape_final()
+                    census_taken = True
+                    if args.router:
+                        router_all_done_census()
+                    elif scraper is not None:
+                        scraper.scrape_final()
             elif kind == "stats":
                 stats = event["stats"]  # last record wins across relaunches
             elif kind == "error":
@@ -700,11 +814,27 @@ def main() -> int:
             failures.append(f"{rid}: unknown stop_reason {reason!r}")
         elif reason in ("eos", "max_tokens") and not chunks.get(rid):
             failures.append(f"{rid}: done without any streamed token chunks")
-    leaked = stats.get("decode/cache_blocks_in_use")
-    if leaked is None:
-        failures.append("no stats record from the child")
-    elif leaked:
-        failures.append(f"pool leak: {int(leaked)} blocks still in use at exit")
+    if args.router:
+        # the final stats record is router/*-shaped: the pool-leak check
+        # belongs to the replicas (the router audits its own census)
+        if not stats:
+            failures.append("no stats record from the router")
+        else:
+            total = stats.get("requests_total", -1)
+            terminals = stats.get("requests_completed", 0) + stats.get(
+                "requests_failed", 0
+            )
+            if terminals != total:
+                failures.append(
+                    f"router census not exactly-once: {terminals} terminals "
+                    f"for {total} routed requests"
+                )
+    else:
+        leaked = stats.get("decode/cache_blocks_in_use")
+        if leaked is None:
+            failures.append("no stats record from the child")
+        elif leaked:
+            failures.append(f"pool leak: {int(leaked)} blocks still in use at exit")
     # the serve process also answers chaos-injected junk
     # (LLMT_CHAOS_SERVE_MALFORMED_FLOOD) with error chunks on this stream
     expected_errors = args.malformed + int(
@@ -715,7 +845,10 @@ def main() -> int:
             f"only {len(error_chunks)} error chunk(s) for "
             f"{expected_errors} malformed line(s)"
         )
-    peak = stats.get("serve/peak_running", 0)
+    peak = (
+        stats.get("peak_inflight", 0) if args.router
+        else stats.get("serve/peak_running", 0)
+    )
     if args.arrival == "overlap" and len(requests) > 1 and peak < 2:
         failures.append(
             f"arrivals never overlapped (peak_running {peak}) — raise "
@@ -743,6 +876,23 @@ def main() -> int:
             failures.append(
                 "no parse-valid scrape at the all-terminal moment"
             )
+        elif args.router:
+            for gauge in ("llmt_router_queue_depth", "llmt_router_inflight"):
+                if final.get(gauge, 0.0) != 0.0:
+                    failures.append(
+                        f"router not quiescent at the final scrape: "
+                        f"{gauge} = {final[gauge]}"
+                    )
+            client_completed = sum(
+                1 for event in done.values()
+                if event.get("stop_reason") in ("eos", "max_tokens")
+            )
+            scraped = final.get("llmt_router_requests_completed")
+            if scraped != float(client_completed):
+                failures.append(
+                    f"exporter/router drift: scraped requests_completed "
+                    f"{scraped} != client census {client_completed}"
+                )
         else:
             for gauge in ("llmt_serve_queue_depth", "llmt_serve_running"):
                 if final.get(gauge, 0.0) != 0.0:
@@ -766,6 +916,36 @@ def main() -> int:
                         f"{client_completed}"
                     )
 
+    # --- --router + --fleet-dir: the fleet rollup at the all-terminal
+    # sweep must still match the client census even after a mid-stream
+    # replica kill and failover replay (satellite of the failover proof)
+    if args.router and args.fleet_dir:
+        if router_fleet is None:
+            failures.append(
+                "--fleet-dir set but the all-terminal fleet sweep never ran "
+                "(did every request get a terminal?)"
+            )
+        else:
+            if router_fleet["verdict"] != "green":
+                failures.append(
+                    f"fleet verdict {router_fleet['verdict']!r} at the "
+                    f"census moment (red={router_fleet['red']}, "
+                    f"stale={router_fleet['stale_cards']})"
+                )
+            client_completed = sum(
+                1 for event in done.values()
+                if event.get("stop_reason") in ("eos", "max_tokens")
+            )
+            rolled = router_fleet["rollup"].get(
+                "llmt_fleet_router_requests_completed"
+            )
+            if rolled != float(client_completed):
+                failures.append(
+                    f"fleet census drift after failover: rollup "
+                    f"router_requests_completed {rolled} != client census "
+                    f"{client_completed}"
+                )
+
     ttft = [
         1000.0 * (first_token_s[r] - submit_s[r]) for r in first_token_s
     ]
@@ -788,6 +968,18 @@ def main() -> int:
     }
     if scrape_summary is not None:
         summary["scrape"] = scrape_summary
+    if router_fleet is not None:
+        summary["fleet"] = {
+            "verdict": router_fleet["verdict"],
+            "red": router_fleet["red"],
+            "stale_cards": router_fleet["stale_cards"],
+            "rollup": {
+                key: value
+                for key, value in router_fleet["rollup"].items()
+                if key.startswith(("llmt_fleet_router_", "llmt_fleet_serve_",
+                                   "llmt_fleet_replicas"))
+            },
+        }
     if ttft:
         summary["client_ttft_p50_ms"] = round(percentile(ttft, 50), 3)
         summary["client_ttft_p99_ms"] = round(percentile(ttft, 99), 3)

@@ -510,3 +510,149 @@ def test_slo_observe_vs_scrape_read_obeys_lock_order(seed, tmp_path):
     seen = [a for a in alerts if a is not None]
     for alert in seen:
         assert alert["key"] == "serve/ttft_p99_ms" and "burn_fast" in alert
+
+
+# ------------------------------------------------- router: assignment vs death
+
+
+class _RouterStubHandle:
+    def __init__(self, rid: str, port: int):
+        self.rid = rid
+        self.port = port
+
+
+def _router_under(run: Interleaver, tmp_path):
+    from llm_training_tpu.serve.router import Router
+
+    with instrumented_locks(run):
+        journal = RequestJournal(tmp_path / "router-journal.jsonl")
+        router = Router()
+    router.journal = journal
+    journal._lock.rename("journal")
+    router._lock.rename("router")
+    return router, journal
+
+
+def test_lock_order_declares_router_before_journal():
+    """The router appends journal records (assignment notes, progress)
+    while holding its own lock, so the contract table must sort `router`
+    before `journal` — and keep it a distinct label."""
+    assert "router" in contracts.LOCK_ORDER
+    assert contracts.LOCK_ORDER.index("router") < contracts.LOCK_ORDER.index(
+        "journal"
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_router_assignment_vs_replica_death_window(tmp_path, seed):
+    """The ISSUE's hairy window: the main loop assigning + folding chunks
+    from replica r0 while the EOF path declares r0 dead and folds its
+    journal. Under EVERY schedule: at most one terminal ever reaches the
+    client, the request is never lost (finished, re-assignable, or
+    orphaned — never vanished), and every recorded lock edge obeys
+    contracts.LOCK_ORDER (router -> journal, never inverted)."""
+    router, journal = _router_under(run := Interleaver(seed=seed), tmp_path)
+    router.register_replica(_RouterStubHandle("r0", 9001))
+    router.register_replica(_RouterStubHandle("r1", 9002))
+    req = router.intake({"id": "req-0", "prompt": [1, 2], "max_new_tokens": 8})
+    events = []
+    failover = {}
+
+    def main_loop():
+        sched_point("assign")
+        router.assign(req)
+        sched_point("token")
+        events.extend(router.record_token("r0", {"id": "r0::req-0", "token": 5}))
+        sched_point("done")
+        events.extend(
+            router.record_done("r0", {"id": "r0::req-0", "stop_reason": "eos"})
+        )
+
+    def death():
+        sched_point("death")
+        folded = [
+            {
+                "id": "r0::req-0",
+                "client_id": "req-0",
+                "source_replica": "r0",
+                "prompt": [1, 2],
+                "generated": [5],
+                "emitted": 1,
+                "max_new_tokens": 8,
+                "priority": 0,
+            }
+        ]
+        failover.update(router.fail_replica("r0", folded))
+
+    run.thread(main_loop, name="main")
+    run.thread(death, name="death")
+    run.run()
+    run.assert_lock_order()
+
+    terminals = [e for e in events + failover.get("events", []) if e.get("type") == "done"]
+    assert len(terminals) <= 1, terminals
+    stats = router.stats()
+    assert stats["duplicate_terminals_suppressed"] + stats["suppressed_chunks"] >= 0
+    if terminals:
+        # finished exactly once: tombstoned, dedupes forever, nothing orphaned
+        assert stats["requests_completed"] == 1
+        assert failover.get("orphans", []) == []
+        assert router.inflight() == 0
+        assert router.intake({"id": "req-0", "prompt": [1, 2]}) is None
+    else:
+        # replica died before the terminal: the request survives as an
+        # orphan (resubmittable) or as a still-registered in-flight entry
+        orphans = failover.get("orphans", [])
+        assert [o.id for o in orphans] == ["req-0"] or router.inflight() == 1
+        assert stats["requests_completed"] == 0
+    # the journal stayed line-parseable under the interleaving and its fold
+    # agrees with the terminal outcome
+    journal.close()
+    lines = (tmp_path / "router-journal.jsonl").read_text().splitlines()
+    assert all(json.loads(line) for line in lines)
+    remainder = replay_journal(tmp_path / "router-journal.jsonl")
+    if terminals:
+        assert remainder == []
+    else:
+        assert [e["id"] for e in remainder] == ["req-0"]
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_router_live_stats_scrape_never_observes_torn_counters(tmp_path, seed):
+    """The exporter's extra_fn (HTTP thread) scraping live_stats() while
+    the main loop registers and finishes requests: every snapshot must be
+    internally consistent — a request is in-flight XOR terminal, so
+    total == completed + failed + inflight at every observation point."""
+    router, journal = _router_under(run := Interleaver(seed=seed), tmp_path)
+    router.register_replica(_RouterStubHandle("r0", 9001))
+    reqs = [
+        router.intake({"id": f"req-{n}", "prompt": [n], "max_new_tokens": 4})
+        for n in range(3)
+    ]
+    snapshots = []
+
+    def main_loop():
+        for n, req in enumerate(reqs):
+            sched_point(f"assign:{n}")
+            router.assign(req)
+            sched_point(f"done:{n}")
+            router.record_done(
+                "r0", {"id": f"r0::req-{n}", "stop_reason": "eos"}
+            )
+
+    def scrape():
+        for n in range(5):
+            sched_point(f"scrape:{n}")
+            snapshots.append(router.live_stats())
+
+    run.thread(main_loop, name="main")
+    run.thread(scrape, name="scrape")
+    run.run()
+    run.assert_lock_order()
+    assert snapshots
+    for snap in snapshots:
+        total = snap["router/requests_total"]
+        settled = snap["router/requests_completed"] + snap["router/requests_failed"]
+        assert total == settled + snap["router/inflight"], snap
+    assert router.stats()["requests_completed"] == 3
+    journal.close()
