@@ -55,6 +55,15 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "the loadgen drives the serve CLI as a subprocess and must keep "
         "feeding/timing requests while the child owns the backend"
     ),
+    "llm_training_tpu/rl/reward.py": (
+        "verifiable rewards are pure host scoring over token lists, run "
+        "on the rollout-collection path between engine steps — importing "
+        "a backend there couples scoring latency to device state"
+    ),
+    "scripts/rl_smoke.py": (
+        "the RL smoke drives the rl-fit CLI as a subprocess, exactly "
+        "like the loadgen — the child owns the backend"
+    ),
     "llm_training_tpu/telemetry/trace.py": (
         "the serve scheduler (host-only policy) imports the tracer at "
         "module level, and the trace/report/export paths must run anywhere "
@@ -118,6 +127,7 @@ ENV_DOC_FILES = (
     "docs/config.md",
     "docs/parallelism.md",
     "docs/static-analysis.md",
+    "docs/post-training.md",
 )
 
 # ---------------------------------------------------------------- rule 6
@@ -197,6 +207,11 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
         "stdin reader thread while the engine journals progress from the "
         "step loop (the PR 12 lost-delivery race class)",
     },
+    "llm_training_tpu/rl/rollout.py": {
+        "RolloutCollector": "the collection loop bumps rollout counters "
+        "between engine steps while the rl-fit exporter's scrape threads "
+        "read stats() per /metrics request",
+    },
     "llm_training_tpu/serve/router.py": {
         "Router": "the route CLI's main loop mutates routing state while "
         "the exporter's scrape threads render live_stats() and the "
@@ -246,6 +261,9 @@ LOCK_ORDER = (
                  # _current_lock (admission state only; counter/tracer
                  # side effects and jax.profiler calls all happen after
                  # release, so no edge into trace/registry)
+    "rl",        # rl/rollout.py RolloutCollector._lock (counter dict
+                 # only; harvest/trace side effects emit after release,
+                 # so no edge into trace/registry beyond the leaf order)
     "journal",   # serve/journal.py RequestJournal._lock
     "trace",     # telemetry/trace.py TraceRecorder._lock + _current_lock
     "registry",  # telemetry/registry.py TelemetryRegistry._lock (leaf)

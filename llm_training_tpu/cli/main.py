@@ -1,5 +1,5 @@
-"""Console entry: fit / validate / generate / serve / evaluate / report /
-trace / watch / fleet / supervise.
+"""Console entry: fit / validate / generate / serve / rl-fit / evaluate /
+report / trace / watch / fleet / supervise.
 
 Capability parity: reference `cli/main.py:4-5` + LightningCLI wiring
 (`lightning/cli/cli.py:17-83`): YAML -> instantiated Trainer / objective /
@@ -236,13 +236,19 @@ def _run_generate(args, config: dict) -> int:
     )
     result = engine.generate(prompts, generate_config)
     for row, tokens in enumerate(result["tokens"]):
-        print(json.dumps({
+        record = {
             "prompt": prompts[row],
             "tokens": tokens,
             "sequence": result["sequences"][row],
             "n_tokens": result["lengths"][row],
             "stop_reason": result["stop_reasons"][row],
-        }))
+        }
+        if args.logprobs:
+            # per-token logprob of each CHOSEN token under the sampled
+            # distribution (temperature+filter applied; raw log_softmax
+            # when greedy) — docs/inference.md#logprobs
+            record["logprobs"] = result["logprobs"][row]
+        print(json.dumps(record))
     print(json.dumps({"stats": result["stats"]}))
     _publish_run_telemetry(config, result["stats"])
     return 0
@@ -646,6 +652,174 @@ def _run_serve(args, config: dict) -> int:
     return rc
 
 
+def _run_rl_fit(args, config: dict) -> int:
+    """`rl-fit`: on-policy GRPO post-training riding the serving engine
+    (docs/post-training.md). Each round collects N samples per prompt
+    through the `ServingEngine` scheduler (rollouts are a dedicated
+    priority class below user traffic), scores them with a verifiable
+    reward, applies one group-relative policy-gradient update, then syncs
+    the new weights into the engine (`rl/sync.py` — fused on-device by
+    default). Per-round {"type": "rl_round"} records stream on stdout; a
+    final {"type": "stats"} record carries the rl/* + serve/* gauges
+    (merged into the run dir's telemetry.jsonl for `report`'s == RL ==
+    section).
+
+    Resilience mirrors serve: SIGTERM drains in-flight rollouts into the
+    request journal, checkpoints the weights they were sampled under
+    (plus the round cursor), and exits 75; the relaunch restores the
+    checkpoint, replays the journal, and ADOPTS the replayed rollouts as
+    current-generation — sound because the checkpoint always follows the
+    sync, so restored weights match the rollouts' weights."""
+    import json
+
+    from llm_training_tpu.callbacks.loggers import _primary_host
+    from llm_training_tpu.infer import SamplingConfig
+    from llm_training_tpu.lms import GRPO
+    from llm_training_tpu.resilience import (
+        RESUMABLE_EXIT_CODE,
+        GracefulShutdown,
+        config_from_env,
+        install_chaos,
+        uninstall_chaos,
+    )
+    from llm_training_tpu.rl.loop import RLLoop, RLLoopOptions
+    from llm_training_tpu.serve import RequestJournal, ServeConfig, replay_journal
+    from llm_training_tpu.telemetry import get_registry
+    from llm_training_tpu.telemetry.exporter import start_exporter
+    from llm_training_tpu.telemetry.slo import build_slo_monitor
+    from llm_training_tpu.telemetry.trace import get_tracer
+
+    log = logging.getLogger(__name__)
+    trainer, objective, _ = _build(config)
+    if not isinstance(objective, GRPO):
+        raise SystemExit(
+            "rl-fit drives the GRPO objective; the config's model node "
+            f"builds {type(objective).__name__} — point rl-fit at a config "
+            "whose model node is llm_training_tpu.lms.GRPO wrapping the "
+            "policy model"
+        )
+    run_dir = _jsonl_run_dir(config)
+    primary = _primary_host()
+    trace_attached = False
+    if run_dir is not None and primary:
+        trace_attached = get_tracer().attach_sink(run_dir / "trace.jsonl")
+
+    serve_config = ServeConfig(
+        max_batch=args.max_batch,
+        max_model_len=args.max_model_len,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        prefill_chunk=args.prefill_chunk,
+        max_queue=args.max_queue,
+        cache_dtype=args.cache_dtype,
+        seed=args.seed,
+        eos_token_id=(
+            args.eos_token_id if args.eos_token_id is not None
+            else _scalar_eos(objective.model.config)
+        ),
+        sampling=SamplingConfig(
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p
+        ),
+    )
+    # serve chaos (LLMT_CHAOS_SERVE_*) fires inside engine.step, so the
+    # SIGTERM-mid-rollout drill exercises the drain/journal/adopt path
+    install_chaos(config_from_env())
+    shutdown = GracefulShutdown().install()
+    slo = build_slo_monitor(
+        registry=get_registry(), run_dir=run_dir if primary else None
+    )
+
+    loop = RLLoop(
+        trainer, objective, serve_config,
+        RLLoopOptions(
+            rounds=args.rounds,
+            prompts_per_round=args.prompts_per_round,
+            prompt_len=args.prompt_len,
+            max_new_tokens=args.max_new_tokens,
+            sync_mode=args.sync_mode,
+            reward=args.reward,
+            prompt_style=args.prompt_style,
+            rollout_priority=args.rollout_priority,
+            updates_per_round=args.updates_per_round,
+            user_traffic=args.user_traffic,
+            yield_steps=args.yield_steps,
+            resume_step=int(args.ckpt_path) if args.ckpt_path else None,
+        ),
+        slo=slo,
+    )
+    loop.setup()
+    engine = loop.engine
+    exporter = start_exporter(
+        registry=get_registry(),
+        slo=slo,
+        role="rl-fit",
+        extra_fn=lambda: {**engine.live_stats(), **loop.collector.stats()},
+        status_fn=lambda: {
+            "engine step": engine._step_index,
+            "queue depth": len(engine.scheduler.waiting),
+            "running": len(engine.scheduler.running),
+        },
+    )
+
+    # rollout journal: same rotation contract as serve — the backup
+    # survives until every entry is re-accepted into the fresh journal
+    journal_path = (
+        run_dir / "rl-journal.jsonl"
+        if run_dir is not None and primary else None
+    )
+    backup_path = None
+    resumed = []
+    if journal_path is not None:
+        backup_path = journal_path.with_name("rl-journal.replaying.jsonl")
+        if journal_path.exists():
+            with open(backup_path, "a") as backup:
+                backup.write(journal_path.read_text())
+            journal_path.unlink()
+        if backup_path.exists():
+            resumed = replay_journal(backup_path)
+        engine.attach_journal(
+            RequestJournal(journal_path), every=args.journal_every
+        )
+    if resumed:
+        log.warning(
+            "replaying %d journaled rollout(s) from the previous rl-fit "
+            "process", len(resumed),
+        )
+        # adopt FIRST so the replayed token events route into the
+        # collector's pending entries instead of the foreign path
+        loop.collector.adopt(resumed)
+        for entry in resumed:
+            loop.collector.ingest(engine.submit_resumed(entry))
+    if backup_path is not None and backup_path.exists():
+        backup_path.unlink()
+
+    result = loop.run(
+        shutdown=shutdown,
+        emit=lambda record: print(json.dumps(record), flush=True),
+    )
+    rc = RESUMABLE_EXIT_CODE if result["interrupted"] else 0
+    if rc:
+        log.warning(
+            "%s: rollouts journaled and round cursor checkpointed — "
+            "exiting %d (resumable)",
+            shutdown.reason, RESUMABLE_EXIT_CODE,
+        )
+    stats = result["gauges"]
+    if trace_attached:
+        get_tracer().detach_sink()
+    print(json.dumps({"type": "stats", "stats": stats}), flush=True)
+    _publish_run_telemetry(config, stats)
+    if engine.journal is not None and rc == 0:
+        engine.journal.close()
+        if journal_path is not None:
+            journal_path.unlink(missing_ok=True)
+    if exporter is not None:
+        exporter.stop()
+    uninstall_chaos()
+    shutdown.uninstall()
+    return rc
+
+
 def _scalar_eos(model_config) -> int | None:
     """The config's eos id when it is a single int (list-valued eos —
     Llama-3.x instruct — would need multi-token stop support; decode then
@@ -783,6 +957,12 @@ def main(argv: list[str] | None = None) -> int:
         "--eos-token-id", type=int, default=None,
         help="stop token (default: the model config's scalar eos, if any)",
     )
+    generate.add_argument(
+        "--logprobs", action="store_true",
+        help="include each generated token's logprob (under the sampled "
+        "temperature/top-k/top-p distribution; raw log-softmax when "
+        "greedy) in the output records",
+    )
     generate.add_argument("overrides", nargs="*")
     serve = sub.add_parser(
         "serve",
@@ -855,6 +1035,93 @@ def main(argv: list[str] | None = None) -> int:
         help="stop token (default: the model config's scalar eos, if any)",
     )
     serve.add_argument("overrides", nargs="*")
+    rl_fit = sub.add_parser(
+        "rl-fit",
+        help="on-policy GRPO post-training: rollouts through the serving "
+        "engine, group-relative policy-gradient updates, on-device weight "
+        "sync each round (docs/post-training.md)",
+    )
+    rl_fit.add_argument("--config", required=True)
+    rl_fit.add_argument(
+        "--ckpt-path", default=None,
+        help="checkpoint step to restore the policy from (default: newest; "
+        "fresh seed-init when none exists)",
+    )
+    rl_fit.add_argument("--rounds", type=int, default=4)
+    rl_fit.add_argument(
+        "--prompts-per-round", type=int, default=2,
+        help="prompt groups per round (x the objective's group_size "
+        "samples each)",
+    )
+    rl_fit.add_argument(
+        "--prompt-len", type=int, default=4,
+        help="synthetic prompt length (deterministic in seed and round)",
+    )
+    rl_fit.add_argument("--max-new-tokens", type=int, default=8)
+    rl_fit.add_argument(
+        "--sync-mode", default="fused", choices=("fused", "host"),
+        help="trainer->engine weight sync: fused = on-device resharding "
+        "(default), host = device_get/device_put round-trip (the "
+        "correctness oracle; docs/post-training.md#weight-sync)",
+    )
+    rl_fit.add_argument(
+        "--reward", default=None,
+        help="verifiable reward name (copy_digit/regex/numeric_answer/"
+        "length; default: LLMT_RL_REWARD, else copy_digit)",
+    )
+    rl_fit.add_argument(
+        "--prompt-style", default="uniform", choices=("uniform", "repeat"),
+        help="synthetic prompt shape: uniform random tokens, or one digit "
+        "repeated (the copy-the-digit smoke task)",
+    )
+    rl_fit.add_argument(
+        "--updates-per-round", type=int, default=1,
+        help="PPO-style epochs over each round's batch (the clipped "
+        "importance ratio keeps >1 sound)",
+    )
+    rl_fit.add_argument(
+        "--rollout-priority", type=int, default=-1,
+        help="scheduler priority class for rollout requests (default -1: "
+        "below user traffic's 0, so contention sheds rollouts first)",
+    )
+    rl_fit.add_argument(
+        "--user-traffic", type=int, default=0,
+        help="synthetic priority-0 user requests submitted per round "
+        "alongside the rollouts (their latencies feed the serve SLO "
+        "windows; rollout latencies do not)",
+    )
+    rl_fit.add_argument(
+        "--yield-steps", type=int, default=50,
+        help="engine steps rollout submission backs off after a NEW serve "
+        "SLO burn-rate breach (LLMT_SLO_* targets arm the monitor)",
+    )
+    rl_fit.add_argument(
+        "--max-batch", type=int, default=4, help="decode slots (static batch)"
+    )
+    rl_fit.add_argument("--max-model-len", type=int, default=256)
+    rl_fit.add_argument("--block-size", type=int, default=None)
+    rl_fit.add_argument("--num-blocks", type=int, default=None)
+    rl_fit.add_argument("--prefill-chunk", type=int, default=32)
+    rl_fit.add_argument(
+        "--max-queue", type=int, default=None,
+        help="intake bound; overflow sheds lowest-priority (rollouts) first",
+    )
+    rl_fit.add_argument(
+        "--journal-every", type=int, default=1,
+        help="engine steps between rollout-journal progress checkpoints",
+    )
+    rl_fit.add_argument(
+        "--cache-dtype", default=None, choices=("param", "float32", "bfloat16")
+    )
+    rl_fit.add_argument(
+        "--temperature", type=float, default=1.0,
+        help="rollout sampling temperature (must be > 0 for exploration)",
+    )
+    rl_fit.add_argument("--top-k", type=int, default=None)
+    rl_fit.add_argument("--top-p", type=float, default=None)
+    rl_fit.add_argument("--seed", type=int, default=0)
+    rl_fit.add_argument("--eos-token-id", type=int, default=None)
+    rl_fit.add_argument("overrides", nargs="*")
     evaluate = sub.add_parser(
         "evaluate", help="packed perplexity / per-token NLL from a checkpoint"
     )
@@ -1159,6 +1426,8 @@ def main(argv: list[str] | None = None) -> int:
         return _run_generate(args, config)
     if args.command == "serve":
         return _run_serve(args, config)
+    if args.command == "rl-fit":
+        return _run_rl_fit(args, config)
     if args.command == "evaluate":
         return _run_evaluate(args, config)
 

@@ -39,7 +39,10 @@ from llm_training_tpu.infer.cache import (
     decode_state_shardings,
     init_decode_state,
 )
-from llm_training_tpu.infer.sampling import SamplingConfig, sample_tokens
+from llm_training_tpu.infer.sampling import (
+    SamplingConfig,
+    sample_tokens_with_logprob,
+)
 from llm_training_tpu.models.base import DecodeState
 
 logger = logging.getLogger(__name__)
@@ -157,7 +160,8 @@ class InferenceEngine:
                 decode_state=state,
             )
             logits = out.logits[:, -1, :].astype(jnp.float32)
-            return out.decode_state, sample_tokens(logits, rng, sampling)
+            token, logprob = sample_tokens_with_logprob(logits, rng, sampling)
+            return out.decode_state, token, logprob
 
         def decode_step(variables, tokens, pad_lens, state, rng):
             # per-row RoPE position: absolute cache slot minus left-pad
@@ -170,7 +174,8 @@ class InferenceEngine:
                 decode_state=state,
             )
             logits = out.logits[:, -1, :].astype(jnp.float32)
-            return out.decode_state, sample_tokens(logits, rng, sampling)
+            token, logprob = sample_tokens_with_logprob(logits, rng, sampling)
+            return out.decode_state, token, logprob
 
         # the cache is donated: k/v update in place across the token loop
         self._prefill_jit = jax.jit(prefill, donate_argnums=(4,))
@@ -185,6 +190,7 @@ class InferenceEngine:
         config: GenerateConfig | None = None,
     ) -> dict[str, Any]:
         """-> {"tokens": new tokens per row (truncated after eos),
+        "logprobs": chosen-token logprobs per row (aligned with "tokens"),
         "sequences": prompt + new tokens, "lengths": generated count per
         row, "stop_reasons": "eos" | "max_tokens" per row, "stats": decode
         telemetry}."""
@@ -230,7 +236,7 @@ class InferenceEngine:
 
             rng = jax.random.key(config.seed)
             t0 = time.perf_counter()
-            state, token = self._prefill_jit(
+            state, token, logprob = self._prefill_jit(
                 self.variables, ids_j, seg_j, pos_j, state,
                 jax.random.fold_in(rng, 0),
             )
@@ -243,19 +249,22 @@ class InferenceEngine:
                 # early-stop needs each token on host: the per-step fetch
                 # IS the stop check (and the natural decode sync point)
                 new_tokens = [np.asarray(jax.device_get(token))]
+                new_logprobs = [np.asarray(jax.device_get(logprob))]
                 step_times: list[float] = []
                 for step in range(1, config.max_new_tokens):
                     t_step = time.perf_counter()
-                    state, token = self._decode_jit(
+                    state, token, logprob = self._decode_jit(
                         self.variables, token, pad_j, state,
                         jax.random.fold_in(rng, step),
                     )
                     host_token = np.asarray(jax.device_get(token))
                     step_times.append(time.perf_counter() - t_step)
                     new_tokens.append(host_token)
+                    new_logprobs.append(np.asarray(jax.device_get(logprob)))
                     if all(eos in row for row in np.stack(new_tokens, 1)):
                         break
                 grid = np.stack(new_tokens, axis=1)  # [B, T]
+                lp_grid = np.stack(new_logprobs, axis=1)
                 steady = step_times[1:] if len(step_times) > 1 else step_times
                 steady_steps, steady_s = len(steady), sum(steady)
             else:
@@ -264,22 +273,26 @@ class InferenceEngine:
                 # for nothing. The first decode step is fenced separately
                 # so its trace+compile stays out of the steady-state rate.
                 device_tokens = [token]
+                device_logprobs = [logprob]
                 steady_steps = steady_s = 0
                 for step in range(1, config.max_new_tokens):
-                    state, token = self._decode_jit(
+                    state, token, logprob = self._decode_jit(
                         self.variables, token, pad_j, state,
                         jax.random.fold_in(rng, step),
                     )
                     device_tokens.append(token)
+                    device_logprobs.append(logprob)
                     if step == 1:
                         jax.device_get(token)  # compile fence
                         t_steady = time.perf_counter()
                 host = jax.device_get(device_tokens)  # the real fence
+                host_lp = jax.device_get(device_logprobs)
                 if config.max_new_tokens > 2:
                     steady_s = time.perf_counter() - t_steady
                     steady_steps = config.max_new_tokens - 2
                 grid = np.stack([np.asarray(t) for t in host], axis=1)
-        tokens, sequences, lengths, stop_reasons = [], [], [], []
+                lp_grid = np.stack([np.asarray(t) for t in host_lp], axis=1)
+        tokens, logprobs, sequences, lengths, stop_reasons = [], [], [], [], []
         for row in range(batch):
             emitted = grid[row].tolist()
             if eos is not None and eos in emitted:
@@ -288,6 +301,7 @@ class InferenceEngine:
             else:
                 stop_reasons.append("max_tokens")
             tokens.append(emitted)
+            logprobs.append([float(v) for v in lp_grid[row, : len(emitted)]])
             lengths.append(len(emitted))
             sequences.append(list(prompts[row]) + emitted)
 
@@ -310,6 +324,10 @@ class InferenceEngine:
         )
         return {
             "tokens": tokens,
+            # chosen-token logprob per emitted token, under the sampled
+            # distribution (raw for greedy, filtered for temperature > 0 —
+            # see infer/sampling.py:sample_tokens_with_logprob)
+            "logprobs": logprobs,
             "sequences": sequences,
             # per-row generated length + why each row stopped ("eos" |
             # "max_tokens") — callers (serve scheduler, evaluate, bench)

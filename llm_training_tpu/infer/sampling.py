@@ -68,6 +68,20 @@ def top_p_filter(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits >= threshold, logits, _FILTERED)
 
 
+def filtered_logits(logits: jnp.ndarray, config: SamplingConfig) -> jnp.ndarray:
+    """Apply the temperature/top-k/top-p pipeline (HF order) to raw logits.
+
+    The result is the logits of the distribution the categorical draw
+    actually samples from — the behavior policy an RL importance ratio
+    must be computed against. Only meaningful for temperature > 0."""
+    logits = logits / jnp.float32(config.temperature)
+    if config.top_k is not None:
+        logits = top_k_filter(logits, config.top_k)
+    if config.top_p is not None:
+        logits = top_p_filter(logits, config.top_p)
+    return logits
+
+
 def sample_tokens(
     logits: jnp.ndarray,
     rng: jax.Array | None,
@@ -78,9 +92,34 @@ def sample_tokens(
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         raise ValueError("temperature > 0 sampling requires a PRNG key")
-    logits = logits / jnp.float32(config.temperature)
-    if config.top_k is not None:
-        logits = top_k_filter(logits, config.top_k)
-    if config.top_p is not None:
-        logits = top_p_filter(logits, config.top_p)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, filtered_logits(logits, config), axis=-1
+    ).astype(jnp.int32)
+
+
+def sample_tokens_with_logprob(
+    logits: jnp.ndarray,
+    rng: jax.Array | None,
+    config: SamplingConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """logits [batch, vocab] (fp32) -> (token ids [batch] int32,
+    chosen-token logprobs [batch] fp32).
+
+    The logprob is taken under the distribution the token was actually
+    drawn from: greedy scores under the RAW log-softmax (so logprobs
+    collected incrementally during paged decode are comparable to a
+    teacher-forced full forward over the same tokens), temperature > 0
+    scores under the temperature-scaled, top-k/top-p-filtered
+    distribution (the behavior policy for importance ratios — a token
+    outside the nucleus has ~-inf there, never the raw value)."""
+    if config.temperature == 0.0:
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        if rng is None:
+            raise ValueError("temperature > 0 sampling requires a PRNG key")
+        filtered = filtered_logits(logits, config)
+        tokens = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
+        log_probs = jax.nn.log_softmax(filtered, axis=-1)
+    chosen = jnp.take_along_axis(log_probs, tokens[:, None], axis=-1)[:, 0]
+    return tokens, chosen.astype(jnp.float32)

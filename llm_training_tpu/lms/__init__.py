@@ -10,6 +10,7 @@ no trainer state — the Trainer jits `objective.loss_and_metrics` directly.
 from llm_training_tpu.lms.base import BaseLMConfig, CausalLM, ModelProvider
 from llm_training_tpu.lms.clm import CLM, CLMConfig
 from llm_training_tpu.lms.dpo import DPO, DPOConfig
+from llm_training_tpu.lms.grpo import GRPO, GRPOConfig
 from llm_training_tpu.lms.orpo import ORPO, ORPOConfig
 
 __all__ = [
@@ -20,6 +21,8 @@ __all__ = [
     "CLMConfig",
     "DPO",
     "DPOConfig",
+    "GRPO",
+    "GRPOConfig",
     "ORPO",
     "ORPOConfig",
 ]

@@ -1,0 +1,224 @@
+"""Group Relative Policy Optimization (on-policy RL post-training).
+
+The objective half of the `rl/` loop (docs/post-training.md): rollouts
+come from `rl/rollout.py` (sampled through the serving engine, with each
+chosen token's behavior logprob collected in-stream), verifiable rewards
+from `rl/reward.py`, and this module turns one round of scored rollouts
+into a policy-gradient update:
+
+- **group-relative advantages**: N samples per prompt form a group; each
+  sample's advantage is its reward standardized against its OWN group
+  (mean/std over the N siblings) — the GRPO trick that replaces a learned
+  value baseline with the group statistic;
+- **token-level clipped policy gradient**: per-token importance ratio of
+  the current policy against the COLLECTED behavior logprobs (the policy
+  that actually sampled the rollout — one or more engine steps stale by
+  construction), PPO-clipped;
+- **KL-to-reference penalty**: the k3 estimator (unbiased, always
+  positive) against a frozen reference copy, token-level, weighted by
+  `beta`.
+
+Parameter plumbing is DPO's (lms/dpo.py): `params = {"policy": ...,
+"ref": ...}` with `^ref/` auto-frozen (structural `optax.masked` — no
+optimizer state for the reference) and `stop_gradient` around the
+reference forward. Per-token logps come from the chunked
+`fused_linear_token_log_probs` so the full [batch, seq, vocab] logits
+are never materialized; label masking reuses the CLM segment idiom.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from pydantic import ConfigDict
+
+from llm_training_tpu.lms.base import BaseLMConfig, ModelProvider
+from llm_training_tpu.lms.clm import head_and_bias
+from llm_training_tpu.ops import shift_labels
+from llm_training_tpu.ops.cross_entropy import fused_linear_token_log_probs
+
+
+def group_relative_advantages(
+    rewards: jnp.ndarray,
+    group_ids: jnp.ndarray,
+    eps: float = 1e-6,
+) -> jnp.ndarray:
+    """Standardize each sample's reward against its own prompt group:
+    (r - mean(group)) / (std(group) + eps). `group_ids` are dense ints in
+    [0, batch); a group of one (or a zero-variance group) gets advantage
+    0 — no baseline, no signal, rather than a division blow-up."""
+    n = rewards.shape[0]
+    rewards = rewards.astype(jnp.float32)
+    ones = jnp.ones_like(rewards)
+    counts = jax.ops.segment_sum(ones, group_ids, num_segments=n)
+    safe_counts = jnp.maximum(counts, 1.0)
+    mean = jax.ops.segment_sum(rewards, group_ids, num_segments=n) / safe_counts
+    centered = rewards - mean[group_ids]
+    var = (
+        jax.ops.segment_sum(centered * centered, group_ids, num_segments=n)
+        / safe_counts
+    )
+    std = jnp.sqrt(var)[group_ids]
+    return centered / (std + eps)
+
+
+class GRPOConfig(BaseLMConfig):
+    model_config = ConfigDict(extra="forbid")
+
+    model: ModelProvider | None = None
+    ref_model: ModelProvider | None = None  # defaults to a frozen copy of `model`
+    # KL-to-reference penalty weight (k3 estimator, token-level)
+    beta: float = 0.04
+    # PPO ratio clip half-width: ratios outside [1-eps, 1+eps] stop
+    # contributing gradient in the direction that widens them
+    clip_eps: float = 0.2
+    # rollout samples per prompt (the advantage group size) — the rollout
+    # collector reads this; the loss itself infers groups from group_ids
+    group_size: int = 4
+    ignore_index: int = -100
+    logps_chunk_size: int = 1024
+
+
+class GRPO:
+    def __init__(
+        self,
+        config: GRPOConfig,
+        model: Any | None = None,
+        ref_model: Any | None = None,
+    ):
+        self.config = config
+        self.model = model if model is not None else config.model.get_model()
+        if ref_model is not None:
+            self.ref_model = ref_model
+        elif config.ref_model is not None:
+            self.ref_model = config.ref_model.get_model()
+        else:
+            self.ref_model = self.model
+        if "^ref/" not in config.frozen_modules:
+            config.frozen_modules = list(config.frozen_modules) + ["^ref/"]
+
+    def init_params(self, rng: jax.Array, batch: dict[str, jnp.ndarray]) -> Any:
+        ids = batch["input_ids"][:1]
+        policy = self.model.init(rng, ids)
+        ref = (
+            self.ref_model.init(rng, ids)
+            if self.ref_model is not self.model
+            else policy
+        )
+        # the reference starts as an exact copy of the policy (the KL
+        # anchor is "the model before RL", exactly like DPO's ref)
+        return {"policy": policy, "ref": jax.tree.map(jnp.copy, ref)}
+
+    def pretrained_source(self) -> str | None:
+        from llm_training_tpu.lms.base import resolve_pretrained_source
+
+        return resolve_pretrained_source(self)
+
+    def pretrained_params(self, shardings: Any, dtypes: Any) -> Any:
+        # identical policy/ref placement problem to DPO — reuse its logic
+        from llm_training_tpu.lms.dpo import DPO
+
+        return DPO.pretrained_params(self, shardings, dtypes)
+
+    def _token_logps(self, model, params, batch):
+        """Per-token label logps [B, S] of prompt+completion sequences,
+        masked to completion positions (0 elsewhere), plus the mask."""
+        cfg = self.config
+        segment_ids = batch["segment_ids"]
+        # CLM segment masking: a position's label is the NEXT token; it is
+        # valid only when both sides sit in the same nonzero segment
+        next_seg = jnp.concatenate(
+            [segment_ids[:, 1:], jnp.zeros_like(segment_ids[:, :1])], axis=1
+        )
+        valid = (segment_ids > 0) & (segment_ids == next_seg)
+        # ...and, for RL, only where the PREDICTED token is a completion
+        # token with a usable behavior logprob (completion_mask marks
+        # completion token positions; shift it onto the label positions)
+        comp = batch["completion_mask"]
+        comp_next = jnp.concatenate(
+            [comp[:, 1:], jnp.zeros_like(comp[:, :1])], axis=1
+        )
+        valid = valid & (comp_next > 0)
+        labels = shift_labels(batch["input_ids"], cfg.ignore_index)
+        labels = jnp.where(valid, labels, cfg.ignore_index)
+        out = model.apply(
+            params,
+            input_ids=batch["input_ids"],
+            segment_ids=segment_ids,
+            position_ids=batch.get("position_ids"),
+            compute_logits=False,
+            return_last_hidden_states=True,
+        )
+        p = params["params"] if "params" in params else params
+        head, head_bias = head_and_bias(model, p)
+        logps, valids = fused_linear_token_log_probs(
+            out.last_hidden_states,
+            head.astype(out.last_hidden_states.dtype),
+            labels,
+            ignore_index=cfg.ignore_index,
+            chunk_size=cfg.logps_chunk_size,
+            logits_soft_cap=getattr(model.config, "final_logit_softcapping", None),
+            bias=head_bias,
+        )
+        return logps, valids.astype(jnp.float32)
+
+    def loss_and_metrics(
+        self,
+        params: Any,
+        batch: dict[str, jnp.ndarray],
+        rng: jax.Array | None = None,
+        train: bool = True,
+    ) -> tuple[jnp.ndarray, dict[str, jnp.ndarray]]:
+        """batch: input_ids [B,S] (prompt + completion, right-padded),
+        segment_ids [B,S], completion_mask [B,S] (1 on completion token
+        positions), behavior_logprobs [B,S] (collected logprob of the
+        token AT each position), rewards [B], group_ids [B]."""
+        cfg = self.config
+
+        policy_lp, mask = self._token_logps(self.model, params["policy"], batch)
+        ref_params = jax.lax.stop_gradient(params["ref"])
+        ref_lp, _ = self._token_logps(self.ref_model, ref_params, batch)
+
+        # behavior logprobs are collected per completion TOKEN; shift onto
+        # the label positions the policy logps live at
+        behavior = batch["behavior_logprobs"].astype(jnp.float32)
+        behavior_lp = jnp.concatenate(
+            [behavior[:, 1:], jnp.zeros_like(behavior[:, :1])], axis=1
+        )
+
+        advantages = group_relative_advantages(
+            batch["rewards"], batch["group_ids"]
+        )[:, None]
+
+        # PPO-clipped token-level policy gradient against the BEHAVIOR
+        # policy (the weights the engine sampled under — on-policy up to
+        # sync cadence, never assumed identical)
+        log_ratio = policy_lp - behavior_lp
+        ratio = jnp.exp(jnp.where(mask > 0, log_ratio, 0.0))
+        clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
+        pg = -jnp.minimum(ratio * advantages, clipped * advantages)
+
+        # k3 KL estimator to the frozen reference: always >= 0, unbiased
+        ref_log_ratio = jnp.where(mask > 0, ref_lp - policy_lp, 0.0)
+        kl = jnp.exp(ref_log_ratio) - ref_log_ratio - 1.0
+
+        n_tokens = jnp.maximum(mask.sum(), 1.0)
+        loss = (((pg + cfg.beta * kl) * mask).sum()) / n_tokens
+
+        clip_frac = (
+            (jnp.abs(ratio - 1.0) > cfg.clip_eps).astype(jnp.float32) * mask
+        ).sum() / n_tokens
+        metrics = {
+            "loss": loss,
+            "target_tokens": mask.sum().astype(jnp.int32),
+            "mean_reward": batch["rewards"].astype(jnp.float32).mean(),
+            "mean_advantage": jax.lax.stop_gradient(advantages).mean(),
+            "kl_to_ref": jax.lax.stop_gradient((kl * mask).sum() / n_tokens),
+            "ratio_clip_frac": jax.lax.stop_gradient(clip_frac),
+            "policy_logps": jax.lax.stop_gradient(
+                (policy_lp * mask).sum() / n_tokens
+            ),
+        }
+        return loss, metrics

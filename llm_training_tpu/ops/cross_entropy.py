@@ -165,3 +165,58 @@ def fused_linear_log_probs(
         (hidden_chunks, label_chunks),
     )
     return logps, counts
+
+
+def fused_linear_token_log_probs(
+    hidden: jnp.ndarray,
+    weight: jnp.ndarray,
+    labels: jnp.ndarray,
+    ignore_index: int = -100,
+    chunk_size: int = 1024,
+    logits_soft_cap: float | None = None,
+    bias: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-TOKEN label log-probs of `hidden @ weight` without full logits.
+
+    hidden: [batch, seq, embed]; labels: [batch, seq].
+    Returns (log p per token [batch, seq] fp32 — 0.0 at ignore_index
+    positions — and the validity mask [batch, seq] bool). The GRPO
+    building block (lms/grpo.py): a token-level policy gradient needs
+    each completion token's logp under policy and reference, not a
+    per-sequence sum, but must still never materialize [batch, seq,
+    vocab] logits — same chunked-remat scan as `fused_linear_log_probs`,
+    stacking per-chunk results instead of reducing them.
+    """
+    batch, seq, embed = hidden.shape
+    chunk_size = min(chunk_size, seq)
+    num_chunks = -(-seq // chunk_size)
+    pad = num_chunks * chunk_size - seq
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=ignore_index)
+
+    hidden_chunks = jnp.moveaxis(
+        hidden.reshape(batch, num_chunks, chunk_size, embed), 1, 0
+    )
+    label_chunks = jnp.moveaxis(
+        labels.reshape(batch, num_chunks, chunk_size), 1, 0
+    )
+
+    @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk_logps(h: jnp.ndarray, l: jnp.ndarray):
+        logits = jnp.dot(h, weight, preferred_element_type=jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        if logits_soft_cap is not None:
+            logits = logits_soft_cap * jnp.tanh(logits / logits_soft_cap)
+        nll, valid = _token_nll(logits, l, ignore_index)
+        return -nll, valid
+
+    def body(carry, xs):
+        return carry, chunk_logps(*xs)
+
+    _, (logps, valids) = jax.lax.scan(body, None, (hidden_chunks, label_chunks))
+    # [num_chunks, batch, chunk] -> [batch, seq(+pad)] -> strip the pad
+    logps = jnp.moveaxis(logps, 0, 1).reshape(batch, -1)[:, :seq]
+    valids = jnp.moveaxis(valids, 0, 1).reshape(batch, -1)[:, :seq]
+    return logps, valids

@@ -1,0 +1,26 @@
+"""On-policy RL post-training (docs/post-training.md).
+
+The generate → score → update loop over the serving engine:
+
+- `rl.rollout`   — RolloutCollector: prompt groups through the
+  `ServingEngine` scheduler as a dedicated priority class, per-token
+  behavior logprobs collected in-stream, every sample tagged with the
+  serve weights generation (stale samples are dropped, never trained on);
+- `rl.reward`    — jax-free pluggable verifiable rewards (env-selectable);
+- `rl.sync`      — trainer → engine weight sync: `reload_weights` host
+  round-trip as the correctness oracle, on-device resharding as the perf
+  target, stream-equivalence test-pinned;
+- `rl.loop`      — the GRPO round loop behind the `rl-fit` CLI
+  subcommand (lms/grpo.py is the objective).
+"""
+
+from llm_training_tpu.rl.reward import resolve_reward
+from llm_training_tpu.rl.rollout import Rollout, RolloutCollector
+from llm_training_tpu.rl.sync import sync_weights
+
+__all__ = [
+    "Rollout",
+    "RolloutCollector",
+    "resolve_reward",
+    "sync_weights",
+]

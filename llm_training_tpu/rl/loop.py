@@ -1,0 +1,386 @@
+"""The GRPO round loop behind `rl-fit` (docs/post-training.md#loop).
+
+One round is the on-policy state machine:
+
+    collect(W_k) -> score -> update -> W_{k+1} -> sync engine -> checkpoint
+
+- **collect**: the `RolloutCollector` pushes prompt groups through the
+  `ServingEngine` (optionally alongside synthetic user traffic at a
+  higher priority), harvesting generation-clean samples with their
+  behavior logprobs;
+- **score**: the pluggable verifiable reward (`rl/reward.py`) runs on
+  host token lists;
+- **update**: one jitted GRPO step — `value_and_grad` over
+  `GRPO.loss_and_metrics`, the trainer's own optimizer layout
+  (`_build_tx`, so `^ref/` stays structurally frozen), sharded state;
+- **sync**: `rl/sync.py` pushes `state.params["policy"]` into the
+  engine; the generation bump is what makes any still-unharvested sample
+  stale;
+- **checkpoint**: the full TrainState plus an `{"rl": {"round": k+1}}`
+  rider, AFTER the sync — so a relaunch always restores weights
+  consistent with whatever the request journal replays (a mid-rollout
+  death resumes round k+1 under W_{k+1}, and the replayed rollouts are
+  exactly W_{k+1} samples: `RolloutCollector.adopt`).
+
+Round prompts are deterministic in (seed, round), so a relaunched round
+regenerates the same prompts and adopted journal entries slot into their
+original (prompt, sample) positions.
+
+The update step does NOT donate the state: the engine aliases the live
+policy buffers between syncs (the fused sync's no-copy path), and
+donation would free them under the engine's feet. The transient extra
+copy is one policy tree per round.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+import optax
+from flax import linen as nn
+from jax.sharding import NamedSharding, PartitionSpec
+
+from llm_training_tpu.rl.reward import resolve_reward
+from llm_training_tpu.rl.rollout import Rollout, RolloutCollector
+from llm_training_tpu.rl.sync import sync_weights
+from llm_training_tpu.telemetry import get_registry
+from llm_training_tpu.telemetry.trace import get_tracer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class RLLoopOptions:
+    rounds: int = 4
+    prompts_per_round: int = 2
+    prompt_len: int = 4
+    max_new_tokens: int = 8
+    sync_mode: str = "fused"  # "fused" | "host" (rl/sync.py)
+    reward: str | None = None  # rl/reward.py name; None -> LLMT_RL_REWARD
+    # synthetic prompt shape: "uniform" = independent random tokens;
+    # "repeat" = one random digit repeated prompt_len times (the
+    # copy-the-digit smoke task: continuing the repetition is exactly
+    # what copy_digit rewards, and it is learnable by a tiny model in a
+    # few policy-gradient rounds)
+    prompt_style: str = "uniform"
+    rollout_priority: int = -1  # below user traffic's default 0
+    # PPO-style epochs over the round's (fixed) batch: the clipped ratio
+    # against the collected behavior logprobs is what makes >1 sound
+    updates_per_round: int = 1
+    user_traffic: int = 0  # synthetic priority-0 requests per round
+    yield_steps: int = 50  # SLO-breach rollout-submission backoff
+    resume_step: int | None = None
+
+
+class RLLoop:
+    """Owns the sharded TrainState, the serving engine, the collector,
+    and the jitted GRPO update. Construction is cheap; `setup()` builds
+    the mesh/state/engine; `run()` iterates rounds."""
+
+    def __init__(self, trainer, objective, serve_config, options, slo=None):
+        from llm_training_tpu.lms import GRPO
+
+        if not isinstance(objective, GRPO):
+            raise ValueError(
+                "rl-fit drives the GRPO objective; the config's model node "
+                f"builds {type(objective).__name__} — point rl-fit at a "
+                "config whose model node is llm_training_tpu.lms.GRPO"
+            )
+        self.trainer = trainer
+        self.objective = objective
+        self.serve_config = serve_config
+        self.options = options
+        self.slo = slo
+        self.reward_fn = resolve_reward(options.reward)
+        self.engine: Any = None
+        self.collector: RolloutCollector | None = None
+        self.state = None
+        self.start_round = 0
+        self._user_done = 0
+        # static update-step shapes: stale drops shrink a round's sample
+        # count, padding keeps the jit cache at one entry
+        self.batch_rows = options.prompts_per_round * objective.config.group_size
+        self.seq_len = options.prompt_len + options.max_new_tokens
+
+    # --------------------------------------------------------------- setup
+
+    def setup(self) -> None:
+        from llm_training_tpu.parallel.mesh import build_mesh
+        from llm_training_tpu.serve import ServingEngine
+        from llm_training_tpu.trainer.state import TrainState
+        from llm_training_tpu.trainer.trainer import LOGICAL_AXIS_RULES
+
+        trainer, objective = self.trainer, self.objective
+        trainer.mesh = build_mesh(trainer.config.mesh, trainer.devices)
+        self.mesh = trainer.mesh
+        with self.mesh, nn.logical_axis_rules(LOGICAL_AXIS_RULES):
+            sample_batch = {"input_ids": np.zeros((1, 8), np.int32)}
+            self.tx, _ = trainer._build_tx(objective)
+            abstract_boxed = trainer._abstract_state(
+                objective, sample_batch, self.tx
+            )
+            trainer.state_shardings = trainer._state_shardings(abstract_boxed)
+            abstract_state = nn.meta.unbox(abstract_boxed)
+            state = None
+            if trainer.checkpointer is not None:
+                restored = trainer.checkpointer.maybe_restore(
+                    abstract_state, trainer.state_shardings,
+                    self.options.resume_step,
+                )
+                if restored is not None:
+                    state, meta = restored
+                    self.start_round = int(meta.get("rl", {}).get("round", 0))
+                    logger.info(
+                        "restored step %d, resuming at RL round %d",
+                        int(state.step), self.start_round,
+                    )
+            if state is None:
+                seed = trainer.config.seed
+                tx = self.tx
+
+                def make_state(rng):
+                    params = objective.init_params(rng, sample_batch)
+                    opt_state = trainer._opt_init(tx, params)
+                    return nn.meta.unbox(
+                        TrainState.create(params, opt_state, jax.random.key(seed + 1))
+                    )
+
+                state = jax.jit(make_state, out_shardings=trainer.state_shardings)(
+                    jax.random.key(seed)
+                )
+            self.state = state
+        self.engine = ServingEngine(
+            objective.model, self.state.params["policy"], self.serve_config,
+            mesh=self.mesh, rules=LOGICAL_AXIS_RULES,
+        )
+        self.collector = RolloutCollector(
+            self.engine,
+            group_size=objective.config.group_size,
+            max_new_tokens=self.options.max_new_tokens,
+            priority=self.options.rollout_priority,
+            slo=self.slo,
+            yield_steps=self.options.yield_steps,
+            on_foreign_event=self._on_foreign,
+        )
+        self._update = self._build_update()
+
+    def _build_update(self):
+        objective, tx = self.objective, self.tx
+
+        def update_step(state, batch):
+            def loss_fn(params):
+                return objective.loss_and_metrics(params, batch, train=True)
+
+            (_, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params
+            )
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (
+                state.replace(step=state.step + 1, params=params, opt_state=opt_state),
+                metrics,
+            )
+
+        return jax.jit(
+            update_step,
+            out_shardings=(self.trainer.state_shardings, None),
+        )
+
+    # ------------------------------------------------------------- traffic
+
+    def _on_foreign(self, event: dict) -> None:
+        """Non-rollout terminals (user traffic on the shared engine) feed
+        the serve SLO windows — rollout latencies deliberately do not:
+        the SLO protects the serving product, not the trainer."""
+        if event.get("type") != "done":
+            return
+        self._user_done += 1
+        if self.slo is not None:
+            self.slo.observe_request(
+                ttft_ms=event.get("ttft_ms"),
+                tpot_ms=event.get("tpot_ms"),
+                ok=event.get("stop_reason") in ("eos", "max_tokens"),
+            )
+
+    def _round_prompts(self, round_idx: int) -> list[list[int]]:
+        """Deterministic in (seed, round): a relaunched round regenerates
+        the SAME prompts, so journal-adopted samples line up."""
+        rng = np.random.default_rng((self.trainer.config.seed, round_idx))
+        vocab = self.objective.model.config.vocab_size
+        high = max(3, vocab)
+        if self.options.prompt_style == "repeat":
+            return [
+                [int(rng.integers(2, high))] * self.options.prompt_len
+                for _ in range(self.options.prompts_per_round)
+            ]
+        return [
+            rng.integers(2, high, size=self.options.prompt_len).tolist()
+            for _ in range(self.options.prompts_per_round)
+        ]
+
+    def _submit_user_traffic(self, round_idx: int) -> None:
+        rng = np.random.default_rng((self.trainer.config.seed + 1, round_idx))
+        vocab = max(3, self.objective.model.config.vocab_size)
+        for i in range(self.options.user_traffic):
+            prompt = rng.integers(2, vocab, size=self.options.prompt_len).tolist()
+            events = self.engine.submit(
+                id=f"user:r{round_idx}:{i}", prompt=prompt,
+                max_new_tokens=self.options.max_new_tokens, priority=0,
+            )
+            self.collector.ingest(events)
+
+    # --------------------------------------------------------------- batch
+
+    def _build_batch(self, rollouts: Sequence[Rollout]) -> dict[str, np.ndarray]:
+        """Fixed-shape [batch_rows, seq_len] GRPO batch. Short rounds
+        (stale/failed drops) pad with rows whose completion_mask is all
+        zero AND whose group id is a fresh singleton — padding contributes
+        neither loss tokens nor group statistics. Group ids are densely
+        remapped so they always fit segment_sum's num_segments=batch."""
+        B, S = self.batch_rows, self.seq_len
+        input_ids = np.zeros((B, S), np.int32)
+        segment_ids = np.zeros((B, S), np.int32)
+        completion_mask = np.zeros((B, S), np.int32)
+        behavior = np.zeros((B, S), np.float32)
+        rewards = np.zeros((B,), np.float32)
+        group_ids = np.zeros((B,), np.int32)
+        gid_map: dict[int, int] = {}
+        rows = list(rollouts)[:B]
+        for row, rollout in enumerate(rows):
+            seq = list(rollout.prompt) + list(rollout.tokens)
+            length = min(len(seq), S)
+            input_ids[row, :length] = seq[:length]
+            segment_ids[row, :length] = 1
+            prompt_len = len(rollout.prompt)
+            for j, logprob in enumerate(rollout.logprobs):
+                pos = prompt_len + j
+                if pos >= S:
+                    break
+                completion_mask[row, pos] = 1
+                behavior[row, pos] = float(logprob)
+            rewards[row] = float(rollout.reward or 0.0)
+            group_ids[row] = gid_map.setdefault(rollout.prompt_idx, len(gid_map))
+        for pad in range(len(rows), B):
+            group_ids[pad] = len(gid_map) + (pad - len(rows))
+        return {
+            "input_ids": input_ids,
+            "segment_ids": segment_ids,
+            "completion_mask": completion_mask,
+            "behavior_logprobs": behavior,
+            "rewards": rewards,
+            "group_ids": group_ids,
+        }
+
+    # ----------------------------------------------------------------- run
+
+    def _checkpoint(self, next_round: int) -> None:
+        checkpointer = self.trainer.checkpointer
+        if checkpointer is None:
+            return
+        checkpointer.save(
+            int(self.state.step), self.state, force=True,
+            extra={"rl": {"round": next_round}},
+        )
+        checkpointer.wait()
+
+    def run(self, shutdown=None, emit=None) -> dict:
+        """Iterate rounds; returns the final gauge dict (rl/* + serve/*).
+        `shutdown` (GracefulShutdown) turns a SIGTERM into drain ->
+        checkpoint(current round) -> the caller exits resumable; `emit`
+        receives one JSON-able record per round (the rl_smoke contract)."""
+        options = self.options
+        registry = get_registry()
+        tracer = get_tracer()
+        should_stop = (lambda: shutdown.requested) if shutdown is not None else None
+        mean_reward = 0.0
+        interrupted = False
+        completed_rounds = 0
+        last_sync = None
+        for round_idx in range(self.start_round, options.rounds):
+            if shutdown is not None and shutdown.requested:
+                interrupted = True
+                break
+            with tracer.measure("rl", "round", round=round_idx):
+                self._submit_user_traffic(round_idx)
+                rollouts = self.collector.collect(
+                    round_idx, self._round_prompts(round_idx),
+                    should_stop=should_stop,
+                )
+                if shutdown is not None and shutdown.requested:
+                    interrupted = True
+                    break
+                for rollout in rollouts:
+                    rollout.reward = self.reward_fn(rollout.prompt, rollout.tokens)
+                mean_reward = (
+                    float(np.mean([r.reward for r in rollouts])) if rollouts else 0.0
+                )
+                metrics = {}
+                if rollouts:
+                    batch = jax.device_put(
+                        self._build_batch(rollouts),
+                        NamedSharding(self.mesh, PartitionSpec()),
+                    )
+                    with tracer.measure("rl", "update", round=round_idx):
+                        for _ in range(max(1, options.updates_per_round)):
+                            self.state, metrics = self._update(self.state, batch)
+                        metrics = jax.device_get(metrics)
+                else:
+                    logger.warning(
+                        "round %d harvested no usable rollouts — skipping "
+                        "the update (weights unchanged)", round_idx,
+                    )
+                sync = last_sync = sync_weights(
+                    self.engine, self.state.params["policy"],
+                    mode=options.sync_mode,
+                )
+                self._checkpoint(round_idx + 1)
+            completed_rounds = round_idx + 1
+            registry.gauge("rl/rounds").set(float(completed_rounds))
+            registry.gauge("rl/mean_reward").set(mean_reward)
+            if metrics:
+                registry.gauge("rl/loss").set(float(metrics["loss"]))
+                registry.gauge("rl/kl_to_ref").set(float(metrics["kl_to_ref"]))
+            for key, value in self.collector.stats().items():
+                registry.gauge(key).set(value)
+            record = {
+                "type": "rl_round",
+                "round": round_idx,
+                "collected": len(rollouts),
+                "mean_reward": mean_reward,
+                "generation": sync["generation"],
+                "sync_mode": sync["mode"],
+                "user_done": self._user_done,
+                **{
+                    k: float(v) for k, v in (metrics or {}).items()
+                    if k in ("loss", "kl_to_ref", "ratio_clip_frac", "mean_advantage")
+                },
+                **self.collector.stats(),
+            }
+            if emit is not None:
+                emit(record)
+            logger.info(
+                "rl round %d: %d rollouts, mean reward %.4f, generation %d",
+                round_idx, len(rollouts), mean_reward, sync["generation"],
+            )
+        if interrupted:
+            # drain journals every in-flight/queued request (rollouts AND
+            # user traffic); the checkpoint pins the weights those
+            # journaled rollouts were sampled under
+            self.engine.drain()
+            self._checkpoint(completed_rounds if completed_rounds else self.start_round)
+        gauges = {
+            "rl/rounds": float(completed_rounds),
+            "rl/mean_reward": mean_reward,
+            "rl/user_requests_done": float(self._user_done),
+            **self.collector.stats(),
+            **self.engine.stats(),
+        }
+        if last_sync is not None:
+            gauges["rl/weight_syncs"] = float(last_sync["generation"])
+            gauges["rl/sync_time_s"] = float(last_sync["sync_time_s"])
+        return {"gauges": gauges, "interrupted": interrupted}

@@ -1,0 +1,83 @@
+"""Trainer → engine weight sync (docs/post-training.md#weight-sync).
+
+After every GRPO update the serving engine must decode the NEXT round
+under the new policy. `reload_weights` (PR 17) already owns the hard
+half — evict-all fold-in requeue, generation bump, tree/shape/dtype
+validation — so sync reduces to producing a `variables` tree the engine
+accepts, in one of two modes:
+
+- **host** — the correctness oracle: `device_get` the policy params to
+  host numpy, then `device_put` each leaf back with the engine leaf's
+  sharding. Two full HBM↔host round-trips; unambiguous semantics.
+- **fused** (default) — the perf target: `device_put` each live train-
+  state leaf directly to the engine leaf's sharding, device-to-device.
+  Leaves already laid out right alias without a copy; sharded-differently
+  leaves reshard on-device. No host round-trip. The engine's OLD buffers
+  are donated in effect: rebinding `engine.variables` drops their last
+  reference and XLA reclaims the HBM.
+
+The two modes are stream-equivalent by construction — both hand
+`reload_weights` numerically identical trees — and test-pinned
+(tests/test_rl.py): a mid-flight request continued after a fused sync
+must produce tokens identical to a fresh engine restored from the synced
+weights and fed prompt + tokens-so-far.
+
+The policy tree handed in must be restore_for_inference-shaped (the
+engine validates); the GRPO loop passes `state.params["policy"]`, which
+the engine was built from, so structure always matches.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from llm_training_tpu.telemetry import get_registry
+from llm_training_tpu.telemetry.trace import get_tracer
+
+_MODES = ("fused", "host")
+
+
+def sync_weights(engine: Any, variables: Any, mode: str = "fused") -> dict:
+    """Push `variables` (the current policy tree) into `engine` and bump
+    its weights generation. Returns a summary dict (mode, generation,
+    sync_time_s, leaves)."""
+    # function-local: the rl package's reward path is jax-free by contract
+    # (analysis/contracts.py), and `llm_training_tpu.rl` re-exports this
+    # module — a top-level jax import here would break that closure
+    import jax
+    import numpy as np
+
+    if mode not in _MODES:
+        raise ValueError(f"sync mode must be one of {_MODES}, got {mode!r}")
+    t0 = time.perf_counter()
+    with get_tracer().measure("rl", "weight_sync", mode=mode):
+        if mode == "host":
+            placed = jax.tree.map(
+                lambda new, old: jax.device_put(
+                    np.asarray(jax.device_get(new)),
+                    getattr(old, "sharding", None),
+                ),
+                variables,
+                engine.variables,
+            )
+        else:
+            placed = jax.tree.map(
+                lambda new, old: jax.device_put(
+                    new, getattr(old, "sharding", None)
+                ),
+                variables,
+                engine.variables,
+            )
+        jax.block_until_ready(placed)
+        generation = engine.reload_weights(placed)
+    dt = time.perf_counter() - t0
+    registry = get_registry()
+    registry.gauge("rl/weight_syncs").set(float(generation))
+    registry.gauge("rl/sync_time_s").set(dt)
+    return {
+        "mode": mode,
+        "generation": int(generation),
+        "sync_time_s": dt,
+        "leaves": len(jax.tree.leaves(placed)),
+    }

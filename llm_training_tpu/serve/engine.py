@@ -50,7 +50,10 @@ import jax.numpy as jnp
 import numpy as np
 from pydantic import BaseModel, ConfigDict, model_validator
 
-from llm_training_tpu.infer.sampling import SamplingConfig, sample_tokens
+from llm_training_tpu.infer.sampling import (
+    SamplingConfig,
+    sample_tokens_with_logprob,
+)
 from llm_training_tpu.models.base import PagedDecodeState
 from llm_training_tpu.resilience.chaos import get_chaos
 from llm_training_tpu.serve.paged_cache import (
@@ -72,6 +75,10 @@ logger = logging.getLogger(__name__)
 # percentiles: bounds the per-scrape cost on a long-lived server whose
 # completed list grows without bound
 _LIVE_WINDOW = 512
+
+# terminals that are the engine SHEDDING load to protect its SLO, not
+# request failures: counted as serve/requests_shed, never requests_failed
+_SHED_REASONS = ("deadline", "overloaded")
 
 
 class ServeConfig(BaseModel):
@@ -205,8 +212,12 @@ class ServingEngine:
         # protocol-truth terminal counters (bumped in _done_event, the one
         # place every terminal passes): live_stats reads them so a scrape
         # never pays O(full completion history) — and they match the
-        # client-side census by construction
+        # client-side census by construction. Shed load (deadline/
+        # overloaded — the engine protecting its SLO) is tallied apart
+        # from real failures: conflating them poisons both RL rollout
+        # accounting and the SLO error-rate stream
         self._done_full = 0
+        self._done_shed = 0
         self._done_failed = 0
         # one-shot decode-step attribution (LLMT_PROFILE_ATTR_DECODE=1,
         # docs/observability.md#device-plane): the first real decode batch
@@ -241,9 +252,11 @@ class ServingEngine:
             logits = jax.lax.dynamic_index_in_dim(
                 out.logits[0], last_pos, axis=0, keepdims=False
             ).astype(jnp.float32)
-            token = sample_tokens(logits[None], rng, sampling)[0]
+            token, logprob = sample_tokens_with_logprob(
+                logits[None], rng, sampling
+            )
             state = out.decode_state
-            return state.k, state.v, token
+            return state.k, state.v, token[0], logprob[0]
 
         def decode_step(variables, tokens, pool_k, pool_v, tables, lengths, rng):
             state = PagedDecodeState(
@@ -255,8 +268,9 @@ class ServingEngine:
                 position_ids=lengths[:, None], decode_state=state,
             )
             logits = out.logits[:, -1].astype(jnp.float32)
+            token, logprob = sample_tokens_with_logprob(logits, rng, sampling)
             state = out.decode_state
-            return state.k, state.v, sample_tokens(logits, rng, sampling)
+            return state.k, state.v, token, logprob
 
         self._prefill_jit = jax.jit(prefill_chunk, donate_argnums=(4, 5))
         self._decode_jit = jax.jit(decode_step, donate_argnums=(2, 3))
@@ -324,6 +338,15 @@ class ServingEngine:
             priority=int(entry.get("priority", 0)),
         )
         request.generated = [int(t) for t in entry.get("generated", [])]
+        # restore the per-token logprobs alongside the tokens; a journal
+        # written before logprob collection pads with None (the rollout
+        # collector treats such samples as unusable, never as zeros)
+        logprobs = [
+            None if lp is None else float(lp)
+            for lp in (entry.get("logprobs") or [])
+        ][: len(request.generated)]
+        logprobs += [None] * (len(request.generated) - len(logprobs))
+        request.logprobs = logprobs
         request.emitted = min(int(entry.get("emitted", 0)), len(request.generated))
         if entry.get("deadline_ms") is not None:
             request.deadline_s = (
@@ -522,9 +545,19 @@ class ServingEngine:
                 events.extend(self._run_decode(rows))
         return events
 
-    def _emit_token(self, request: ServeRequest, token: int, events: list[dict]) -> None:
+    def _emit_token(
+        self,
+        request: ServeRequest,
+        token: int,
+        events: list[dict],
+        logprob: float | None = None,
+    ) -> None:
         now = time.perf_counter()
         request.generated.append(token)
+        # parallel to `generated`: the chosen token's logprob under the
+        # sampled distribution (rollout collection trains on these). None
+        # only for tokens restored from a pre-logprob journal.
+        request.logprobs.append(logprob)
         self.tokens_generated += 1
         if request.first_token_s is None:
             request.first_token_s = now
@@ -544,6 +577,7 @@ class ServingEngine:
             events.append({
                 "type": "token", "id": request.id,
                 "token": request.generated[request.emitted],
+                "logprob": request.logprobs[request.emitted],
                 # the weights generation this token was decoded under — a
                 # mid-stream reload_weights is visible exactly where it
                 # landed (docs/serving.md#reload)
@@ -571,7 +605,7 @@ class ServingEngine:
         ).astype(np.int32)[None, :]
         tables = self._table_row(request)[None, :]
         final = start + len(chunk) >= len(request.prefill_tokens)
-        self._pool_k, self._pool_v, token = self._prefill_jit(
+        self._pool_k, self._pool_v, token, logprob = self._prefill_jit(
             self.variables, jnp.asarray(ids), jnp.asarray(seg),
             jnp.asarray(pos), self._pool_k, self._pool_v,
             jnp.asarray(tables), jnp.asarray([start], jnp.int32),
@@ -580,7 +614,10 @@ class ServingEngine:
         request.prefilled += len(chunk)
         request.cache_len += len(chunk)
         if final:
-            self._emit_token(request, int(jax.device_get(token)), events)
+            host_token, host_logprob = jax.device_get((token, logprob))
+            self._emit_token(
+                request, int(host_token), events, logprob=float(host_logprob)
+            )
         now = time.perf_counter()
         get_tracer().span(
             "serve", "prefill_chunk", t_chunk, now, write=request.traced,
@@ -624,11 +661,16 @@ class ServingEngine:
             # while the jit consumes the pool buffers
             self._decode_attr_done = True
             self._publish_decode_attribution(step_args)
-        self._pool_k, self._pool_v, out = self._decode_jit(*step_args)
-        host = np.asarray(jax.device_get(out))
+        self._pool_k, self._pool_v, out, out_lp = self._decode_jit(*step_args)
+        host, host_lp = jax.device_get((out, out_lp))
+        host = np.asarray(host)
+        host_lp = np.asarray(host_lp)
         for request in survivors:
             request.cache_len += 1
-            self._emit_token(request, int(host[request.slot]), events)
+            self._emit_token(
+                request, int(host[request.slot]), events,
+                logprob=float(host_lp[request.slot]),
+            )
         return events
 
     def _publish_decode_attribution(self, step_args) -> None:
@@ -663,6 +705,8 @@ class ServingEngine:
     def _done_event(self, request: ServeRequest) -> dict:
         if request.stop_reason in ("eos", "max_tokens"):
             self._done_full += 1
+        elif request.stop_reason in _SHED_REASONS:
+            self._done_shed += 1
         else:
             self._done_failed += 1
         if self.journal is not None:
@@ -671,6 +715,7 @@ class ServingEngine:
             "type": "done", "id": request.id,
             "stop_reason": request.stop_reason,
             "tokens": list(request.generated),
+            "logprobs": list(request.logprobs),
             "n_tokens": len(request.generated),
             "evictions": request.evictions,
             "generation": self.weights_generation,
@@ -766,6 +811,7 @@ class ServingEngine:
             "serve/engine_steps": float(self._step_index),
             "serve/requests_completed": float(self._done_full),
             "serve/requests_failed": float(self._done_failed),
+            "serve/requests_shed": float(self._done_shed),
             "serve/tokens_generated": float(self.tokens_generated),
             "serve/weights_generation": float(self.weights_generation),
             "decode/cache_blocks_in_use": float(self.allocator.blocks_in_use),
@@ -787,9 +833,17 @@ class ServingEngine:
         wall = (time.perf_counter() - self._t0) if self._t0 is not None else 0.0
         n_chips = max(1, jax.device_count())
         tps = self.tokens_generated / wall if wall > 0 else 0.0
+        # shed load (deadline/overloaded) is the engine protecting its SLO;
+        # requests_failed is what remains — real errors (rejection etc.)
+        shed = sum(
+            1 for r in completed_all if r.stop_reason in _SHED_REASONS
+        )
         stats = {
             "serve/requests_completed": float(len(completed)),
-            "serve/requests_failed": float(len(completed_all) - len(completed)),
+            "serve/requests_failed": float(
+                len(completed_all) - len(completed) - shed
+            ),
+            "serve/requests_shed": float(shed),
             "serve/requests_evicted": float(self.scheduler.evictions),
             "serve/shed_total": float(self.scheduler.shed_total),
             "serve/deadline_total": float(self.scheduler.deadline_total),

@@ -112,13 +112,24 @@ class RequestJournal:
                 return
             start = 0 if prev is None or prev[0] < 0 else prev[0]
             self._written[request.id] = state
-            self._append({
+            # logprobs ride the same delta window as `generated` (the
+            # engine appends both together, so they share length); a
+            # request without the attribute (router-side bookkeeping)
+            # journals tokens only
+            logprobs = getattr(request, "logprobs", None)
+            record = {
                 "event": "progress",
                 "id": request.id,
                 "generated_from": start,
                 "generated": list(request.generated[start:]),
                 "emitted": request.emitted,
-            })
+            }
+            if logprobs is not None:
+                record["logprobs"] = [
+                    None if lp is None else round(float(lp), 6)
+                    for lp in logprobs[start:]
+                ]
+            self._append(record)
 
     def finished(self, request) -> None:
         with self._lock:
@@ -187,6 +198,7 @@ def replay_journal(path: str | Path) -> list[dict]:
                     "id": rid,
                     "prompt": [int(t) for t in record["prompt"]],
                     "generated": [],
+                    "logprobs": [],
                     "emitted": 0,
                     "max_new_tokens": int(record["max_new_tokens"]),
                     "priority": int(record.get("priority", 0)),
@@ -209,6 +221,20 @@ def replay_journal(path: str | Path) -> list[dict]:
                     # prefix — replay may re-stream, it must never invent
                     continue
                 entries[rid]["generated"] = current[:start] + tokens
+                # fold the parallel logprob delta; a record without one
+                # (pre-logprob journal) pads with None so the entry's
+                # logprobs stay aligned with generated
+                raw_lps = record.get("logprobs")
+                if raw_lps is None:
+                    lps = [None] * len(tokens)
+                else:
+                    lps = [
+                        None if lp is None else float(lp) for lp in raw_lps
+                    ][: len(tokens)]
+                    lps += [None] * (len(tokens) - len(lps))
+                entries[rid]["logprobs"] = (
+                    entries[rid]["logprobs"][:start] + lps
+                )
                 entries[rid]["emitted"] = int(record["emitted"])
             except (KeyError, TypeError, ValueError):
                 continue

@@ -74,6 +74,12 @@ class ServeRequest:
 
     # runtime (scheduler-owned)
     generated: list[int] = field(default_factory=list)
+    # chosen-token logprob per generated token (parallel to `generated`;
+    # the engine appends both together). None marks a token whose logprob
+    # is unknown — e.g. restored from a pre-logprob journal. RL rollout
+    # collection (rl/rollout.py) trains on these; eviction preserves them
+    # with `generated` so a fold-in requeue loses nothing.
+    logprobs: list[float | None] = field(default_factory=list)
     emitted: int = 0  # tokens already streamed (an evict/resume never re-emits)
     slot: int | None = None
     blocks: list[int] = field(default_factory=list)

@@ -74,6 +74,7 @@ _SERVE_SUM_KEYS = (
     "llmt_serve_running",
     "llmt_serve_requests_completed",
     "llmt_serve_requests_failed",
+    "llmt_serve_requests_shed",
     "llmt_serve_tokens_generated",
 )
 

@@ -201,6 +201,11 @@ def _serving_section(telemetry: dict) -> list[str]:
     failed = num("serve/requests_failed")
     if failed:
         line += f", {int(failed)} failed"
+    # shed load (deadline/overloaded) is reported apart from failures —
+    # the engine protecting its SLO is not an error condition
+    shed_requests = num("serve/requests_shed")
+    if shed_requests:
+        line += f", {int(shed_requests)} shed"
     evicted = num("serve/requests_evicted")
     if evicted:
         line += f", {int(evicted)} evictions"
@@ -251,6 +256,52 @@ def _serving_section(telemetry: dict) -> list[str]:
         if leaked:
             line += f" — {int(leaked)} still held at exit (leak?)"
         lines.append(line)
+    return lines
+
+
+def _rl_section(telemetry: dict) -> list[str]:
+    """RL post-training telemetry (`rl/*` from the `rl-fit` CLI —
+    docs/post-training.md): rounds, reward, rollout accounting, and the
+    weight-sync / SLO-yield counters. Rendered only when an rl-fit
+    invocation merged its gauges into telemetry.jsonl."""
+    def num(key):
+        try:
+            return float(telemetry[key])
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    rounds = num("rl/rounds")
+    collected = num("rl/rollouts_collected")
+    if rounds is None and collected is None:
+        return []
+    lines = ["", "== RL =="]
+    line = f"rounds: {int(rounds or 0)}"
+    reward = num("rl/mean_reward")
+    if reward is not None:
+        line += f", final mean reward {reward:.4f}"
+    lines.append(line)
+    parts = [f"{int(collected or 0)} collected"]
+    stale = num("rl/rollouts_stale_dropped")
+    failed = num("rl/rollouts_failed")
+    if stale:
+        # stale = tokens from an older weights generation: dropped by
+        # contract, never trained on (docs/post-training.md#generations)
+        parts.append(f"{int(stale)} stale-dropped")
+    if failed:
+        parts.append(f"{int(failed)} shed/failed")
+    submitted = num("rl/rollouts_submitted")
+    if submitted is not None:
+        parts.append(f"of {int(submitted)} submitted")
+    lines.append("rollouts: " + ", ".join(parts))
+    yields = num("rl/rollout_yields")
+    user_done = num("rl/user_requests_done")
+    parts = []
+    if yields:
+        parts.append(f"{int(yields)} SLO yield(s)")
+    if user_done:
+        parts.append(f"{int(user_done)} user requests served alongside")
+    if parts:
+        lines.append("arbitration: " + ", ".join(parts))
     return lines
 
 
@@ -1212,6 +1263,7 @@ def render_report(
     ))
     lines.extend(_decode_section(telemetry))
     lines.extend(_serving_section(telemetry))
+    lines.extend(_rl_section(telemetry))
     lines.extend(_router_section(telemetry))
     lines.extend(_slo_section(telemetry))
     lines.extend(_profiling_section(_profiling_summary(run_dir, telemetry)))
@@ -1355,6 +1407,9 @@ def render_report_data(
         "audit": audit_data,
         "inference": _numeric_subset(telemetry, ("decode/", "eval/")),
         "serving": _numeric_subset(telemetry, ("serve/",)),
+        # null when the run never post-trained (no `rl-fit` invocation) —
+        # additive: schema_version stays 1
+        "rl": _numeric_subset(telemetry, ("rl/",)),
         # null when the run never routed (no `route` invocation)
         "router": _numeric_subset(telemetry, ("router/",)),
         # null when the run armed no SLO config — the structured twin of

@@ -377,6 +377,15 @@ class Trainer:
 
         def leaf_sharding(path, leaf):
             if isinstance(leaf, nn.Partitioned):
+                if not jax.tree_util.tree_leaves(leaf.value):
+                    # a box around an EMPTY pytree — optax.masked wraps
+                    # frozen params' opt-state slots in MaskedNode(), and
+                    # zeros_like maps it THROUGH the Partitioned box. There
+                    # is no array to shard; emitting a sharding here would
+                    # give the shardings tree a leaf the unboxed state tree
+                    # doesn't have, breaking every frozen-modules restore
+                    # (DPO/GRPO reference params)
+                    return leaf.value
                 spec, leaf_drops = resolve_spec(
                     leaf.names, LOGICAL_AXIS_RULES, strict=True,
                     path=jax.tree_util.keystr(path),

@@ -296,6 +296,16 @@ echo "== precommit: router smoke (failover exactly-once + hedged blackhole) =="
 python scripts/router_smoke.py "${SMOKE_ROOT}/router-smoke" \
     "${SMOKE_ROOT}/smoke/cpu-smoke"
 
+# rl-smoke gate (docs/post-training.md): the on-policy GRPO loop riding
+# the serving engine — a tiny policy must STRICTLY improve mean reward
+# over 10 rounds (rollouts through the real engine scheduler, behavior
+# logprobs, fused weight sync every round); a chaos SIGTERM mid-rollout
+# must journal in-flight rollouts and exit 75, and the relaunch must
+# replay+adopt them (host-oracle sync mode) and finish; the run dir must
+# render report's == RL == section text and JSON
+echo "== precommit: rl smoke (GRPO reward improvement + SIGTERM resume) =="
+python scripts/rl_smoke.py "${SMOKE_ROOT}/rl-smoke"
+
 # perf-regression ledger gate (docs/performance.md#perf-ledger): the
 # committed BENCH_r*.json history must parse and gate clean — a newly
 # committed round that regressed same-backend MFU / decode rate / TTFT

@@ -41,7 +41,7 @@ def test_example_parses_and_validates_structurally(path):
         getattr(module, cls.__name__ + "Config")(**node.get("init_args", {}))
 
     objective_cls = import_class(config["model"]["class_path"])
-    assert objective_cls.__name__ in ("CLM", "DPO", "ORPO")
+    assert objective_cls.__name__ in ("CLM", "DPO", "ORPO", "GRPO")
     data_cls = import_class(config["data"]["class_path"])
     assert data_cls is not None
 
