@@ -84,6 +84,17 @@ JAX_FREE_CONTRACTS: dict[str, str] = {
         "must keep sweeping while replicas own backends, and the fleet "
         "CLI must run on operator machines that have none"
     ),
+    "llm_training_tpu/resilience/durability.py": (
+        "the ckpt CLI verifies/mirrors checkpoint trees on operator "
+        "machines with no backend, and the mirror daemon thread must "
+        "never touch jax or it can block behind the wedged dispatch a "
+        "restore is about to recover from"
+    ),
+    "scripts/durability_smoke.py": (
+        "the durability smoke drives fit / ckpt / report as "
+        "subprocesses, exactly like the crash-resume smoke — the "
+        "children own the backend"
+    ),
     "llm_training_tpu/telemetry/perf_ledger.py": (
         "the bench PARENT (itself jax-free) imports the regression ledger; "
         "the --check-regression gate must run on any machine the repo is "
@@ -225,6 +236,12 @@ THREAD_SHARED_CONTRACTS: dict[str, dict[str, str]] = {
         "get_chaos": "same global as chaos_point (the serve engine reads "
         "it from the step loop)",
     },
+    "llm_training_tpu/resilience/durability.py": {
+        "MirrorDaemon": "the mirror/scrub thread mutates the mirrored/"
+        "failed bookkeeping sets while the owning Checkpointer calls "
+        "notify()/drain()/stats() from the train loop's save and wait "
+        "barriers",
+    },
     "llm_training_tpu/resilience/watchdog.py": {
         "HangWatchdog": "beat() is called from the prefetcher worker "
         "(heartbeat hook) as well as the train loop, racing the poll "
@@ -264,6 +281,10 @@ LOCK_ORDER = (
     "rl",        # rl/rollout.py RolloutCollector._lock (counter dict
                  # only; harvest/trace side effects emit after release,
                  # so no edge into trace/registry beyond the leaf order)
+    "durability", # resilience/durability.py MirrorDaemon._lock (the
+                 # mirrored/failed bookkeeping sets only; all filesystem
+                 # work and every registry publication happen OUTSIDE it,
+                 # so its only potential edge is into the registry leaf)
     "journal",   # serve/journal.py RequestJournal._lock
     "trace",     # telemetry/trace.py TraceRecorder._lock + _current_lock
     "registry",  # telemetry/registry.py TelemetryRegistry._lock (leaf)

@@ -24,7 +24,7 @@ TELEMETRY_PREFIXES = (
     "goodput/", "hbm/", "xla/", "data/", "checkpoint/", "perf/",
     "health/", "nan_guard/", "resilience/", "decode/", "eval/", "serve/",
     "elastic/", "flash/", "trace/", "slo/", "exporter/", "attr/",
-    "profile/", "hbm_timeline/", "router/", "rl/",
+    "profile/", "hbm_timeline/", "router/", "rl/", "ckpt/",
 )
 TELEMETRY_KEYS = ("compile_time_s",)
 

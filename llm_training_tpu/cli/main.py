@@ -1293,6 +1293,44 @@ def main(argv: list[str] | None = None) -> int:
         "including './supervisor.jsonl' — is used as given",
     )
     supervise.add_argument("overrides", nargs="*")
+    ckpt = sub.add_parser(
+        "ckpt",
+        help="checkpoint durability operations over a checkpoint root "
+        "(and its mirror): verify manifests, list steps, retention GC, "
+        "force a mirror pass (docs/resilience.md#durability)",
+    )
+    ckpt_sub = ckpt.add_subparsers(dest="ckpt_command", required=True)
+    for name, help_text in (
+        ("verify", "check every committed step against its integrity "
+         "manifest; exit 1 with each offending file named on findings"),
+        ("ls", "list committed steps and their manifest status"),
+        ("gc", "apply the retention policy (keep-last-N + keep-every-K; "
+         "never the newest step, never the last intact copy)"),
+        ("mirror", "mirror every manifested step now (tmp-then-rename + "
+         "manifest re-verification on the copy)"),
+    ):
+        p = ckpt_sub.add_parser(name, help=help_text)
+        p.add_argument("dir", help="checkpoint root (the orbax step parent)")
+        p.add_argument(
+            "--mirror-dir", default=None,
+            help="mirror root (default: LLMT_CKPT_MIRROR_DIR)",
+        )
+        if name == "verify":
+            p.add_argument(
+                "--mode", default="fast", choices=("fast", "full"),
+                help="fast = file set + sizes; full = re-hash every file",
+            )
+            p.add_argument(
+                "--step", type=int, default=None,
+                help="verify only this step (default: every committed step)",
+            )
+        if name == "gc":
+            p.add_argument("--keep-last", type=int, default=3)
+            p.add_argument(
+                "--keep-every", type=int, default=None,
+                help="also keep every step divisible by K",
+            )
+            p.add_argument("--dry-run", action="store_true")
     route = sub.add_parser(
         "route",
         help="health-aware router over N serve replicas: same JSONL "
@@ -1396,6 +1434,13 @@ def main(argv: list[str] | None = None) -> int:
         from llm_training_tpu.telemetry.exporter import profile_main
 
         return profile_main(port=args.port, host=args.host, tag=args.tag)
+    if args.command == "ckpt":
+        # jax-free like report/fleet: verifying or mirroring a checkpoint
+        # tree must work on operator machines with no backend (and must
+        # never hold the devices of the run it is inspecting)
+        from llm_training_tpu.resilience.durability import ckpt_main
+
+        return ckpt_main(args)
     if args.command == "supervise":
         # the supervisor must never initialize jax — it would hold the TPU
         # its child needs; hand off before any backend-touching import

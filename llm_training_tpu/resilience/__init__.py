@@ -28,8 +28,13 @@ Seven layers (docs/resilience.md):
   topology to `supervisor.jsonl`, and the goodput ledger's chip-count/
   price tags aggregate into `report`'s goodput-per-dollar.
 - **Fault injection** (`chaos.py`): config/env-driven failures at every
-  recovery site — including NaN/spike divergence and SIGKILL — so tests
-  and `scripts/crash_resume_smoke.py` prove the paths above end to end.
+  recovery site — including NaN/spike divergence, SIGKILL, and byte-level
+  checkpoint corruption — so tests and `scripts/crash_resume_smoke.py` /
+  `scripts/durability_smoke.py` prove the paths above end to end.
+- **Checkpoint durability** (`durability.py`): sha256 integrity manifests
+  beside every committed step, verify-before-restore, an async mirror
+  daemon with retention GC and a scrubber, and the jax-free `ckpt` CLI
+  (docs/resilience.md#durability).
 """
 
 from pydantic import BaseModel, ConfigDict, Field
@@ -43,6 +48,17 @@ from llm_training_tpu.resilience.chaos import (
     get_chaos,
     install_chaos,
     uninstall_chaos,
+)
+from llm_training_tpu.resilience.durability import (
+    MirrorDaemon,
+    VerifyResult,
+    build_manifest,
+    committed_steps,
+    corrupt_step,
+    mirror_step,
+    retention_victims,
+    verify_step,
+    write_manifest,
 )
 from llm_training_tpu.resilience.elastic import (
     ElasticConfig,
@@ -139,6 +155,7 @@ __all__ = [
     "ElasticTopologyError",
     "GracefulShutdown",
     "HangWatchdog",
+    "MirrorDaemon",
     "PreemptionInterrupt",
     "RecoveryConfig",
     "RecoveryExhaustedError",
@@ -148,20 +165,28 @@ __all__ = [
     "Supervisor",
     "SupervisorConfig",
     "TopologyPlan",
+    "VerifyResult",
     "build_fit_argv",
+    "build_manifest",
     "chaos_device_limit",
     "chaos_point",
     "check_data_continuity",
+    "committed_steps",
     "config_from_env",
     "cooldown_schedule",
+    "corrupt_step",
     "get_chaos",
     "install_chaos",
     "is_transient",
     "log_segment_topology",
+    "mirror_step",
     "plan_topology",
     "resolve_chip_price",
+    "retention_victims",
     "retry_call",
     "segment_attempt",
     "uninstall_chaos",
+    "verify_step",
     "visible_device_count",
+    "write_manifest",
 ]

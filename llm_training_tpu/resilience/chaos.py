@@ -100,6 +100,18 @@ class ChaosConfig(BaseModel):
     # accept the Nth request->replica assignment but never submit it to the
     # replica (accept-but-never-stream) — only hedging can finish it
     router_blackhole_at: int | None = None
+    # byte-level checkpoint corruption (docs/resilience.md#durability):
+    # `{flip,truncate,delete}[:step]` — damage one payload file of a
+    # committed checkpoint post-commit. With `:step`, fires right after
+    # that step's manifest lands (BEFORE the mirror copies it — the
+    # mirror-side re-verification must reject the copy); without a step,
+    # fires on the newest committed step at the final wait() barrier
+    # (AFTER the mirror drained — the restore must land on the mirror leg)
+    ckpt_corrupt: str | None = None
+    # SIGKILL this process inside the force-save delete→commit swap window
+    # at this step — the staged `.stale/` copy must be promotable on
+    # relaunch (the old no-durable-copy window, docs/resilience.md)
+    ckpt_kill_in_swap: int | None = None
     # SLO-breach injection (docs/observability.md#slo): sleep this long at
     # EVERY optimizer-step boundary from `slow_step_from` on — a sustained
     # slow regime, exactly what the multi-window burn-rate alert needs to
@@ -122,6 +134,8 @@ class ChaosConfig(BaseModel):
             or self.serve_malformed_flood > 0
             or self.router_kill_replica_at is not None
             or self.router_blackhole_at is not None
+            or self.ckpt_corrupt is not None
+            or self.ckpt_kill_in_swap is not None
             or self.slow_step_s > 0
         )
 
@@ -135,7 +149,9 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
     / LLMT_CHAOS_SPIKE_STEP / LLMT_CHAOS_SERVE_STALL_STEP /
     LLMT_CHAOS_SERVE_SIGTERM_STEP / LLMT_CHAOS_SERVE_MALFORMED_FLOOD /
     LLMT_CHAOS_ROUTER_KILL_REPLICA / LLMT_CHAOS_ROUTER_BLACKHOLE /
+    LLMT_CHAOS_CKPT_KILL_IN_SWAP /
     LLMT_CHAOS_SLOW_STEP_FROM / LLMT_CHAOS_SEED (ints) /
+    LLMT_CHAOS_CKPT_CORRUPT ({flip,truncate,delete}[:step]) /
     LLMT_CHAOS_SLOW_STEP_S (float, seconds of injected dead time per
     optimizer step — the SLO-breach hook)."""
     update: dict = {}
@@ -157,6 +173,8 @@ def config_from_env(base: ChaosConfig | None = None) -> ChaosConfig:
         ("serve_malformed_flood", "LLMT_CHAOS_SERVE_MALFORMED_FLOOD", int),
         ("router_kill_replica_at", "LLMT_CHAOS_ROUTER_KILL_REPLICA", int),
         ("router_blackhole_at", "LLMT_CHAOS_ROUTER_BLACKHOLE", int),
+        ("ckpt_corrupt", "LLMT_CHAOS_CKPT_CORRUPT", str),
+        ("ckpt_kill_in_swap", "LLMT_CHAOS_CKPT_KILL_IN_SWAP", int),
         ("slow_step_s", "LLMT_CHAOS_SLOW_STEP_S", float),
         ("slow_step_from", "LLMT_CHAOS_SLOW_STEP_FROM", int),
         ("seed", "LLMT_CHAOS_SEED", int),
@@ -238,6 +256,64 @@ class Chaos:
             return
         self._count()
         logger.warning("chaos: delivering SIGKILL to self at step %d", step)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # --------------------------------------------------- durability tier
+
+    def _ckpt_corrupt_parsed(self) -> tuple[str, int | None] | None:
+        """(mode, target_step|None) from `ckpt_corrupt`, or None."""
+        raw = self.config.ckpt_corrupt
+        if not raw:
+            return None
+        mode, _, rest = raw.partition(":")
+        return mode, (int(rest) if rest else None)
+
+    def maybe_corrupt_checkpoint(
+        self, root, step: int, at_final_barrier: bool = False
+    ) -> str | None:
+        """Damage one payload file of the just-committed checkpoint `step`
+        (once). The targeted form (`mode:step`) fires when that step's
+        manifest lands; the untargeted form fires on the newest committed
+        step at the final wait() barrier (`at_final_barrier`) — after the
+        mirror drained, so a verified clean copy exists to fall back to.
+        Returns the damaged file's relative path (logged by name: the
+        detection path must be able to quote it back)."""
+        parsed = self._ckpt_corrupt_parsed()
+        if parsed is None:
+            return None
+        mode, target = parsed
+        if target is not None:
+            if step != target:
+                return None
+        elif not at_final_barrier:
+            return None
+        with self._lock:
+            if ("ckpt_corrupt",) in self._fired:
+                return None
+            self._fired.add(("ckpt_corrupt",))
+        from llm_training_tpu.resilience.durability import corrupt_step
+
+        victim = corrupt_step(root, step, mode)
+        self._count()
+        logger.warning(
+            "chaos: %s-corrupted checkpoint step %d payload file %s in %s",
+            mode, step, victim, root,
+        )
+        return victim
+
+    def maybe_ckpt_kill_in_swap(self, step: int) -> None:
+        """SIGKILL this process inside the force-save swap window (old
+        step deleted, replacement not yet committed) at the trigger step —
+        the staged `.stale/` copy is then the step's ONLY durable copy and
+        a relaunch must promote it. Meant for single-shot child processes
+        (the durability smoke's kill leg); a relaunch that re-crosses the
+        trigger with the env still set will die again."""
+        if self.config.ckpt_kill_in_swap is None or step != self.config.ckpt_kill_in_swap:
+            return
+        self._count()
+        logger.warning(
+            "chaos: delivering SIGKILL inside force-save swap at step %d", step
+        )
         os.kill(os.getpid(), signal.SIGKILL)
 
     # ------------------------------------------------------- serving tier

@@ -389,10 +389,58 @@ class MetricsExporter:
             detail["watchdog"] = "none"
         return True, detail
 
+    def _durability_status(self) -> tuple[str | None, str | None]:
+        """(health-line warning, detail line) from the registry's ckpt/*
+        gauges + verify counters — a red mirror or a failed scrub must be
+        visible on /statusz BEFORE a restore needs the copy. (None, None)
+        when the run has no durability surface armed."""
+        if self.registry is None:
+            return None, None
+        values, _ = self.registry.snapshot_with_kinds()
+        watched = (
+            "checkpoint/verify_failures", "ckpt/mirror_lag_steps",
+            "ckpt/mirrored_steps", "ckpt/mirror_verify_rejects",
+            "ckpt/scrub_failures", "ckpt/scrub_last_ok",
+        )
+        if not any(key in values for key in watched):
+            return None, None
+        verify_failures = int(values.get("checkpoint/verify_failures", 0))
+        rejects = int(values.get("ckpt/mirror_verify_rejects", 0))
+        lag = values.get("ckpt/mirror_lag_steps")
+        scrub_failures = int(values.get("ckpt/scrub_failures", 0))
+        scrub_last_ok = values.get("ckpt/scrub_last_ok")
+        problems: list[str] = []
+        if verify_failures:
+            problems.append(f"{verify_failures} verify failure(s)")
+        if rejects:
+            problems.append(f"{rejects} mirror reject(s)")
+        if lag:
+            problems.append(f"mirror {int(lag)} step(s) behind")
+        if scrub_failures or scrub_last_ok == 0.0:
+            problems.append(
+                f"scrub failing ({scrub_failures} failure(s), last step "
+                f"{int(values.get('ckpt/scrub_last_step', -1))})"
+            )
+        scrub = (
+            "n/a" if scrub_last_ok is None
+            else ("ok" if scrub_last_ok else "FAILED")
+        )
+        line = (
+            f"durability: verify failures {verify_failures}  mirror lag "
+            f"{int(lag) if lag is not None else 'n/a'} step(s) "
+            f"({int(values.get('ckpt/mirrored_steps', 0))} mirrored)  "
+            f"scrub last {scrub}"
+        )
+        return ("; ".join(problems) or None), line
+
     def render_statusz(self) -> str:
         lines = ["llm-training-tpu statusz", ""]
         healthy, detail = self.health()
-        lines.append(f"health: {'ok' if healthy else 'UNHEALTHY'}")
+        durability_warn, durability_line = self._durability_status()
+        health_line = f"health: {'ok' if healthy else 'UNHEALTHY'}"
+        if durability_warn:
+            health_line += f"  [durability: {durability_warn}]"
+        lines.append(health_line)
         if detail.get("reason"):
             lines.append(f"  {detail['reason']}")
         if self.ledger is not None:
@@ -407,6 +455,8 @@ class MetricsExporter:
                 f"watchdog: beat {detail['beat_age_s']:.1f}s ago "
                 f"(timeout {detail['watchdog_timeout_s']:.1f}s)"
             )
+        if durability_line is not None:
+            lines.append(durability_line)
         if self.status_fn is not None:
             try:
                 for key, value in self.status_fn().items():

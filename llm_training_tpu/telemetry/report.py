@@ -1101,6 +1101,33 @@ def _resilience_section(telemetry: dict) -> list[str]:
     ], telemetry)
 
 
+def _durability_section(telemetry: dict) -> list[str]:
+    """Checkpoint durability plane (docs/resilience.md#durability):
+    verify/heal/scrub event counters plus the mirror's end-of-run state.
+    Omitted entirely for runs with no mirror and no findings — like the
+    other event sections, a clean unmirrored run's report is unchanged."""
+    lines = _counter_section("Durability", [
+        ("checkpoint/verify_failures",
+         "checkpoint verify failures (offending file named in the log)"),
+        ("checkpoint/mirror_restores", "restores healed from the mirror"),
+        ("ckpt/mirror_verify_rejects",
+         "mirror copies rejected by re-verification"),
+        ("ckpt/gc_deleted", "mirror steps deleted by retention GC"),
+        ("ckpt/scrub_ok", "scrub verifications passed"),
+        ("ckpt/scrub_failures", "scrub verifications FAILED"),
+    ], telemetry)
+    if "ckpt/mirrored_steps" in telemetry:
+        try:
+            mirrored = int(float(telemetry["ckpt/mirrored_steps"]))
+            lag = int(float(telemetry.get("ckpt/mirror_lag_steps", 0)))
+        except (TypeError, ValueError):
+            return lines
+        if not lines:
+            lines = ["", "== Durability =="]
+        lines.append(f"mirrored steps: {mirrored} (lag {lag} step(s))")
+    return lines
+
+
 def _load_run(run_dir: Path) -> tuple[list[dict], list[dict], dict]:
     """(metrics, telemetry_records, telemetry-total) for the NEWEST run
     segment — the one loader both the text and JSON renderers consume, so
@@ -1278,6 +1305,7 @@ def render_report(
     ))
     lines.extend(_recovery_section(telemetry))
     lines.extend(_resilience_section(telemetry))
+    lines.extend(_durability_section(telemetry))
     return "\n".join(lines)
 
 
@@ -1423,6 +1451,12 @@ def render_report_data(
         # null when no `fleet --out` sweep was persisted into the run dir
         "fleet": _fleet_summary(run_dir),
         "recovery": _numeric_subset(telemetry, ("resilience/",)),
+        # null when the run mirrored nothing and had no verify findings —
+        # full-key "prefixes" pick the two checkpoint/ durability counters
+        # without dragging in save/wait timers
+        "durability": _numeric_subset(telemetry, (
+            "ckpt/", "checkpoint/verify_failures", "checkpoint/mirror_restores",
+        )),
         "flash": _numeric_subset(telemetry, ("flash/",)),
         "telemetry": telemetry,
     }

@@ -12,20 +12,29 @@ Capability parity: reference checkpoint subsystem (SURVEY.md §3.3/§5.4):
   (cf. `resumable_dataloader.py:20-25`, which replays O(skipped) batches)
 - async save (orbax background thread) with `wait()` barrier
 
-Durability (docs/resilience.md): transient I/O errors during save are
-retried with exponential backoff (retries escalate to an overwrite in case
-the failed attempt left a partial step dir); async-save failures surface at
-the NEXT save point instead of silently waiting for the next `wait()`; and
-restore falls back to the previous retained step when the newest one is
-corrupt/partial — a run preempted mid-commit must not crash-loop on
-relaunch.
+Durability (docs/resilience.md#durability): transient I/O errors during
+save are retried with exponential backoff; async-save failures surface at
+the NEXT save point instead of silently waiting for the next `wait()`.
+Each committed step gets an integrity manifest (sha256 + size per payload
+file, written by `resilience.durability` tmp-then-rename) and restore runs
+verify-before-restore (`checkpoint.verify: off|fast|full`): a step whose
+bytes disagree with its manifest is healed from the mirror
+(`LLMT_CKPT_MIRROR_DIR` / `checkpoint.mirror_dir`, kept warm by a
+background `MirrorDaemon`) or skipped — restore falls back
+primary→mirror→older-step, each leg counted. Force-overwrites stage the
+old step under `.stale/` before orbax's delete-then-save, so a SIGKILL
+inside the swap leaves a promotable durable copy; and the post-fallback
+repair deletes a step only when its manifest verification FAILED —
+an environmental restore error (permissions, layout mismatch) on bytes
+that hash clean must not destroy a good checkpoint.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 from pathlib import Path
-from typing import Any
+from typing import Any, Literal
 
 import jax
 import orbax.checkpoint as ocp
@@ -48,6 +57,24 @@ class CheckpointConfig(BaseModel):
     save_retries: int = 3
     retry_backoff_s: float = 0.5
     retry_backoff_max_s: float = 30.0
+    # verify-before-restore mode (docs/resilience.md#durability): `fast`
+    # checks the file set + sizes against the step's manifest, `full`
+    # additionally re-hashes every payload file, `off` restores blind
+    # (legacy behavior). The post-fallback repair classification always
+    # consults manifests regardless of this knob.
+    verify: Literal["off", "fast", "full"] = "fast"
+    # async mirror target (LLMT_CKPT_MIRROR_DIR overrides); None disables
+    # mirroring, healing, and the scrubber
+    mirror_dir: str | None = None
+    mirror_interval_s: float = 2.0
+    # mirror-side retention: keep the newest `mirror_keep_last` steps plus
+    # every step divisible by `mirror_keep_every` — and never the newest
+    # committed step or a copy that is the last intact one
+    mirror_keep_last: int = 3
+    mirror_keep_every: int | None = None
+    # background scrubber cadence: re-verify (full) one retained step per
+    # interval, alternating primary/mirror; <= 0 disables
+    scrub_interval_s: float = 60.0
 
 
 def _pack(state: TrainState) -> Any:
@@ -71,6 +98,14 @@ class Checkpointer:
 
         self.run_metadata = collect_run_metadata()
         self.directory = Path(config.dirpath).absolute()
+        self._primary_host = jax.process_index() == 0
+        if self._primary_host:
+            # a predecessor SIGKILLed inside a force-save swap leaves the
+            # old step parked under `.stale/` with no committed
+            # replacement — put it back BEFORE orbax scans the directory
+            from llm_training_tpu.resilience import durability
+
+            durability.promote_stale_steps(self.directory)
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -82,6 +117,23 @@ class Checkpointer:
         # newest save launched but not yet confirmed committed (async mode);
         # wait() logs the commit once the barrier passes
         self._inflight_step: int | None = None
+        # committed steps still owed a manifest (flushed once orbax's
+        # background write finishes — a manifest must hash FINAL bytes)
+        self._pending_manifest: set[int] = set()
+        mirror_raw = os.environ.get("LLMT_CKPT_MIRROR_DIR") or config.mirror_dir
+        self.mirror_dir = Path(mirror_raw).absolute() if mirror_raw else None
+        self._mirror = None
+        if self.mirror_dir is not None and self._primary_host:
+            from llm_training_tpu.resilience.durability import MirrorDaemon
+
+            self._mirror = MirrorDaemon(
+                self.directory,
+                self.mirror_dir,
+                interval_s=config.mirror_interval_s,
+                keep_last=config.mirror_keep_last,
+                keep_every=config.mirror_keep_every,
+                scrub_interval_s=config.scrub_interval_s,
+            ).start()
 
     def check_errors(self) -> None:
         """Surface a failed async save NOW (orbax parks background-thread
@@ -101,6 +153,9 @@ class Checkpointer:
         # surface a parked async failure even when THIS call dedupes away —
         # "failures surface at the next save point" must include skipped ones
         self.check_errors()
+        # a previous async save may have committed since the last barrier:
+        # its manifest is writable now (and the mirror can pick it up)
+        self._flush_manifests()
         if step in self.manager.all_steps() and not force:
             return  # e.g. end-of-fit save colliding with an interval save
         meta = {
@@ -114,6 +169,8 @@ class Checkpointer:
             **(extra or {}),
         }
         from llm_training_tpu.resilience import RetryPolicy, chaos_point, retry_call
+        from llm_training_tpu.resilience import durability
+        from llm_training_tpu.resilience.chaos import get_chaos
         from llm_training_tpu.telemetry import get_registry
 
         registry = get_registry()
@@ -126,16 +183,24 @@ class Checkpointer:
         def _save(attempt: int) -> None:
             chaos_point("checkpoint_save", step=step)
             # force-overwrite path (emergency save over a stale/partial
-            # entry, or a retry after a mid-write failure): orbax refuses to
-            # save over a finalized step, so drop it first. There is a
-            # window between the delete and the replacement's commit where
-            # this step has no durable copy — a SIGKILL inside it loses the
-            # step; retention (max_to_keep) plus the restore fallback bound
-            # the damage to "resume from the previous retained step", which
-            # beats the alternative (StepAlreadyExistsError = no emergency
-            # save at all)
+            # entry, or a retry after a mid-write failure): orbax refuses
+            # to save over a finalized step and has no atomic replace, so
+            # the old step must be dropped first. Before dropping it, park
+            # a hardlink clone (+ manifest) under `.stale/<step>` — the
+            # durable copy that keeps a SIGKILL inside the delete→commit
+            # window from losing the step entirely; the staged copy is
+            # cleared only after the replacement's commit AND manifest
+            # land (`_flush_manifests`), and a relaunch promotes it back
+            # when the replacement never committed (`promote_stale_steps`)
             if step in self.manager.all_steps():
+                if self._primary_host:
+                    durability.stage_stale_step(self.directory, step)
                 self.manager.delete(step)
+                chaos = get_chaos()
+                if chaos is not None:
+                    # the SIGKILL-in-swap chaos leg: die exactly inside
+                    # the old no-durable-copy window
+                    chaos.maybe_ckpt_kill_in_swap(step)
             # force here only bypasses the save-interval policy; a failed
             # attempt's partial (unfinalized) dir is cleared by orbax itself
             self.manager.save(
@@ -155,6 +220,7 @@ class Checkpointer:
                 label=f"checkpoint save (step {step})",
                 counter=registry.counter("checkpoint/retries"),
             )
+        self._pending_manifest.add(step)
         if self.config.async_save:
             self._inflight_step = step
             logger.info(
@@ -162,9 +228,94 @@ class Checkpointer:
                 "after the wait() barrier)", step, self.directory,
             )
         else:
+            self._flush_manifests()
             logger.info(
                 "checkpoint committed at step %d -> %s", step, self.directory
             )
+
+    def _flush_manifests(self) -> None:
+        """Write the manifest for every pending committed step (process 0
+        only, and only while no async save is mid-write — a manifest must
+        hash the step's FINAL bytes). Clears the step's staged `.stale/`
+        copy (its replacement is now durable + manifested) and wakes the
+        mirror daemon."""
+        if not self._primary_host:
+            self._pending_manifest.clear()
+            return
+        if not self._pending_manifest:
+            return
+        if self.manager.is_saving_in_progress():
+            return
+        from llm_training_tpu.resilience import durability
+        from llm_training_tpu.resilience.chaos import get_chaos
+        from llm_training_tpu.telemetry import get_registry
+
+        registry = get_registry()
+        for step in sorted(self._pending_manifest):
+            sdir = durability.step_dir(self.directory, step)
+            if not sdir.is_dir():
+                self._pending_manifest.discard(step)  # GC'd before flush
+                continue
+            with registry.timer("checkpoint/manifest").time():
+                manifest = durability.build_manifest(sdir, step)
+                durability.write_manifest(self.directory, step, manifest)
+            durability.clear_stale_step(self.directory, step)
+            self._pending_manifest.discard(step)
+            chaos = get_chaos()
+            if chaos is not None:
+                # the targeted (`mode:step`) corruption form fires here —
+                # post-commit, post-manifest, BEFORE the mirror copies the
+                # step, so the mirror-side re-verification must reject it
+                chaos.maybe_corrupt_checkpoint(self.directory, step)
+        if self._mirror is not None:
+            self._mirror.notify()
+
+    def _record_verify_failure(self, result) -> None:
+        from llm_training_tpu.telemetry import get_registry
+
+        get_registry().counter("checkpoint/verify_failures").inc()
+        for finding in result.findings:
+            logger.warning(
+                "checkpoint verification failed in %s: %s",
+                self.directory, finding,
+            )
+
+    def _heal_from_mirror(self, step: int) -> bool:
+        """Replace a corrupt primary step with the mirror's copy — but only
+        after the mirror copy itself passes FULL verification (healing from
+        a rotten mirror would just move the corruption). Counted as the
+        restore's mirror leg (`checkpoint/mirror_restores`)."""
+        if self.mirror_dir is None:
+            return False
+        from llm_training_tpu.resilience import durability
+        from llm_training_tpu.telemetry import get_registry
+
+        mirror_check = durability.verify_step(self.mirror_dir, step, mode="full")
+        if not mirror_check.ok:
+            for finding in mirror_check.findings:
+                logger.warning(
+                    "mirror copy unusable for healing (%s): %s",
+                    self.mirror_dir, finding,
+                )
+            return False
+        try:
+            tmp = self.directory / f".tmp-heal-{step}"
+            durability.clone_tree(durability.step_dir(self.mirror_dir, step), tmp)
+            durability._replace_dir(tmp, durability.step_dir(self.directory, step))
+            manifest = durability.load_manifest(self.mirror_dir, step)
+            durability.write_manifest(self.directory, step, manifest)
+            self.manager.reload()  # orbax caches its directory view
+        except OSError as e:
+            logger.warning(
+                "healing step %d from mirror %s failed: %s",
+                step, self.mirror_dir, e,
+            )
+            return False
+        get_registry().counter("checkpoint/mirror_restores").inc()
+        logger.warning(
+            "healed checkpoint step %d from mirror %s", step, self.mirror_dir
+        )
+        return True
 
     def maybe_restore(
         self,
@@ -175,18 +326,24 @@ class Checkpointer:
     ) -> tuple[TrainState, dict] | None:
         """Restore the latest (or given) step straight into sharded buffers.
         Returns None when no checkpoint exists. When no explicit step is
-        requested and the newest retained step is corrupt/partial (a
-        preemption mid-commit), fall back to the next older retained step —
+        requested, each candidate is verified against its integrity
+        manifest first (`checkpoint.verify`, docs/resilience.md#durability)
+        and on failure healed from the mirror or skipped; a restore
+        exception likewise falls back to the next older retained step —
         losing a few steps of progress beats crash-looping the relaunch.
         An EXPLICIT step request never falls back (the caller asked for
         that state, not "something close to it"); and if every retained
         step fails, the first error is re-raised so a systematic problem
         (e.g. an optimizer-layout mismatch) keeps its diagnosis.
 
-        `repair=True` (the fit path) deletes the unrestorable newer steps
-        after a successful fallback so the resumed run re-saves them;
-        read-only callers (the `validate` CLI) pass False — an observation
-        must not mutate the checkpoint directory."""
+        `repair=True` (the fit path) deletes fallen-back steps ONLY when
+        their manifest verification failed — bytes that hash clean mean
+        the restore error was environmental (permissions, layout
+        mismatch) and deleting would destroy a good checkpoint. Steps
+        with no manifest (pre-durability legacy saves) keep the old
+        delete-on-fallback behavior, logged as unverifiable. Read-only
+        callers (the `validate` CLI) pass False — an observation must
+        not mutate the checkpoint directory."""
         explicit = step is not None
         candidates = (
             [step] if explicit else sorted(self.manager.all_steps(), reverse=True)
@@ -201,9 +358,10 @@ class Checkpointer:
             shardings,
         )
         abstract = _pack_abstract(abstract)
-        from llm_training_tpu.resilience import RetryPolicy, is_transient, retry_call
+        from llm_training_tpu.resilience import RetryPolicy, durability, is_transient, retry_call
         from llm_training_tpu.telemetry import get_registry
 
+        registry = get_registry()
         # transient I/O during restore is retried like it is during save —
         # without this, a one-off storage blip would be misclassified as
         # corruption and the (perfectly good) newest step deleted below.
@@ -218,35 +376,94 @@ class Checkpointer:
         def _restore_transient(e: BaseException) -> bool:
             return is_transient(e) and not isinstance(e, FileNotFoundError)
 
-        first_error: Exception | None = None
-        corrupt: list[int] = []
-        for candidate in candidates:
-            try:
-                restored = retry_call(
-                    lambda attempt: self.manager.restore(
-                        candidate,
-                        args=ocp.args.Composite(
-                            state=ocp.args.StandardRestore(abstract),
-                            meta=ocp.args.JsonRestore(),
-                        ),
+        def _restore(candidate: int):
+            return retry_call(
+                lambda attempt: self.manager.restore(
+                    candidate,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(abstract),
+                        meta=ocp.args.JsonRestore(),
                     ),
-                    policy,
-                    label=f"checkpoint restore (step {candidate})",
-                    counter=get_registry().counter("checkpoint/retries"),
-                    transient=_restore_transient,
+                ),
+                policy,
+                label=f"checkpoint restore (step {candidate})",
+                counter=registry.counter("checkpoint/retries"),
+                transient=_restore_transient,
+            )
+
+        def _fall_back(candidate: int, why: str) -> None:
+            registry.counter("resilience/restore_fallbacks").inc()
+            logger.warning(
+                "checkpoint step %d in %s %s; falling back to the previous "
+                "retained step", candidate, self.directory, why,
+            )
+
+        first_error: Exception | None = None
+        corrupt: list[int] = []  # FAILED manifest verification → repairable
+        legacy: list[int] = []  # no manifest + failed restore → legacy delete
+        for candidate in candidates:
+            healed = False
+            if self.config.verify != "off" and not explicit:
+                check = durability.verify_step(
+                    self.directory, candidate, mode=self.config.verify
                 )
-            except Exception as e:
-                if explicit:
-                    raise
-                if first_error is None:
-                    first_error = e
-                corrupt.append(candidate)
-                get_registry().counter("resilience/restore_fallbacks").inc()
-                logger.warning(
-                    "checkpoint step %d in %s is corrupt or partial (%s); "
-                    "falling back to the previous retained step",
-                    candidate, self.directory, e,
-                )
+                if check.verifiable and not check.ok:
+                    self._record_verify_failure(check)
+                    healed = self._heal_from_mirror(candidate)
+                    if not healed:
+                        corrupt.append(candidate)
+                        _fall_back(candidate, "failed manifest verification")
+                        continue
+            restored = None
+            for on_healed_bytes in (False, True):
+                try:
+                    restored = _restore(candidate)
+                    break
+                except Exception as e:
+                    if explicit:
+                        raise
+                    if first_error is None:
+                        first_error = e
+                    if on_healed_bytes or healed:
+                        # already restoring a verified-clean mirror copy —
+                        # a second failure is not a byte problem
+                        logger.warning(
+                            "restore of healed step %d still failed (%s)",
+                            candidate, e,
+                        )
+                        _fall_back(candidate, f"failed restore after healing ({e})")
+                        break
+                    # classify before condemning: a restore error is only
+                    # corruption when the bytes disagree with the manifest
+                    check = durability.verify_step(
+                        self.directory, candidate, mode="full"
+                    )
+                    if not check.verifiable:
+                        legacy.append(candidate)
+                        _fall_back(
+                            candidate,
+                            f"failed restore with no manifest to verify "
+                            f"against (unverifiable legacy step; {e})",
+                        )
+                        break
+                    if check.ok:
+                        # bytes hash clean: environmental failure — the
+                        # step is preserved (never deleted) and the next
+                        # older step gets its chance
+                        _fall_back(
+                            candidate,
+                            f"failed restore but verifies clean against its "
+                            f"manifest (environmental error, step "
+                            f"preserved: {e})",
+                        )
+                        break
+                    self._record_verify_failure(check)
+                    healed = self._heal_from_mirror(candidate)
+                    if not healed:
+                        corrupt.append(candidate)
+                        _fall_back(candidate, "failed manifest verification")
+                        break
+            if restored is None:
                 continue
             logger.info(
                 "restored checkpoint step %d from %s", candidate, self.directory
@@ -255,12 +472,24 @@ class Checkpointer:
             # (a) stay the "newest" checkpoint every later restore has to
             # fall back past, and (b) make the resumed run's interval save
             # at the same step skip via the already-exists early return —
-            # the corruption would never be repaired
-            for bad in corrupt if repair else ():
+            # the corruption would never be repaired. Delete-eligible are
+            # ONLY verified-corrupt steps and unverifiable legacy steps —
+            # never a step whose bytes hash clean against its manifest
+            for bad in (corrupt + legacy) if repair else ():
                 try:
                     self.manager.delete(bad)
+                    from llm_training_tpu.resilience.durability import (
+                        manifest_path,
+                    )
+
+                    mpath = manifest_path(self.directory, bad)
+                    if self._primary_host and mpath.exists():
+                        mpath.unlink()
                     logger.warning(
-                        "deleted unrestorable checkpoint step %d", bad
+                        "deleted unrestorable checkpoint step %d (%s)",
+                        bad,
+                        "verified corrupt" if bad in corrupt
+                        else "unverifiable legacy step",
                     )
                 except Exception as e:
                     logger.warning(
@@ -305,6 +534,26 @@ class Checkpointer:
 
         with get_registry().timer("checkpoint/wait").time():
             self.manager.wait_until_finished()
+        self._flush_manifests()
+        if self._mirror is not None:
+            # the run must not end (or roll back) with its newest step
+            # unmirrored — this is the mirror's durability barrier; timed
+            # so the durability smoke can price the critical-path cost
+            with get_registry().timer("checkpoint/mirror_drain").time():
+                self._mirror.drain()
+        from llm_training_tpu.resilience import durability
+        from llm_training_tpu.resilience.chaos import get_chaos
+
+        chaos = get_chaos()
+        if chaos is not None and self._primary_host:
+            steps = durability.committed_steps(self.directory)
+            if steps:
+                # the untargeted corruption form fires here — after the
+                # mirror drained, so the restore's mirror leg has a clean
+                # copy to land on
+                chaos.maybe_corrupt_checkpoint(
+                    self.directory, steps[-1], at_final_barrier=True
+                )
         if self._inflight_step is not None:
             logger.info(
                 "checkpoint committed at step %d -> %s",
@@ -318,6 +567,8 @@ class Checkpointer:
         try:
             self.wait()
         finally:
+            if self._mirror is not None:
+                self._mirror.stop()
             self.manager.close()
 
 

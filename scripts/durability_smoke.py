@@ -1,0 +1,330 @@
+"""Checkpoint-durability smoke: the whole manifests/mirror/chaos story end
+to end through the real CLI (docs/resilience.md#durability).
+
+Legs (all in-process against `cli.main` on a tiny CPU config):
+
+1. **Plain fit** — no durability features armed; its wall clock is the A
+   side of the overhead comparison.
+2. **Armed fit** — manifests + async mirror + scrubber on. Its per-step
+   losses are the ground truth for resume exactness, the mirror must hold
+   every committed step, report must render `== Durability ==`, and the
+   critical-path durability cost (manifest hashing + the exit drain
+   barrier, both timed in telemetry) must stay under 2% of total wall.
+3. **Chaos corruption** — the same fit preempted at step 3
+   (chaos SIGTERM -> emergency checkpoint -> exit 75) with
+   `LLMT_CHAOS_CKPT_CORRUPT=flip` armed: the final barrier flips one byte
+   in the newest committed primary step AFTER the mirror drained.
+4. **`ckpt verify`** — must exit 1 and NAME the corrupted step + file
+   (fast mode must stay green: a same-size flip is invisible without the
+   hash pass — exactly why the relaunch uses `verify=full`).
+5. **Healed resume** — relaunching the same fit with
+   `trainer.checkpoint.verify=full` must detect the flip, heal the step
+   in place from the mirror (`checkpoint/mirror_restores`), and finish
+   with steps 4..6 losses EXACTLY equal (rtol 0) to leg 2's clean run;
+   `ckpt verify --mode full` must then exit 0 and report must render the
+   healed restore.
+6. **SIGKILL in the force-save swap window** — a child process is
+   SIGKILLed between the old step's delete and its replacement's commit
+   (`LLMT_CHAOS_CKPT_KILL_IN_SWAP`); the relaunch must promote the staged
+   `.stale/` copy and restore it (>= 1 restorable durable copy survives).
+
+Usage: `python scripts/durability_smoke.py <scratch-dir>` (exit 0 = pass).
+`scripts/precommit.sh` runs it on CPU after the kill-and-resume smoke.
+"""
+
+import contextlib
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import yaml
+
+from llm_training_tpu.cli.main import main as cli_main
+from llm_training_tpu.resilience import RESUMABLE_EXIT_CODE, durability
+
+MAX_STEPS = 6
+SIGTERM_STEP = 3
+
+
+def _config(scratch: Path, name: str, mirror: bool = True,
+            **trainer_extra) -> Path:
+    checkpoint = {
+        "dirpath": str(scratch / name / "checkpoints"),
+        "async_save": False,
+        "retry_backoff_s": 0.0,
+    }
+    if mirror:
+        checkpoint.update({
+            "mirror_dir": str(scratch / name / "mirror"),
+            "mirror_interval_s": 0.1,
+            "scrub_interval_s": 0.2,
+        })
+    config = {
+        "seed_everything": 7,
+        "trainer": {
+            "max_steps": MAX_STEPS,
+            "log_every_n_steps": 1,
+            "checkpoint_every_n_steps": 2,
+            "checkpoint": checkpoint,
+            "loggers": [{
+                "class_path": "llm_training_tpu.callbacks.JsonlLogger",
+                "init_args": {"save_dir": str(scratch), "project": "smoke",
+                              "name": name},
+            }],
+            **trainer_extra,
+        },
+        "model": {
+            "class_path": "llm_training_tpu.lms.CLM",
+            "init_args": {
+                "model": {
+                    "model_class": "Llama",
+                    "model_kwargs": {
+                        "vocab_size": 128, "hidden_size": 32,
+                        "intermediate_size": 64, "num_hidden_layers": 1,
+                        "num_attention_heads": 2, "num_key_value_heads": 2,
+                        "max_position_embeddings": 64, "attention_impl": "xla",
+                        "param_dtype": "float32", "compute_dtype": "float32",
+                    },
+                },
+                "optim": {"learning_rate": 1e-3, "warmup_steps": 2,
+                          "lr_scheduler": "constant"},
+            },
+        },
+        "data": {
+            "class_path": "llm_training_tpu.data.DummyDataModule",
+            "init_args": {"batch_size": 8, "max_length": 32, "num_samples": 64,
+                          "vocab_size": 128},
+        },
+    }
+    path = scratch / f"{name}.yaml"
+    path.write_text(yaml.safe_dump(config))
+    return path
+
+
+def _losses(scratch: Path, name: str) -> dict[int, float]:
+    out: dict[int, float] = {}
+    for line in (scratch / "smoke" / name / "metrics.jsonl").read_text().splitlines():
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "loss" in record and "step" in record:
+            out[int(record["step"])] = float(record["loss"])
+    return out
+
+
+def _final_telemetry(scratch: Path, name: str) -> dict:
+    merged: dict = {}
+    for line in (scratch / "smoke" / name / "telemetry.jsonl").read_text().splitlines():
+        try:
+            merged.update(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return merged
+
+
+def _capture(argv: list[str]) -> tuple[int, str]:
+    buffer = io.StringIO()
+    with contextlib.redirect_stdout(buffer):
+        rc = cli_main(argv)
+    return rc, buffer.getvalue()
+
+
+def _fail(message: str) -> int:
+    print(f"FAIL: {message}")
+    return 1
+
+
+def main(scratch_arg: str) -> int:
+    scratch = Path(scratch_arg)
+    scratch.mkdir(parents=True, exist_ok=True)
+    for var in ("LLMT_CHAOS_CKPT_CORRUPT", "LLMT_CHAOS_CKPT_KILL_IN_SWAP",
+                "LLMT_CKPT_MIRROR_DIR"):
+        os.environ.pop(var, None)
+
+    # -------- leg 1: plain fit (the A side of the overhead gate) -------
+    rc = cli_main(["fit", "--config", str(_config(scratch, "plain",
+                                                  mirror=False))])
+    if rc != 0:
+        return _fail(f"plain fit exited {rc}")
+    wall_plain = _final_telemetry(scratch, "plain").get("goodput/total_s", 0.0)
+    print(f"OK leg 1: plain fit ({wall_plain:.2f}s wall)")
+
+    # -------- leg 2: armed fit — manifests + mirror + overhead budget --
+    rc = cli_main(["fit", "--config", str(_config(scratch, "armed"))])
+    if rc != 0:
+        return _fail(f"armed fit exited {rc}")
+    armed_losses = _losses(scratch, "armed")
+    if sorted(armed_losses) != list(range(1, MAX_STEPS + 1)):
+        return _fail(f"armed fit logged steps {sorted(armed_losses)}")
+    primary = scratch / "armed" / "checkpoints"
+    mirror = scratch / "armed" / "mirror"
+    committed = durability.committed_steps(primary)
+    if not committed:
+        return _fail("armed fit committed no checkpoints")
+    for step in committed:
+        if not durability.verify_step(primary, step, mode="full").ok:
+            return _fail(f"primary step {step} has no clean manifest")
+    if durability.committed_steps(mirror) != committed:
+        return _fail(
+            f"mirror {durability.committed_steps(mirror)} != primary "
+            f"{committed} after the exit drain barrier"
+        )
+    telemetry = _final_telemetry(scratch, "armed")
+    wall = telemetry.get("goodput/total_s", 0.0)
+    durable_s = (telemetry.get("checkpoint/manifest_s", 0.0)
+                 + telemetry.get("checkpoint/mirror_drain_s", 0.0))
+    if not wall:
+        return _fail(f"armed fit recorded no goodput/total_s: {telemetry}")
+    overhead = durable_s / wall
+    if overhead >= 0.02:
+        return _fail(
+            f"durability critical-path cost {durable_s:.3f}s is "
+            f"{100 * overhead:.2f}% of {wall:.2f}s wall (budget < 2%)"
+        )
+    rc, rendered = _capture(["report", str(scratch / "smoke" / "armed")])
+    if rc != 0 or "== Durability ==" not in rendered:
+        return _fail(f"report (rc={rc}) missing == Durability ==:\n{rendered}")
+    delta = wall - wall_plain
+    print(
+        f"OK leg 2: armed fit mirrored steps {committed}, durability "
+        f"critical path {durable_s * 1000:.0f}ms = {100 * overhead:.2f}% of "
+        f"wall (< 2%), A/B wall delta {delta:+.2f}s, report renders "
+        "== Durability =="
+    )
+
+    # -------- leg 3: preempt + flip the newest step at the barrier -----
+    chaos_config = _config(
+        scratch, "chaos",
+        resilience={"chaos": {"sigterm_step": SIGTERM_STEP}},
+    )
+    os.environ["LLMT_CHAOS_CKPT_CORRUPT"] = "flip"
+    try:
+        rc = cli_main(["fit", "--config", str(chaos_config)])
+    finally:
+        os.environ.pop("LLMT_CHAOS_CKPT_CORRUPT", None)
+    if rc != RESUMABLE_EXIT_CODE:
+        return _fail(f"preempted fit exited {rc}, want {RESUMABLE_EXIT_CODE}")
+    primary = scratch / "chaos" / "checkpoints"
+    mirror = scratch / "chaos" / "mirror"
+    newest = durability.committed_steps(primary)[-1]
+    if newest != SIGTERM_STEP:
+        return _fail(f"no emergency checkpoint at step {SIGTERM_STEP}: "
+                     f"{durability.committed_steps(primary)}")
+    if durability.verify_step(primary, newest, mode="full").ok:
+        return _fail("chaos flip left the newest primary step intact")
+    if not durability.verify_step(mirror, newest, mode="full").ok:
+        return _fail("mirror copy not intact — the flip must land AFTER "
+                     "the drain barrier")
+    print(f"OK leg 3: SIGTERM at step {SIGTERM_STEP} -> exit 75, chaos "
+          f"flipped a byte in primary step {newest} after the mirror drained")
+
+    # -------- leg 4: ckpt verify names the damage ----------------------
+    os.environ["LLMT_CKPT_MIRROR_DIR"] = str(mirror)  # the env form
+    try:
+        rc, out = _capture(["ckpt", "verify", str(primary), "--mode", "full"])
+    finally:
+        os.environ.pop("LLMT_CKPT_MIRROR_DIR", None)
+    if rc != 1:
+        return _fail(f"ckpt verify exited {rc} on a corrupt step, want 1:\n{out}")
+    finding = next((l for l in out.splitlines() if l.startswith("FINDING")), "")
+    if f"step {newest}" not in finding or "sha256" not in finding:
+        return _fail(f"verify finding does not name step+file:\n{out}")
+    rc, _ = _capture(["ckpt", "verify", str(primary), "--mode", "fast"])
+    if rc != 0:
+        return _fail("fast verify saw a same-size flip (should need full)")
+    print(f"OK leg 4: ckpt verify exits 1 naming the file ({finding.strip()}), "
+          "fast mode blind to the flip as documented")
+
+    # -------- leg 5: relaunch heals from the mirror, losses exact ------
+    rc = cli_main(["fit", "--config", str(chaos_config),
+                   "trainer.resilience.chaos.sigterm_step=null",
+                   "trainer.checkpoint.verify=full"])
+    if rc != 0:
+        return _fail(f"healed resume exited {rc}")
+    telemetry = _final_telemetry(scratch, "chaos")
+    if telemetry.get("checkpoint/verify_failures", 0) < 1:
+        return _fail(f"resume never counted the verify failure: {telemetry}")
+    if telemetry.get("checkpoint/mirror_restores", 0) < 1:
+        return _fail(f"resume did not heal from the mirror: {telemetry}")
+    resumed = _losses(scratch, "chaos")
+    for step in range(SIGTERM_STEP + 1, MAX_STEPS + 1):
+        if resumed[step] != armed_losses[step]:  # rtol 0: byte-identical
+            return _fail(
+                f"healed resume diverged at step {step}: {resumed[step]!r} "
+                f"vs clean {armed_losses[step]!r}"
+            )
+    rc, _ = _capture(["ckpt", "verify", str(primary), "--mode", "full"])
+    if rc != 0:
+        return _fail("primary still dirty after the mirror heal")
+    rc, rendered = _capture(["report", str(scratch / "smoke" / "chaos")])
+    if rc != 0 or "== Durability ==" not in rendered \
+            or "restores healed from the mirror" not in rendered:
+        return _fail(f"report missing the healed restore:\n{rendered}")
+    rc, out = _capture(["report", str(scratch / "smoke" / "chaos"),
+                        "--format", "json"])
+    doc = json.loads(out)
+    if doc["durability"].get("checkpoint/mirror_restores", 0) < 1:
+        return _fail(f"report json durability subset wrong: {doc['durability']}")
+    print(f"OK leg 5: restore healed step {newest} from the mirror, steps "
+          f"{SIGTERM_STEP + 1}..{MAX_STEPS} losses EXACTLY equal the clean "
+          "run, verify green again, report renders the heal (text + json)")
+
+    # -------- leg 6: SIGKILL inside the force-save swap window ---------
+    kill_dir = scratch / "kill" / "checkpoints"
+    child = textwrap.dedent(
+        """
+        import jax, jax.numpy as jnp
+        from llm_training_tpu.trainer.state import TrainState
+        from llm_training_tpu.trainer.checkpoint import CheckpointConfig, Checkpointer
+        from llm_training_tpu.resilience import ChaosConfig, config_from_env, install_chaos
+
+        install_chaos(config_from_env(ChaosConfig()))
+
+        def tiny(v):
+            return TrainState.create(
+                params={"w": jnp.full((4,), v, jnp.float32)},
+                opt_state={"m": jnp.zeros((4,), jnp.float32)},
+                rng=jax.random.key(0),
+            )
+
+        ckpt = Checkpointer(CheckpointConfig(
+            dirpath=%r, async_save=False, retry_backoff_s=0.0))
+        ckpt.save(1, tiny(1.0))
+        ckpt.save(1, tiny(9.0), force=True)  # chaos SIGKILLs mid-swap
+        raise SystemExit("survived the kill window")
+        """ % str(kill_dir)
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               LLMT_CHAOS_CKPT_KILL_IN_SWAP="1")
+    env["PYTHONPATH"] = (str(Path(__file__).resolve().parent.parent)
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=600)
+    if proc.returncode != -signal.SIGKILL:
+        return _fail(f"kill-in-swap child exited {proc.returncode}: "
+                     f"{proc.stdout}{proc.stderr}")
+    if not (kill_dir / durability.STALE_DIR).is_dir():
+        return _fail("no staged copy survived the SIGKILL window")
+    promoted = durability.promote_stale_steps(kill_dir)
+    if promoted != [1]:
+        return _fail(f"promotion recovered {promoted}, want [1]")
+    rc, out = _capture(["ckpt", "verify", str(kill_dir), "--mode", "full"])
+    if rc != 0:
+        return _fail(f"promoted step does not verify clean (rc={rc}):\n{out}")
+    print("OK leg 6: SIGKILL in the force-save swap window left a staged "
+          "durable copy; promotion restored it and it verifies clean")
+
+    print("durability_smoke: all legs passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else "runs/durability-smoke"))

@@ -334,6 +334,16 @@ JAX_PLATFORMS=cpu python scripts/force_nan_smoke.py "${SMOKE_ROOT}/nan-smoke"
 echo "== precommit: kill-and-resume + supervise + elastic smoke =="
 JAX_PLATFORMS=cpu python scripts/crash_resume_smoke.py "${SMOKE_ROOT}/resilience"
 
+# durability gate (docs/resilience.md#durability): hashed manifests at save
+# commit + async mirror; a chaos byte-flip in the newest primary step must
+# be NAMED by `ckpt verify` (exit 1), the relaunch must heal the step from
+# the mirror and resume with losses EXACTLY equal to the clean same-seed
+# run, a SIGKILL inside the force-save swap window must leave a restorable
+# staged copy, and the manifest+drain critical-path cost must stay < 2% of
+# wall
+echo "== precommit: durability smoke (manifests + mirror heal + chaos corruption) =="
+JAX_PLATFORMS=cpu python scripts/durability_smoke.py "${SMOKE_ROOT}/durability"
+
 # bench harness gate (docs/performance.md): the full stage/subprocess/
 # partial-JSON plumbing must work on CPU so bench wiring can't rot unnoticed
 # between hardware rounds — every stage ok, a real MFU value, a summary
